@@ -1,0 +1,20 @@
+"""stellar_core_tpu — a TPU-native framework with the capabilities of stellar-core.
+
+Brand-new implementation (not a port) of the Stellar validator-node stack:
+XDR protocol types, Ed25519/StrKey crypto, bucket-list ledger store,
+transaction apply engine, SCP consensus, P2P overlay, history publish and
+catchup replay — with the two embarrassingly-parallel hot loops offloaded to
+TPU via JAX:
+
+- ``accel.ed25519``: batched Ed25519 signature verification (the
+  ``TPUCryptoBackend`` behind the ``SignatureChecker`` seam; reference seam:
+  src/crypto/SecretKey.cpp — PubKeyUtils::verifySig).
+- ``accel.quorum``: bitmask-encoded quorum-intersection enumeration (the
+  ``TPUQuorumIntersectionChecker``; reference seam:
+  src/herder/QuorumIntersectionChecker.h — QuorumIntersectionChecker::create).
+
+Layering mirrors SURVEY.md §1 (bottom → top): util/crypto/xdr → bucket/ledger
+→ transactions → herder+scp → overlay → history/catchup → main.
+"""
+
+__version__ = "0.1.0"

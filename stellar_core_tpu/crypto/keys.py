@@ -1,0 +1,131 @@
+"""Key types and signature verification with the verify-result cache.
+
+Reference: src/crypto/SecretKey.{h,cpp} — SecretKey, PublicKey,
+PubKeyUtils::verifySig (libsodium verify + RandomEvictionCache keyed by
+hash(sig‖key‖msg)), KeyUtils; src/crypto/SignerKey.h.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from . import sodium, strkey
+from .sha import sha256
+from ..util.cache import RandomEvictionCache
+
+VERIFY_CACHE_SIZE = 0x10000  # reference: 64k-entry verify cache
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    """Ed25519 public key (XDR: PublicKey{PUBLIC_KEY_TYPE_ED25519, uint256})."""
+
+    ed25519: bytes  # 32 bytes
+
+    def __post_init__(self) -> None:
+        if len(self.ed25519) != 32:
+            raise ValueError("ed25519 public key must be 32 bytes")
+
+    def to_strkey(self) -> str:
+        return strkey.encode_public_key(self.ed25519)
+
+    @staticmethod
+    def from_strkey(s: str) -> "PublicKey":
+        return PublicKey(strkey.decode_public_key(s))
+
+    def hint(self) -> bytes:
+        """Signature hint: last 4 bytes of the key (XDR SignatureHint).
+        Reference: src/crypto/SignerKeyUtils / SignatureUtils — getHint."""
+        return self.ed25519[28:32]
+
+    def __repr__(self) -> str:
+        return f"PublicKey({self.to_strkey()})"
+
+
+class SecretKey:
+    """Reference: src/crypto/SecretKey.h — SecretKey (seed + expanded key)."""
+
+    __slots__ = ("_seed", "_sk", "public_key")
+
+    def __init__(self, seed: bytes) -> None:
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        pk, sk = sodium.sign_seed_keypair(seed)
+        self._seed = seed
+        self._sk = sk
+        self.public_key = PublicKey(pk)
+
+    @staticmethod
+    def random() -> "SecretKey":
+        return SecretKey(os.urandom(32))
+
+    @staticmethod
+    def pseudo_random_for_testing(rng) -> "SecretKey":
+        return SecretKey(bytes(rng.randrange(256) for _ in range(32)))
+
+    @staticmethod
+    def from_strkey_seed(s: str) -> "SecretKey":
+        return SecretKey(strkey.decode_seed(s))
+
+    def to_strkey_seed(self) -> str:
+        return strkey.encode_seed(self._seed)
+
+    def sign(self, msg: bytes) -> bytes:
+        return sodium.sign_detached(msg, self._sk)
+
+    def __repr__(self) -> str:
+        return f"SecretKey({self.public_key.to_strkey()})"
+
+
+class _VerifyCache:
+    def __init__(self) -> None:
+        self._cache: RandomEvictionCache[bytes, bool] = RandomEvictionCache(VERIFY_CACHE_SIZE)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key(sig: bytes, pk: bytes, msg: bytes) -> bytes:
+        return sha256(sig + pk + msg)
+
+    def get(self, k: bytes) -> Optional[bool]:
+        with self._lock:
+            return self._cache.maybe_get(k)
+
+    def put(self, k: bytes, verdict: bool) -> None:
+        with self._lock:
+            self._cache.put(k, verdict)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+
+_verify_cache = _VerifyCache()
+
+
+def verify_sig(pk: PublicKey, sig: bytes, msg: bytes) -> bool:
+    """PubKeyUtils::verifySig equivalent: cached libsodium-exact verdict.
+
+    The TPU batch path (accel.backend.TPUCryptoBackend) pre-verifies whole
+    work units and seeds this cache, so per-tx checks hit without recompute —
+    same observable semantics, hoisted compute.
+    """
+    k = _VerifyCache.key(sig, pk.ed25519, msg)
+    hit = _verify_cache.get(k)
+    if hit is not None:
+        return hit
+    verdict = sodium.verify_detached(sig, msg, pk.ed25519)
+    _verify_cache.put(k, verdict)
+    return verdict
+
+
+def seed_verify_cache(entries) -> None:
+    """Bulk-insert (pk32, sig, msg, verdict) tuples (TPU backend hook)."""
+    for pk, sig, msg, verdict in entries:
+        _verify_cache.put(_VerifyCache.key(sig, pk, msg), bool(verdict))
+
+
+def clear_verify_cache() -> None:
+    _verify_cache.clear()

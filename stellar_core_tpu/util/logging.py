@@ -1,0 +1,49 @@
+"""Partitioned logging. Reference: src/util/Logging.{h,cpp} — CLOG_* macros
+with per-partition runtime-settable levels (Fs, SCP, Bucket, Overlay, History,
+Ledger, Herder, Tx, Database, Process, Work, Invariant, Perf)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Dict
+
+PARTITIONS = (
+    "Fs", "SCP", "Bucket", "Overlay", "History", "Ledger", "Herder", "Tx",
+    "Database", "Process", "Work", "Invariant", "Perf",
+)
+
+_loggers: Dict[str, logging.Logger] = {}
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s [%(name)s %(levelname)s] %(message)s"))
+    root = logging.getLogger("stellar")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    _configured = True
+
+
+def get(partition: str) -> logging.Logger:
+    if partition not in PARTITIONS:
+        raise ValueError(f"unknown log partition {partition!r}")
+    _configure()
+    if partition not in _loggers:
+        _loggers[partition] = logging.getLogger(f"stellar.{partition}")
+    return _loggers[partition]
+
+
+def set_level(level: str, partition: str | None = None) -> None:
+    """Runtime level control (reference: /ll?level=&partition= endpoint)."""
+    _configure()
+    lvl = getattr(logging, level.upper())
+    if partition is None:
+        logging.getLogger("stellar").setLevel(lvl)
+    else:
+        get(partition).setLevel(lvl)

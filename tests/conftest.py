@@ -1,0 +1,16 @@
+"""Test harness config: force a deterministic 8-device CPU mesh for JAX.
+
+Multi-chip sharding (the v5e-8 target topology) is tested on virtual CPU
+devices via --xla_force_host_platform_device_count; the real-TPU path is
+exercised by bench.py and the driver's dryrun. Must run before jax imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
