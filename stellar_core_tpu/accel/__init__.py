@@ -1,0 +1,29 @@
+"""TPU acceleration layer (JAX).
+
+The two offloaded hot loops (BASELINE.json north star):
+
+- ``ed25519``: batched signature verification — the ``TPUCryptoBackend``
+  behind the SignatureChecker seam (reference seam: src/crypto/SecretKey.cpp —
+  PubKeyUtils::verifySig).
+- ``quorum``: quorum-intersection subset enumeration — the
+  ``TPUQuorumIntersectionChecker`` (reference seam:
+  src/herder/QuorumIntersectionCheckerImpl.cpp).
+
+Field arithmetic uses 16x16-bit limbs held in int64, so x64 must be enabled
+before any accel arrays are built (TPU emulates int64 with int32 pairs; the
+kernels are exact integer math end to end).
+"""
+
+import jax
+
+# HARD REQUIREMENT, process-global: the limb kernels are meaningless with
+# int64 silently truncated to int32 (x64 off is jax's default).  This is an
+# import side effect by design — importing this package opts the process into
+# x64, and embedders who need 32-bit weak-type defaults elsewhere must isolate
+# accel work in its own process.  We fail loudly if the flag didn't stick.
+jax.config.update("jax_enable_x64", True)
+if not jax.config.jax_enable_x64:  # pragma: no cover
+    raise RuntimeError(
+        "stellar_core_tpu.accel requires jax_enable_x64; the flag could not "
+        "be enabled (frozen config?) — exact int64 field arithmetic is "
+        "impossible without it")

@@ -1,0 +1,184 @@
+"""Content-addressed buckets and the exact-semantics merge.
+
+Reference: src/bucket/Bucket.{h,cpp} (LiveBucket), BucketInputIterator /
+BucketOutputIterator, and the CAP-20 INIT/LIVE/DEAD merge logic in
+src/bucket/BucketBase.cpp — merge (modern protocol >= 12 semantics, no
+shadow buckets).
+
+A bucket is an immutable, key-sorted sequence of BucketEntry XDR records,
+headed by a METAENTRY carrying the protocol version; its identity is the
+SHA-256 of the serialized stream (content addressing, same scheme the
+reference uses for bucket files).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..crypto.sha import SHA256
+from ..xdr import (BucketEntry, BucketEntryType, BucketMetadata, LedgerEntry,
+                   LedgerKey, ledger_entry_key)
+
+_BE = BucketEntry._xdr_adapter()
+
+
+def _key_bytes(key: LedgerKey) -> bytes:
+    """Sort key: LedgerKey XDR bytes.  Type discriminant leads, then the
+    per-type fields in declaration order — matches the reference's
+    LedgerEntryIdCmp grouping (src/bucket/LedgerCmp.h) for classic types."""
+    return key.to_xdr()
+
+
+def entry_sort_key(be: BucketEntry) -> bytes:
+    if be.switch == BucketEntryType.DEADENTRY:
+        return _key_bytes(be.value)
+    if be.switch == BucketEntryType.METAENTRY:
+        return b""  # meta sorts first
+    return _key_bytes(ledger_entry_key(be.value))
+
+
+class Bucket:
+    """Immutable sorted bucket. entries EXCLUDE the meta entry; protocol
+    version is carried separately and re-serialized as METAENTRY."""
+
+    __slots__ = ("entries", "protocol_version", "_hash")
+
+    def __init__(self, entries: List[BucketEntry], protocol_version: int):
+        self.entries = entries
+        self.protocol_version = protocol_version
+        self._hash: Optional[bytes] = None
+
+    @staticmethod
+    def empty() -> "Bucket":
+        return Bucket([], 0)
+
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    def hash(self) -> bytes:
+        """SHA-256 over the serialized stream (meta + entries); empty bucket
+        hashes to 32 zero bytes (reference: Bucket::getHash of empty)."""
+        if self._hash is None:
+            if not self.entries:
+                self._hash = b"\x00" * 32
+            else:
+                h = SHA256()
+                h.add(_BE.pack(BucketEntry.metaEntry(
+                    BucketMetadata(ledgerVersion=self.protocol_version))))
+                for e in self.entries:
+                    h.add(_BE.pack(e))
+                self._hash = h.finish()
+        return self._hash
+
+    def serialize(self) -> bytes:
+        out = bytearray()
+        if self.entries:
+            out += _BE.pack(BucketEntry.metaEntry(
+                BucketMetadata(ledgerVersion=self.protocol_version)))
+            for e in self.entries:
+                out += _BE.pack(e)
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Bucket":
+        entries: List[BucketEntry] = []
+        off = 0
+        protocol = 0
+        while off < len(data):
+            e, off = _BE.unpack_from(data, off)
+            if e.switch == BucketEntryType.METAENTRY:
+                protocol = e.value.ledgerVersion
+            else:
+                entries.append(e)
+        return Bucket(entries, protocol)
+
+    @staticmethod
+    def fresh(protocol_version: int, init_entries: Iterable[LedgerEntry],
+              live_entries: Iterable[LedgerEntry],
+              dead_keys: Iterable[LedgerKey]) -> "Bucket":
+        """One ledger's output as a bucket (reference: LiveBucket::fresh).
+        Within a single batch a key appears at most once per class; the
+        LedgerManager guarantees init/live/dead disjointness."""
+        tagged: List[Tuple[bytes, BucketEntry]] = []
+        for e in init_entries:
+            be = BucketEntry.initEntry(e)
+            tagged.append((entry_sort_key(be), be))
+        for e in live_entries:
+            be = BucketEntry.liveEntry(e)
+            tagged.append((entry_sort_key(be), be))
+        for k in dead_keys:
+            be = BucketEntry.deadEntry(k)
+            tagged.append((entry_sort_key(be), be))
+        tagged.sort(key=lambda t: t[0])
+        return Bucket([e for _, e in tagged], protocol_version)
+
+    def __iter__(self):
+        return iter(self.entries)
+
+
+def _is_init(be: BucketEntry) -> bool:
+    return be.switch == BucketEntryType.INITENTRY
+
+
+def _is_live(be: BucketEntry) -> bool:
+    return be.switch == BucketEntryType.LIVEENTRY
+
+
+def _is_dead(be: BucketEntry) -> bool:
+    return be.switch == BucketEntryType.DEADENTRY
+
+
+def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
+                  protocol_version: Optional[int] = None) -> Bucket:
+    """Merge two key-sorted buckets, new entries shadowing old.
+
+    CAP-20 pair rules (reference: BucketBase::merge + mergeCasesWithEqualKeys,
+    protocol >= 12 semantics):
+      (INIT, LIVE) -> INIT carrying the live value
+      (INIT, DEAD) -> annihilate (both dropped)
+      (DEAD, INIT) -> LIVE carrying the init value
+      (LIVE, DEAD) -> DEAD tombstone
+      otherwise    -> the newer entry
+    keep_tombstones=False (bottom level): DEADs are dropped and INITs decay
+    to LIVE (no deeper state left to annihilate against).
+    """
+    proto = protocol_version if protocol_version is not None else max(
+        old.protocol_version, new.protocol_version)
+    out: List[BucketEntry] = []
+
+    def emit(be: BucketEntry):
+        if _is_dead(be):
+            if keep_tombstones:
+                out.append(be)
+        elif _is_init(be) and not keep_tombstones:
+            out.append(BucketEntry.liveEntry(be.value))
+        else:
+            out.append(be)
+
+    i = j = 0
+    o, n = old.entries, new.entries
+    while i < len(o) or j < len(n):
+        if j >= len(n):
+            emit(o[i]); i += 1
+            continue
+        if i >= len(o):
+            emit(n[j]); j += 1
+            continue
+        ko, kn = entry_sort_key(o[i]), entry_sort_key(n[j])
+        if ko < kn:
+            emit(o[i]); i += 1
+        elif kn < ko:
+            emit(n[j]); j += 1
+        else:
+            oe, ne = o[i], n[j]
+            i += 1
+            j += 1
+            if _is_init(oe) and _is_live(ne):
+                emit(BucketEntry.initEntry(ne.value))
+            elif _is_init(oe) and _is_dead(ne):
+                pass  # annihilated
+            elif _is_dead(oe) and _is_init(ne):
+                emit(BucketEntry.liveEntry(ne.value))
+            else:
+                emit(ne)
+    return Bucket(out, proto)

@@ -253,6 +253,11 @@ class _EnumAdapter(XdrType):
         self.enum_cls = enum_cls
 
     def pack_into(self, val, out):
+        try:
+            val = self.enum_cls(val)
+        except ValueError:
+            raise XdrError(
+                f"bad {self.enum_cls.__name__} value {val!r}") from None
         out += _pack_prim(_I32, int(val))
 
     def unpack_from(self, buf, off):

