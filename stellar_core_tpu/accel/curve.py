@@ -61,6 +61,15 @@ class PointBatch:
         one = jnp.zeros(shape + (NLIMB,), dtype=jnp.int64).at[..., 0].set(1)
         return PointBatch(zero, one, one, zero)
 
+    @staticmethod
+    def identity_like(ref: "PointBatch") -> "PointBatch":
+        """Identity derived from an existing batch so the result inherits its
+        sharding/varying type (required for lax.scan carries under
+        shard_map)."""
+        zero = ref.X * 0
+        one = zero.at[..., 0].set(1)
+        return PointBatch(zero, one, one, zero)
+
     def tree(self):
         return (self.X, self.Y, self.Z, self.T)
 
@@ -128,8 +137,7 @@ def double_scalarmult_w2(windows, c_point: PointBatch):
         return jnp.broadcast_to(v, (n, NLIMB))
 
     # C multiples: identity, C, 2C, 3C
-    ident = PointBatch(c_point.X * 0, (c_point.X * 0).at[..., 0].set(1),
-                       (c_point.X * 0).at[..., 0].set(1), c_point.X * 0)
+    ident = PointBatch.identity_like(c_point)
     c2 = point_dbl(c_point)
     c3 = point_add(c2, c_point, d2)
     c_mults = [ident, c_point, c2, c3]
@@ -158,9 +166,7 @@ def double_scalarmult_w2(windows, c_point: PointBatch):
         r = point_add(r, picked, d2)
         return r.tree(), None
 
-    zero = c_point.X * 0
-    one = zero.at[..., 0].set(1)
-    final, _ = lax.scan(step, (zero, one, one, zero), windows)
+    final, _ = lax.scan(step, PointBatch.identity_like(c_point).tree(), windows)
     return PointBatch.from_tree(final)
 
 

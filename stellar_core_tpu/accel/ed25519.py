@@ -138,14 +138,19 @@ def _windows_msb_first(s_raw: np.ndarray, h_raw: np.ndarray) -> np.ndarray:
     return w[:, ::-1].T.copy()
 
 
-@jax.jit
-def _verify_kernel(windows, cx, cy, ct, r_bytes):
+def verify_forward(windows, cx, cy, ct, r_bytes):
+    """The jittable forward step (also the driver's compile-check target in
+    __graft_entry__): windowed double-scalarmult + canonical encode +
+    byte-compare."""
     n = cx.shape[0]
     cz = jnp.zeros((n, field.NLIMB), dtype=jnp.int64).at[:, 0].set(1)
     c = PointBatch(cx, cy, cz, ct)
     r = double_scalarmult_w2(windows, c)
     enc = point_encode(r)
     return jnp.all(enc == r_bytes, axis=-1)
+
+
+_verify_kernel = jax.jit(verify_forward)
 
 
 class Ed25519BatchVerifier:

@@ -157,6 +157,8 @@ def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
 
     i = j = 0
     o, n = old.entries, new.entries
+    o_keys = [entry_sort_key(e) for e in o]
+    n_keys = [entry_sort_key(e) for e in n]
     while i < len(o) or j < len(n):
         if j >= len(n):
             emit(o[i]); i += 1
@@ -164,7 +166,7 @@ def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
         if i >= len(o):
             emit(n[j]); j += 1
             continue
-        ko, kn = entry_sort_key(o[i]), entry_sort_key(n[j])
+        ko, kn = o_keys[i], n_keys[j]
         if ko < kn:
             emit(o[i]); i += 1
         elif kn < ko:

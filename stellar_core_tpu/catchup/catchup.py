@@ -1,0 +1,268 @@
+"""Catchup: bootstrap/resync a node from history archives.
+
+Reference: src/catchup/CatchupWork.cpp (the work DAG root),
+VerifyLedgerChainWork.cpp (back-chained previousLedgerHash verification),
+ApplyBucketsWork.cpp + BucketApplicator (state snapshot assumption),
+ApplyCheckpointWork.cpp (tx replay — THE north-star hot loop, SURVEY.md §3.3),
+CatchupConfiguration (CATCHUP_COMPLETE vs minimal/recent modes).
+
+TPU offload hook: before a checkpoint replays, every (pk, sig, payload)
+triple that can be paired by signature hint is batch-verified on the
+accelerator and the verdicts seeded into the process verify cache, so the
+SignatureChecker inside TransactionFrame.apply hits the cache instead of
+calling libsodium — observable behavior identical, compute hoisted
+(BASELINE.json: "batches every envelope and transaction signature from a
+catchup work-unit into a single vmapped Ed25519 verify").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .. import xdr as X
+from ..bucket.bucket import Bucket
+from ..bucket.bucket_list import NUM_LEVELS
+from ..crypto import keys
+from ..crypto.sha import sha256
+from ..history.archive import (CATEGORY_LEDGER, CATEGORY_RESULTS,
+                               CATEGORY_TRANSACTIONS, CHECKPOINT_FREQUENCY,
+                               FileHistoryArchive, category_path,
+                               checkpoint_containing,
+                               first_ledger_in_checkpoint)
+from ..ledger.manager import LedgerManager
+from ..transactions.frame import TransactionFrame
+from ..util import logging as slog
+
+log = slog.get("History")
+
+_LHHE = X.LedgerHeaderHistoryEntry._xdr_adapter()
+_THE = X.TransactionHistoryEntry._xdr_adapter()
+
+
+class CatchupError(RuntimeError):
+    pass
+
+
+def verify_ledger_chain(headers: Sequence[X.LedgerHeaderHistoryEntry],
+                        trusted_tail_hash: Optional[bytes] = None) -> None:
+    """Each entry's hash must equal SHA256 of its header, and each header
+    must chain to the previous entry's hash (reference:
+    VerifyLedgerChainWork — verified back from a trusted hash)."""
+    prev_hash: Optional[bytes] = None
+    for entry in headers:
+        if sha256(entry.header.to_xdr()) != entry.hash:
+            raise CatchupError(
+                f"header self-hash mismatch at ledger {entry.header.ledgerSeq}")
+        if prev_hash is not None and entry.header.previousLedgerHash != prev_hash:
+            raise CatchupError(
+                f"hash chain broken at ledger {entry.header.ledgerSeq}")
+        prev_hash = entry.hash
+    if trusted_tail_hash is not None and headers \
+            and headers[-1].hash != trusted_tail_hash:
+        raise CatchupError("chain tail does not match trusted hash")
+
+
+def preverify_checkpoint_signatures(network_id: bytes,
+                                    tx_entries: Sequence[X.TransactionHistoryEntry],
+                                    chunk_size: int = 2048) -> int:
+    """Batch-verify all hint-pairable signatures of a checkpoint on the
+    accelerator and seed the verify cache.  Returns number of sigs shipped.
+
+    Pairing: a DecoratedSignature whose hint matches the tx source account's
+    master key (the dominant case in replay).  Unpaired signatures simply
+    fall back to on-demand CPU verification — verdicts never differ, only
+    where they're computed."""
+    from ..accel.ed25519 import verify_batch
+
+    pks: List[bytes] = []
+    sigs: List[bytes] = []
+    msgs: List[bytes] = []
+    for entry in tx_entries:
+        for env in entry.txSet.txs:
+            frame = TransactionFrame.make_from_wire(network_id, env)
+            h = frame.content_hash()
+            candidates = [frame.source_account_id().value]
+            if hasattr(frame, "inner"):
+                candidates.append(frame.inner.source_account_id().value)
+            for op in frame.operations:
+                if op.sourceAccount is not None:
+                    candidates.append(
+                        X.muxed_to_account_id(op.sourceAccount).value)
+            for dsig in frame.signatures:
+                for pk in candidates:
+                    if dsig.hint == pk[28:32]:
+                        pks.append(pk)
+                        sigs.append(dsig.signature)
+                        msgs.append(h)
+                        break
+    if not pks:
+        return 0
+    verdicts = verify_batch(pks, sigs, msgs, chunk_size=chunk_size)
+    keys.seed_verify_cache(
+        (pks[i], sigs[i], msgs[i], bool(verdicts[i])) for i in range(len(pks)))
+    return len(pks)
+
+
+class CatchupManager:
+    """Replay/assume-state driver (reference: CatchupManagerImpl +
+    CatchupWork).  `accel=True` routes checkpoint signature verification
+    through the TPU batch backend."""
+
+    def __init__(self, network_id: bytes, network_passphrase: str,
+                 accel: bool = False, accel_chunk: int = 2048):
+        self.network_id = network_id
+        self.network_passphrase = network_passphrase
+        self.accel = accel
+        self.accel_chunk = accel_chunk
+
+    # -- archive readers ----------------------------------------------------
+    def _read_headers(self, archive: FileHistoryArchive,
+                      checkpoint: int) -> List[X.LedgerHeaderHistoryEntry]:
+        recs = archive.get_xdr_file(category_path(CATEGORY_LEDGER, checkpoint))
+        if recs is None:
+            raise CatchupError(f"missing ledger file for checkpoint {checkpoint}")
+        try:
+            return [_LHHE.unpack(r) for r in recs]
+        except X.XdrError as e:
+            raise CatchupError(
+                f"corrupt ledger file at checkpoint {checkpoint}: {e}") from e
+
+    def _read_txs(self, archive: FileHistoryArchive, checkpoint: int
+                  ) -> Dict[int, X.TransactionHistoryEntry]:
+        recs = archive.get_xdr_file(
+            category_path(CATEGORY_TRANSACTIONS, checkpoint)) or []
+        out = {}
+        try:
+            for r in recs:
+                e = _THE.unpack(r)
+                out[e.ledgerSeq] = e
+        except X.XdrError as e:
+            raise CatchupError(
+                f"corrupt tx file at checkpoint {checkpoint}: {e}") from e
+        return out
+
+    # -- complete replay (from genesis) ------------------------------------
+    def catchup_complete(self, archive: FileHistoryArchive,
+                         to_ledger: Optional[int] = None) -> LedgerManager:
+        """Replay every ledger from genesis to the target (reference:
+        CATCHUP_COMPLETE; ApplyCheckpointWork per checkpoint)."""
+        has = archive.get_state()
+        if has is None:
+            raise CatchupError("archive has no HAS")
+        target = to_ledger if to_ledger is not None else has.current_ledger
+
+        mgr = LedgerManager(self.network_id)
+        mgr.start_new_ledger()
+        checkpoint = checkpoint_containing(2)
+        prev_tail: Optional[X.LedgerHeaderHistoryEntry] = None
+        while mgr.last_closed_ledger_seq < target:
+            headers = self._read_headers(archive, checkpoint)
+            verify_ledger_chain(headers)
+            if prev_tail is not None and headers and \
+                    headers[0].header.previousLedgerHash != prev_tail.hash:
+                raise CatchupError(
+                    f"chain broken across checkpoint {checkpoint}")
+            txs = self._read_txs(archive, checkpoint)
+            if self.accel:
+                n = preverify_checkpoint_signatures(
+                    self.network_id, list(txs.values()), self.accel_chunk)
+                log.info("checkpoint %d: %d sigs batch-verified on accel",
+                         checkpoint, n)
+            self._apply_checkpoint(mgr, headers, txs, target)
+            if headers:
+                prev_tail = headers[-1]
+            checkpoint += CHECKPOINT_FREQUENCY
+            if mgr.last_closed_ledger_seq >= target:
+                break
+            if checkpoint > checkpoint_containing(target):
+                break
+        if mgr.last_closed_ledger_seq != target:
+            raise CatchupError(
+                f"catchup ended at {mgr.last_closed_ledger_seq}, "
+                f"target {target}")
+        return mgr
+
+    def _apply_checkpoint(self, mgr: LedgerManager,
+                          headers: Sequence[X.LedgerHeaderHistoryEntry],
+                          txs: Dict[int, X.TransactionHistoryEntry],
+                          target: int) -> None:
+        """Reference: ApplyCheckpointWork — per ledger: reassemble the tx
+        set, check its hash against the header, apply, check the resulting
+        ledger hash (fail-stop on mismatch)."""
+        for entry in headers:
+            seq = entry.header.ledgerSeq
+            if seq <= mgr.last_closed_ledger_seq:
+                continue
+            if seq > target:
+                return
+            if seq != mgr.last_closed_ledger_seq + 1:
+                raise CatchupError(f"gap in headers at {seq}")
+            the = txs.get(seq)
+            if the is not None:
+                tx_set = the.txSet
+            else:
+                tx_set = X.TransactionSet(previousLedgerHash=mgr.lcl_hash,
+                                          txs=[])
+            if sha256(tx_set.to_xdr()) != entry.header.scpValue.txSetHash:
+                raise CatchupError(f"tx set hash mismatch at ledger {seq}")
+            frames = [TransactionFrame.make_from_wire(self.network_id, env)
+                      for env in tx_set.txs]
+            mgr.close_ledger(frames, entry.header.scpValue.closeTime,
+                             tx_set=tx_set,
+                             expected_ledger_hash=entry.hash)
+
+    # -- minimal (assume state from buckets, no replay) ---------------------
+    def catchup_minimal(self, archive: FileHistoryArchive) -> LedgerManager:
+        """Assume the checkpoint state snapshot from bucket files
+        (reference: ApplyBucketsWork + BucketApplicator), verifying every
+        bucket hash and the reassembled bucket-list hash against the header."""
+        has = archive.get_state()
+        if has is None:
+            raise CatchupError("archive has no HAS")
+        checkpoint = has.current_ledger
+        headers = self._read_headers(archive, checkpoint)
+        verify_ledger_chain(headers)
+        tail = headers[-1]
+        if tail.header.ledgerSeq != checkpoint:
+            raise CatchupError("checkpoint tail mismatch")
+
+        mgr = LedgerManager(self.network_id)
+        mgr.start_new_ledger()  # scaffolding; replaced below
+
+        hashes = has.bucket_hashes()
+        if len(hashes) != NUM_LEVELS * 2:
+            raise CatchupError("HAS bucket list malformed")
+        empty = "0" * 64
+        from ..ledger.ledger_txn import LedgerTxnRoot
+        root = LedgerTxnRoot(tail.header)
+        seen: set = set()
+        for i in range(NUM_LEVELS):
+            for j, attr in ((0, "curr"), (1, "snap")):
+                hh = hashes[i * 2 + j]
+                if hh == empty:
+                    bucket = Bucket.empty()
+                else:
+                    b = archive.get_bucket(hh)
+                    if b is None:
+                        raise CatchupError(f"missing bucket {hh}")
+                    bucket = b
+                setattr(mgr.bucket_list.levels[i], attr, bucket)
+                # newest-first state assumption: first record wins per key
+                for be in bucket.entries:
+                    if be.switch == X.BucketEntryType.DEADENTRY:
+                        kb = be.value.to_xdr()
+                        if kb not in seen:
+                            seen.add(kb)
+                    else:
+                        kb = X.ledger_entry_key(be.value).to_xdr()
+                        if kb not in seen:
+                            seen.add(kb)
+                            root._apply_delta({kb: be.value}, None)
+        if mgr.bucket_list.hash() != tail.header.bucketListHash:
+            raise CatchupError("assumed bucket list hash != header hash")
+        mgr.root = root
+        mgr.lcl_header = tail.header
+        mgr.lcl_hash = tail.hash
+        log.info("assumed state at ledger %d (%d entries)",
+                 checkpoint, root.entry_count())
+        return mgr

@@ -1,0 +1,206 @@
+"""History archives: the checkpoint file store.
+
+Reference: src/history/HistoryArchive.{h,cpp} (HistoryArchiveState — the
+`.well-known/stellar-history.json` HAS document), FileTransferInfo.h (path
+scheme `category/ww/xx/yy/category-<hex8>.xdr.gz`), and the XDR file stream
+record framing from xdrpp (util/XDRStream.h — XDRInputFileStream): each
+record is a 4-byte big-endian header whose MSB marks the final fragment and
+low 31 bits carry the length, followed by the XDR body.
+
+Archives are dumb file stores; the reference drives them with configured
+get/put shell commands (cp/curl).  Here an archive is a directory with the
+same layout, and the command indirection arrives with ProcessManager.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import struct
+from typing import Iterator, List, Optional
+
+from .. import xdr as X
+from ..bucket.bucket import Bucket
+
+CHECKPOINT_FREQUENCY = 64
+HAS_CURRENT_VERSION = 1
+
+CATEGORY_LEDGER = "ledger"
+CATEGORY_TRANSACTIONS = "transactions"
+CATEGORY_RESULTS = "results"
+CATEGORY_SCP = "scp"
+CATEGORY_BUCKET = "bucket"
+
+
+def is_checkpoint_boundary(ledger_seq: int) -> bool:
+    """Checkpoints close at seq ≡ 63 (mod 64) (reference:
+    HistoryManager::isLastLedgerInCheckpoint; first checkpoint is 1..63)."""
+    return (ledger_seq + 1) % CHECKPOINT_FREQUENCY == 0
+
+
+def checkpoint_containing(ledger_seq: int) -> int:
+    """The checkpoint ledger (its last seq) that contains ledger_seq."""
+    return ((ledger_seq // CHECKPOINT_FREQUENCY) + 1) * CHECKPOINT_FREQUENCY - 1
+
+
+def first_ledger_in_checkpoint(checkpoint: int) -> int:
+    return max(1, checkpoint + 1 - CHECKPOINT_FREQUENCY)
+
+
+# -- XDR record-mark stream framing (xdrpp compatible) ----------------------
+
+def pack_xdr_stream(records: List[bytes]) -> bytes:
+    out = bytearray()
+    for rec in records:
+        out += struct.pack(">I", len(rec) | 0x80000000)
+        out += rec
+    return bytes(out)
+
+
+def unpack_xdr_stream(data: bytes) -> Iterator[bytes]:
+    off = 0
+    while off < len(data):
+        if off + 4 > len(data):
+            raise ValueError("truncated record mark")
+        (mark,) = struct.unpack_from(">I", data, off)
+        length = mark & 0x7FFFFFFF
+        off += 4
+        if off + length > len(data):
+            raise ValueError("truncated record body")
+        yield data[off:off + length]
+        off += length
+
+
+# -- path scheme ------------------------------------------------------------
+
+def _hex8(n: int) -> str:
+    return f"{n:08x}"
+
+
+def category_path(category: str, checkpoint: int, suffix: str = ".xdr.gz") -> str:
+    h = _hex8(checkpoint)
+    return f"{category}/{h[0:2]}/{h[2:4]}/{h[4:6]}/{category}-{h}{suffix}"
+
+
+def bucket_path(hash_hex: str) -> str:
+    return (f"bucket/{hash_hex[0:2]}/{hash_hex[2:4]}/{hash_hex[4:6]}/"
+            f"bucket-{hash_hex}.xdr.gz")
+
+
+# -- HistoryArchiveState ----------------------------------------------------
+
+class HistoryArchiveState:
+    """The HAS JSON: current ledger + the bucket hash list per level."""
+
+    def __init__(self, current_ledger: int, network_passphrase: str,
+                 level_hashes: List[dict], server: str = "stellar-core-tpu"):
+        self.version = HAS_CURRENT_VERSION
+        self.server = server
+        self.current_ledger = current_ledger
+        self.network_passphrase = network_passphrase
+        self.level_hashes = level_hashes  # [{"curr": hex, "snap": hex}, ...]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "server": self.server,
+            "currentLedger": self.current_ledger,
+            "networkPassphrase": self.network_passphrase,
+            "currentBuckets": [
+                {"curr": lh["curr"], "snap": lh["snap"],
+                 "next": {"state": 0}}
+                for lh in self.level_hashes],
+        }, indent=2)
+
+    @staticmethod
+    def from_json(text: str) -> "HistoryArchiveState":
+        d = json.loads(text)
+        return HistoryArchiveState(
+            current_ledger=d["currentLedger"],
+            network_passphrase=d.get("networkPassphrase", ""),
+            level_hashes=[{"curr": b["curr"], "snap": b["snap"]}
+                          for b in d["currentBuckets"]],
+            server=d.get("server", ""))
+
+    def bucket_hashes(self) -> List[str]:
+        out = []
+        for lh in self.level_hashes:
+            out.append(lh["curr"])
+            out.append(lh["snap"])
+        return out
+
+
+# -- file-backed archive ----------------------------------------------------
+
+class FileHistoryArchive:
+    """Local directory archive (the TmpDirHistoryConfigurator analog used by
+    every reference history test — SURVEY.md §4 fixtures)."""
+
+    WELL_KNOWN = ".well-known/stellar-history.json"
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _full(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def put_bytes(self, rel: str, data: bytes) -> None:
+        path = self._full(rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_bytes(self, rel: str) -> Optional[bytes]:
+        try:
+            with open(self._full(rel), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self._full(rel))
+
+    # gzip'd XDR streams
+    def put_xdr_file(self, rel: str, records: List[bytes]) -> None:
+        self.put_bytes(rel, gzip.compress(pack_xdr_stream(records)))
+
+    def get_xdr_file(self, rel: str) -> Optional[List[bytes]]:
+        raw = self.get_bytes(rel)
+        if raw is None:
+            return None
+        return list(unpack_xdr_stream(gzip.decompress(raw)))
+
+    # HAS
+    def put_state(self, has: HistoryArchiveState) -> None:
+        data = has.to_json().encode()
+        self.put_bytes(self.WELL_KNOWN, data)
+        self.put_bytes(category_path("history", has.current_ledger,
+                                     suffix=".json"), data)
+
+    def get_state(self, checkpoint: Optional[int] = None
+                  ) -> Optional[HistoryArchiveState]:
+        if checkpoint is None:
+            raw = self.get_bytes(self.WELL_KNOWN)
+        else:
+            raw = self.get_bytes(category_path("history", checkpoint,
+                                               suffix=".json"))
+        return HistoryArchiveState.from_json(raw.decode()) if raw else None
+
+    # buckets
+    def put_bucket(self, bucket: Bucket) -> None:
+        if bucket.is_empty():
+            return
+        self.put_bytes(bucket_path(bucket.hash().hex()),
+                       gzip.compress(bucket.serialize()))
+
+    def get_bucket(self, hash_hex: str) -> Optional[Bucket]:
+        raw = self.get_bytes(bucket_path(hash_hex))
+        if raw is None:
+            return None
+        b = Bucket.deserialize(gzip.decompress(raw))
+        if b.hash().hex() != hash_hex:
+            raise ValueError(f"bucket hash mismatch for {hash_hex}")
+        return b

@@ -1,0 +1,78 @@
+"""HistoryManager: checkpoint building + publishing.
+
+Reference: src/history/HistoryManagerImpl.{h,cpp} (queueCurrentHistory /
+publishQueuedHistory), src/history/CheckpointBuilder.* (incremental append of
+ledger headers / tx sets / results as ledgers close), src/history/
+StateSnapshot.* (what gets written per checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import xdr as X
+from ..ledger.manager import ClosedLedgerArtifacts, LedgerManager
+from ..util import logging as slog
+from .archive import (CATEGORY_LEDGER, CATEGORY_RESULTS, CATEGORY_TRANSACTIONS,
+                      CHECKPOINT_FREQUENCY, FileHistoryArchive,
+                      HistoryArchiveState, category_path,
+                      is_checkpoint_boundary)
+
+log = slog.get("History")
+
+_LHHE = X.LedgerHeaderHistoryEntry._xdr_adapter()
+_THE = X.TransactionHistoryEntry._xdr_adapter()
+_THRE = X.TransactionHistoryResultEntry._xdr_adapter()
+
+
+class HistoryManager:
+    """Accumulates per-ledger artifacts and publishes checkpoints to the
+    configured archives as boundaries are crossed."""
+
+    def __init__(self, ledger_mgr: LedgerManager, network_passphrase: str,
+                 archives: Optional[List[FileHistoryArchive]] = None):
+        self.ledger_mgr = ledger_mgr
+        self.network_passphrase = network_passphrase
+        self.archives = archives or []
+        self._pending: List[ClosedLedgerArtifacts] = []
+        self.published_checkpoints: List[int] = []
+
+    def ledger_closed(self, arts: ClosedLedgerArtifacts) -> None:
+        """Call after every close (reference: CheckpointBuilder::appendLedger
+        + HistoryManager::maybeQueueHistoryCheckpoint)."""
+        self._pending.append(arts)
+        seq = arts.header_entry.header.ledgerSeq
+        if is_checkpoint_boundary(seq):
+            self.publish_checkpoint(seq)
+
+    def publish_checkpoint(self, checkpoint_seq: int) -> None:
+        """Write ledger/transactions/results streams, bucket files and the
+        HAS for this checkpoint to every archive."""
+        headers = [a.header_entry for a in self._pending]
+        txs = [a.tx_entry for a in self._pending
+               if a.tx_entry.txSet.txs]
+        results = [a.result_entry for a in self._pending
+                   if a.result_entry.txResultSet.results]
+        level_hashes = [
+            {"curr": lvl.curr.hash().hex(), "snap": lvl.snap.hash().hex()}
+            for lvl in self.ledger_mgr.bucket_list.levels]
+        has = HistoryArchiveState(checkpoint_seq, self.network_passphrase,
+                                  level_hashes)
+        for archive in self.archives:
+            archive.put_xdr_file(
+                category_path(CATEGORY_LEDGER, checkpoint_seq),
+                [_LHHE.pack(h) for h in headers])
+            archive.put_xdr_file(
+                category_path(CATEGORY_TRANSACTIONS, checkpoint_seq),
+                [_THE.pack(t) for t in txs])
+            archive.put_xdr_file(
+                category_path(CATEGORY_RESULTS, checkpoint_seq),
+                [_THRE.pack(r) for r in results])
+            for bucket in self.ledger_mgr.bucket_list.buckets():
+                if not bucket.is_empty():
+                    archive.put_bucket(bucket)
+            archive.put_state(has)
+        self.published_checkpoints.append(checkpoint_seq)
+        self._pending.clear()
+        log.info("published checkpoint %d (%d headers, %d tx entries)",
+                 checkpoint_seq, len(headers), len(txs))

@@ -148,6 +148,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
         return LedgerEntry.from_xdr(e.to_xdr()) if e is not None else None
 
     def exists(self, key: LedgerKey) -> bool:
+        self._assert_open_no_child()
         return self.get_entry(key.to_xdr()) is not None
 
     def create(self, entry: LedgerEntry) -> None:
@@ -200,11 +201,14 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._finish()
 
     def rollback(self) -> None:
+        if not self._open:
+            return  # idempotent; must NOT detach a sibling's registration
         if self._child is not None:
             self._child.rollback()
         self._finish()
 
     def _finish(self) -> None:
+        assert self._open, "LedgerTxn finished twice"
         self._open = False
         self._parent._detach_child()
         self._delta = {}

@@ -1,0 +1,202 @@
+"""LedgerManager: genesis, ledger close, header hash chain.
+
+Reference: src/ledger/LedgerManagerImpl.{h,cpp} — startNewLedger,
+valueExternalized/applyLedger (SURVEY.md §3.2 call stack), advanceLedgerPointers,
+plus TxSetFrame hashing (src/herder/TxSetFrame.cpp — computeTxSetHash,
+sortTxsInHashOrder).
+
+Close pipeline per ledger (same phases as the reference):
+  1. canonicalize tx set (txs sorted by content hash), hash it
+  2. process fees + consume seq nums for every tx
+  3. apply each tx (all-or-nothing per tx) collecting results
+  4. txSetResultHash, bucket-list batch (INIT/LIVE/DEAD from the LedgerTxn
+     delta), header finalize, hash = SHA256(header XDR) chains previous.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import xdr as X
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..bucket.bucket_list import BucketList
+from ..transactions.frame import TransactionFrame
+from ..util import logging as slog
+from .ledger_txn import LedgerTxn, LedgerTxnRoot
+
+log = slog.get("Ledger")
+
+GENESIS_LEDGER_SEQ = 1
+TOTAL_COINS = 100_000_000_000 * 10_000_000  # 100B XLM in stroops
+GENESIS_BASE_FEE = 100
+GENESIS_BASE_RESERVE = 100_000_000
+GENESIS_MAX_TX_SET_SIZE = 100
+CURRENT_LEDGER_PROTOCOL_VERSION = 23
+
+SKIP_INTERVALS = (50, 5000, 50000, 500000)
+
+
+@dataclass
+class ClosedLedgerArtifacts:
+    """What history publishing needs from each close (reference: the data
+    CheckpointBuilder appends — SURVEY.md §2.1 History publish)."""
+    header_entry: X.LedgerHeaderHistoryEntry
+    tx_entry: X.TransactionHistoryEntry
+    result_entry: X.TransactionHistoryResultEntry
+
+
+class LedgerManager:
+    def __init__(self, network_id: bytes):
+        self.network_id = network_id
+        self.bucket_list = BucketList()
+        self.root: Optional[LedgerTxnRoot] = None
+        self.lcl_header: Optional[X.LedgerHeader] = None
+        self.lcl_hash: Optional[bytes] = None
+
+    # -- genesis ------------------------------------------------------------
+    def start_new_ledger(self,
+                         protocol_version: int = CURRENT_LEDGER_PROTOCOL_VERSION
+                         ) -> None:
+        """Create ledger 1 with the network root account (reference:
+        LedgerManagerImpl::startNewLedger — root seed is the network id)."""
+        root_key = SecretKey(self.network_id)
+        root_acc = X.AccountEntry(
+            accountID=X.AccountID.ed25519(root_key.public_key.ed25519),
+            balance=TOTAL_COINS,
+            seqNum=GENESIS_LEDGER_SEQ << 32)
+        root_entry = X.LedgerEntry(
+            lastModifiedLedgerSeq=GENESIS_LEDGER_SEQ,
+            data=X.LedgerEntryData.account(root_acc))
+
+        self.bucket_list.add_batch(GENESIS_LEDGER_SEQ, protocol_version,
+                                   [root_entry], [], [])
+        header = X.LedgerHeader(
+            ledgerVersion=protocol_version,
+            previousLedgerHash=b"\x00" * 32,
+            scpValue=X.StellarValue(txSetHash=b"\x00" * 32, closeTime=0),
+            txSetResultHash=sha256(X.TransactionResultSet(results=[]).to_xdr()),
+            bucketListHash=self.bucket_list.hash(),
+            ledgerSeq=GENESIS_LEDGER_SEQ,
+            totalCoins=TOTAL_COINS, feePool=0, inflationSeq=0, idPool=0,
+            baseFee=GENESIS_BASE_FEE, baseReserve=GENESIS_BASE_RESERVE,
+            maxTxSetSize=GENESIS_MAX_TX_SET_SIZE,
+            skipList=[b"\x00" * 32] * 4)
+        self.root = LedgerTxnRoot(header)
+        with LedgerTxn(self.root) as ltx:
+            ltx.create(root_entry)
+            ltx.commit()
+        self.lcl_header = header
+        self.lcl_hash = sha256(header.to_xdr())
+        log.info("genesis ledger 1 closed, root=%s",
+                 root_key.public_key.to_strkey())
+
+    def root_account_secret(self) -> SecretKey:
+        return SecretKey(self.network_id)
+
+    # -- tx set canonicalization -------------------------------------------
+    def make_tx_set(self, frames: Sequence[TransactionFrame]
+                    ) -> Tuple[X.TransactionSet, bytes, List[TransactionFrame]]:
+        """Sort txs into hash order, build the XDR set and its hash
+        (reference: TxSetUtils::sortTxsInHashOrder + computeTxSetHash)."""
+        ordered = sorted(frames, key=lambda f: f.content_hash())
+        tx_set = X.TransactionSet(
+            previousLedgerHash=self.lcl_hash,
+            txs=[f.envelope for f in ordered])
+        return tx_set, sha256(tx_set.to_xdr()), ordered
+
+    # -- close --------------------------------------------------------------
+    def close_ledger(self, frames: Sequence[TransactionFrame],
+                     close_time: int,
+                     tx_set: Optional[X.TransactionSet] = None,
+                     expected_ledger_hash: Optional[bytes] = None
+                     ) -> ClosedLedgerArtifacts:
+        """Apply one ledger.  `frames` may arrive unsorted; the canonical
+        order is derived.  If expected_ledger_hash is given (catchup replay),
+        a mismatch raises — fail-stop, like the reference's hash checks."""
+        assert self.root is not None, "start_new_ledger/load first"
+        if tx_set is None:
+            tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
+        else:
+            ordered = sorted(frames, key=lambda f: f.content_hash())
+            tx_set_hash = sha256(tx_set.to_xdr())
+
+        seq = self.lcl_header.ledgerSeq + 1
+        ltx = LedgerTxn(self.root)
+        header = ltx.load_header()
+        header.ledgerSeq = seq
+        header.previousLedgerHash = self.lcl_hash
+        header.scpValue = X.StellarValue(txSetHash=tx_set_hash,
+                                         closeTime=close_time)
+        ltx.commit_header(header)
+
+        # phase 1: fees + seq nums for every tx, before any applies
+        for f in ordered:
+            with LedgerTxn(ltx) as fee_ltx:
+                f.process_fee_seq_num(fee_ltx)
+                fee_ltx.commit()
+
+        # phase 2: apply
+        result_pairs: List[X.TransactionResultPair] = []
+        for f in ordered:
+            res = f.apply(ltx, close_time)
+            result_pairs.append(X.TransactionResultPair(
+                transactionHash=f.content_hash(), result=res))
+
+        result_set = X.TransactionResultSet(results=result_pairs)
+        header = ltx.load_header()
+        header.txSetResultHash = sha256(result_set.to_xdr())
+        ltx.commit_header(header)
+
+        # split delta into INIT/LIVE/DEAD vs the pre-close state
+        delta = ltx.delta()
+        init_entries, live_entries, dead_keys = [], [], []
+        for kb, entry in delta.items():
+            pre = self.root.get_entry(kb)
+            if entry is None:
+                if pre is not None:
+                    dead_keys.append(X.LedgerKey.from_xdr(kb))
+            elif pre is None:
+                init_entries.append(entry)
+            else:
+                live_entries.append(entry)
+        self.bucket_list.add_batch(seq, header.ledgerVersion,
+                                   init_entries, live_entries, dead_keys)
+        header = ltx.load_header()
+        header.bucketListHash = self.bucket_list.hash()
+        self._update_skip_list(header)
+        ltx.commit_header(header)
+        ltx.commit()
+
+        self.lcl_header = self.root.get_header()
+        self.lcl_hash = sha256(self.lcl_header.to_xdr())
+        if expected_ledger_hash is not None \
+                and self.lcl_hash != expected_ledger_hash:
+            raise RuntimeError(
+                f"ledger {seq} hash mismatch: got {self.lcl_hash.hex()} "
+                f"expected {expected_ledger_hash.hex()}")
+
+        header_entry = X.LedgerHeaderHistoryEntry(
+            hash=self.lcl_hash, header=self.lcl_header)
+        tx_entry = X.TransactionHistoryEntry(ledgerSeq=seq, txSet=tx_set)
+        result_entry = X.TransactionHistoryResultEntry(
+            ledgerSeq=seq, txResultSet=result_set)
+        return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)
+
+    def _update_skip_list(self, header: X.LedgerHeader) -> None:
+        """Rotate the 4 skip hashes at their intervals (reference:
+        LedgerHeaderUtils / updateSkipList in LedgerManagerImpl)."""
+        sl = list(header.skipList)
+        for i, interval in enumerate(SKIP_INTERVALS):
+            if header.ledgerSeq % interval == 0:
+                sl[i] = header.previousLedgerHash
+        header.skipList = sl
+
+    # -- accessors -----------------------------------------------------------
+    @property
+    def last_closed_ledger_seq(self) -> int:
+        return self.lcl_header.ledgerSeq
+
+    def make_frame(self, envelope: X.TransactionEnvelope) -> TransactionFrame:
+        return TransactionFrame.make_from_wire(self.network_id, envelope)

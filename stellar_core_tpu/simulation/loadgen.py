@@ -1,0 +1,79 @@
+"""LoadGenerator: synthetic traffic for tests and benchmarks.
+
+Reference: src/simulation/LoadGenerator.{h,cpp} — modes: create accounts /
+pay (we add per-ledger batching identical in spirit to generateLoad's
+txrate pacing, minus the timer loop: callers drive ledgers explicitly).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from .. import xdr as X
+from ..crypto.keys import SecretKey
+from ..history.manager import HistoryManager
+from ..ledger.manager import LedgerManager
+from ..testutils import TestAccount, create_account_op, native_payment_op
+
+
+class LoadGenerator:
+    def __init__(self, mgr: LedgerManager,
+                 history: Optional[HistoryManager] = None, seed: int = 1):
+        self.mgr = mgr
+        self.history = history
+        self.rng = random.Random(seed)
+        root_sk = mgr.root_account_secret()
+        root_entry = mgr.root.get_entry(
+            X.LedgerKey.account(X.LedgerKeyAccount(
+                accountID=X.AccountID.ed25519(
+                    root_sk.public_key.ed25519))).to_xdr())
+        self.root = TestAccount(mgr, root_sk, root_entry.data.value.seqNum)
+        self.accounts: List[TestAccount] = []
+        self._close_time = 1_600_000_000
+
+    def _close(self, frames) -> None:
+        self._close_time += 5
+        arts = self.mgr.close_ledger(frames, self._close_time)
+        if self.history is not None:
+            self.history.ledger_closed(arts)
+
+    def close_empty_ledger(self) -> None:
+        self._close([])
+
+    def create_accounts(self, n: int, per_ledger: int = 50,
+                        balance: int = 10_000_000_000) -> None:
+        created = 0
+        while created < n:
+            batch = min(per_ledger, n - created)
+            ops = []
+            new_accounts = []
+            for _ in range(batch):
+                sk = SecretKey.pseudo_random_for_testing(self.rng)
+                ops.append(create_account_op(
+                    X.AccountID.ed25519(sk.public_key.ed25519), balance))
+                new_accounts.append(sk)
+            tx = self.root.tx(ops)
+            self._close([tx])
+            header = self.mgr.lcl_header
+            for sk in new_accounts:
+                self.accounts.append(TestAccount(
+                    self.mgr, sk, (header.ledgerSeq) << 32))
+            created += batch
+
+    def payment_ledgers(self, n_ledgers: int, txs_per_ledger: int = 20) -> None:
+        assert len(self.accounts) >= 2, "create accounts first"
+        for _ in range(n_ledgers):
+            frames = []
+            for _ in range(txs_per_ledger):
+                src, dst = self.rng.sample(self.accounts, 2)
+                amount = self.rng.randrange(1, 1_000_000)
+                frames.append(src.tx([native_payment_op(dst.account_id,
+                                                        amount)]))
+            self._close(frames)
+
+    def run_to_checkpoint_boundary(self) -> None:
+        """Close empty ledgers until a checkpoint publishes (seq ≡ 63 mod 64)."""
+        from ..history.archive import is_checkpoint_boundary
+        while not is_checkpoint_boundary(self.mgr.last_closed_ledger_seq):
+            self.close_empty_ledger()

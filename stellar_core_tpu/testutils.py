@@ -1,0 +1,91 @@
+"""Test/loadgen helpers: tx builders and TestAccount.
+
+Reference: src/test/TxTests.{h,cpp} and src/test/TestAccount.{h,cpp} —
+the fixtures every reference test suite builds on (SURVEY.md §4).
+Lives in the package (not tests/) because LoadGenerator and Simulation
+reuse it, mirroring the reference layout.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import xdr as X
+from .crypto.keys import SecretKey
+from .crypto.sha import sha256
+from .transactions.frame import TransactionFrame
+
+
+def native_payment_op(dest: X.AccountID, amount: int,
+                      source: Optional[X.AccountID] = None) -> X.Operation:
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.paymentOp(X.PaymentOp(
+            destination=X.muxed_from_account_id(dest),
+            asset=X.Asset.native(), amount=amount)))
+
+
+def create_account_op(dest: X.AccountID, starting_balance: int,
+                      source: Optional[X.AccountID] = None) -> X.Operation:
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.createAccountOp(X.CreateAccountOp(
+            destination=dest, startingBalance=starting_balance)))
+
+
+def build_tx(network_id: bytes, source: SecretKey, seq_num: int,
+             ops: Sequence[X.Operation], fee: Optional[int] = None,
+             memo: Optional[X.Memo] = None,
+             time_bounds: Optional[X.TimeBounds] = None,
+             extra_signers: Sequence[SecretKey] = ()) -> TransactionFrame:
+    """Build + sign a v1 envelope (reference: TxTests — transactionFromOps)."""
+    tx = X.Transaction(
+        sourceAccount=X.MuxedAccount.ed25519(source.public_key.ed25519),
+        fee=fee if fee is not None else 100 * len(ops),
+        seqNum=seq_num,
+        cond=(X.Preconditions.timeBounds(time_bounds)
+              if time_bounds is not None else X.Preconditions.none()),
+        memo=memo if memo is not None else X.Memo.none(),
+        operations=list(ops))
+    env = X.TransactionEnvelope.v1(
+        X.TransactionV1Envelope(tx=tx, signatures=[]))
+    frame = TransactionFrame(network_id, env)
+    payload_hash = frame.content_hash()
+    for signer in (source, *extra_signers):
+        env.value.signatures.append(X.DecoratedSignature(
+            hint=signer.public_key.hint(),
+            signature=signer.sign(payload_hash)))
+    return frame
+
+
+class TestAccount:
+    """Sequence-tracking account handle (reference: src/test/TestAccount.h)."""
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, mgr, secret: SecretKey, seq_num: int):
+        self.mgr = mgr
+        self.secret = secret
+        self.seq_num = seq_num
+
+    @property
+    def account_id(self) -> X.AccountID:
+        return X.AccountID.ed25519(self.secret.public_key.ed25519)
+
+    def next_seq(self) -> int:
+        self.seq_num += 1
+        return self.seq_num
+
+    def tx(self, ops: Sequence[X.Operation], **kwargs) -> TransactionFrame:
+        return build_tx(self.mgr.network_id, self.secret, self.next_seq(),
+                        ops, **kwargs)
+
+
+def network_id(passphrase: str) -> bytes:
+    """networkID = SHA256(passphrase) (reference: src/main/Config.cpp)."""
+    return sha256(passphrase.encode())
+
+
+TESTNET_PASSPHRASE = "Test SDF Network ; September 2015"

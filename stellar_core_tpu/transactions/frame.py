@@ -1,0 +1,465 @@
+"""TransactionFrame: validation, fee/seq processing, and apply.
+
+Reference: src/transactions/TransactionFrame.{h,cpp},
+FeeBumpTransactionFrame.{h,cpp}, TransactionFrameBase::makeTransactionFromWire.
+Protocol level: current (23) classic semantics, single protocol path (the
+reference's for_all_versions gates are collapsed; divergences noted inline).
+
+Apply pipeline (mirrors §3.2 of SURVEY.md):
+  process_fee_seq_num()  — charge fee, consume seqNum (before any op runs)
+  apply()                — signature checks, per-op checkValid+doApply inside
+                           a nested LedgerTxn, all-or-nothing rollback
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .. import xdr as X
+from ..crypto import keys
+from ..crypto.sha import sha256
+from ..ledger.ledger_txn import LedgerTxn
+from .signature_checker import SignatureChecker
+from . import utils
+from .utils import (THRESHOLD_HIGH, THRESHOLD_LOW, THRESHOLD_MED,
+                    account_key, load_account)
+
+MAX_SEQ_NUM = 2 ** 63 - 1
+
+
+class TransactionFrame:
+    """Wraps a TransactionEnvelope (v0 normalized to v1 view)."""
+
+    def __init__(self, network_id: bytes, envelope: X.TransactionEnvelope):
+        if envelope.switch == X.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+            raise ValueError("use FeeBumpTransactionFrame")
+        self.network_id = network_id
+        self.envelope = envelope
+        self._hash: Optional[bytes] = None
+
+    # -- wire/creation ------------------------------------------------------
+    @staticmethod
+    def make_from_wire(network_id: bytes,
+                       envelope: X.TransactionEnvelope) -> "TransactionFrameBase":
+        if envelope.switch == X.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+            return FeeBumpTransactionFrame(network_id, envelope)
+        return TransactionFrame(network_id, envelope)
+
+    # -- views over v0/v1 ---------------------------------------------------
+    @property
+    def is_v0(self) -> bool:
+        return self.envelope.switch == X.EnvelopeType.ENVELOPE_TYPE_TX_V0
+
+    @property
+    def tx(self):
+        return self.envelope.value.tx
+
+    @property
+    def signatures(self) -> List[X.DecoratedSignature]:
+        return self.envelope.value.signatures
+
+    def source_account_id(self) -> X.AccountID:
+        if self.is_v0:
+            return X.AccountID.ed25519(self.tx.sourceAccountEd25519)
+        return X.muxed_to_account_id(self.tx.sourceAccount)
+
+    @property
+    def operations(self) -> List[X.Operation]:
+        return self.tx.operations
+
+    @property
+    def fee_bid(self) -> int:
+        return self.tx.fee
+
+    @property
+    def seq_num(self) -> int:
+        return self.tx.seqNum
+
+    def time_bounds(self) -> Optional[X.TimeBounds]:
+        if self.is_v0:
+            return self.tx.timeBounds
+        cond = self.tx.cond
+        if cond.switch == X.PreconditionType.PRECOND_TIME:
+            return cond.value
+        if cond.switch == X.PreconditionType.PRECOND_V2:
+            return cond.value.timeBounds
+        return None
+
+    # -- hashing ------------------------------------------------------------
+    def _v1_tx(self) -> X.Transaction:
+        """v0 envelopes hash/sign as the equivalent v1 Transaction
+        (reference: TransactionFrame::getSignaturePayload builds the TX
+        tagged union for both)."""
+        if not self.is_v0:
+            return self.tx
+        t = self.tx
+        return X.Transaction(
+            sourceAccount=X.MuxedAccount.ed25519(t.sourceAccountEd25519),
+            fee=t.fee, seqNum=t.seqNum,
+            cond=(X.Preconditions.timeBounds(t.timeBounds)
+                  if t.timeBounds is not None else X.Preconditions.none()),
+            memo=t.memo, operations=t.operations)
+
+    def signature_payload(self) -> bytes:
+        payload = X.TransactionSignaturePayload(
+            networkId=self.network_id,
+            taggedTransaction=X.TransactionSignaturePayloadTaggedTransaction.tx(self._v1_tx()))
+        return payload.to_xdr()
+
+    def content_hash(self) -> bytes:
+        """The transaction hash (ids history entries, preauth signers)."""
+        if self._hash is None:
+            self._hash = sha256(self.signature_payload())
+        return self._hash
+
+    # -- fees ---------------------------------------------------------------
+    def num_operations(self) -> int:
+        return len(self.operations)
+
+    def min_fee(self, header: X.LedgerHeader) -> int:
+        return self.num_operations() * header.baseFee
+
+    def fee_charged(self, header: X.LedgerHeader) -> int:
+        """min(bid, numOps*baseFee) — flat per-op pricing; the reference's
+        surge-priced effective base fee arrives with generalized tx sets."""
+        return min(self.fee_bid, self.min_fee(header))
+
+    # -- validation ---------------------------------------------------------
+    def _common_valid(self, ltx: LedgerTxn, close_time: int,
+                      check_seq: bool) -> Optional[X.TransactionResultCode]:
+        C = X.TransactionResultCode
+        if self.num_operations() == 0:
+            return C.txMISSING_OPERATION
+        if self.num_operations() > X.MAX_OPS_PER_TX:
+            return C.txMALFORMED
+        tb = self.time_bounds()
+        if tb is not None:
+            if tb.minTime and close_time < tb.minTime:
+                return C.txTOO_EARLY
+            if tb.maxTime and close_time > tb.maxTime:
+                return C.txTOO_LATE
+        header = ltx.get_header()
+        if self.fee_bid < self.min_fee(header):
+            return C.txINSUFFICIENT_FEE
+        if self.seq_num < 0 or self.seq_num > MAX_SEQ_NUM:
+            return C.txBAD_SEQ
+        acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
+        if acc_entry is None:
+            return C.txNO_ACCOUNT
+        acc = acc_entry.data.value
+        if check_seq and acc.seqNum + 1 != self.seq_num:
+            return C.txBAD_SEQ
+        if acc.balance < self.fee_charged(header):
+            return C.txINSUFFICIENT_BALANCE
+        return None
+
+    def check_valid(self, ltx: LedgerTxn, close_time: int) -> X.TransactionResult:
+        """Full validity check without state writes (reference:
+        TransactionFrame::checkValid — the tx-queue admission path)."""
+        code = self._common_valid(ltx, close_time, check_seq=True)
+        if code is None:
+            checker = SignatureChecker(
+                ltx.get_header().ledgerVersion, self.content_hash(),
+                self.signatures)
+            ops = self._make_op_frames()
+            op_results = []
+            ok = True
+            for op in ops:
+                res = op.check_valid(checker, ltx)
+                op_results.append(res)
+                if not _op_ok(res):
+                    ok = False
+            if ok and not self._check_extra_signers(checker):
+                code = X.TransactionResultCode.txBAD_AUTH_EXTRA
+            elif ok and not checker.check_all_signatures_used():
+                code = X.TransactionResultCode.txBAD_AUTH_EXTRA
+            elif not ok:
+                return _tx_result(self.fee_charged(ltx.get_header()),
+                                  X.TransactionResultCode.txFAILED, op_results)
+        if code is not None:
+            return _tx_result(self.fee_charged(ltx.get_header()), code)
+        return _tx_result(self.fee_charged(ltx.get_header()),
+                          X.TransactionResultCode.txSUCCESS, None)
+
+    def _check_extra_signers(self, checker: SignatureChecker) -> bool:
+        cond = None if self.is_v0 else self.tx.cond
+        if cond is not None and cond.switch == X.PreconditionType.PRECOND_V2:
+            for sk in cond.value.extraSigners:
+                if not checker.check_signature(
+                        [X.Signer(key=sk, weight=1)], 1):
+                    return False
+        return True
+
+    # -- fee & sequence processing (phase 1 of ledger close) ---------------
+    def process_fee_seq_num(self, ltx: LedgerTxn) -> int:
+        """Charge the fee into feePool and consume the sequence number.
+        Runs for every tx in the set, in set order, before any tx applies
+        (reference: LedgerManager::processFeesSeqNums).  A tx whose seqNum
+        doesn't chain gets its fee charged but the seq NOT consumed, and
+        will report txBAD_SEQ at apply (how bad-seq results appear in
+        history).  Returns fee charged."""
+        header = ltx.load_header()
+        acc_e = load_account(ltx, self.source_account_id())
+        if acc_e is None:
+            self._bad_seq = True
+            return 0
+        acc = acc_e.data.value
+        fee = min(self.fee_charged(header), max(acc.balance, 0))
+        acc.balance -= fee
+        if acc.seqNum + 1 == self.seq_num:
+            acc.seqNum = self.seq_num
+            self._bad_seq = False
+        else:
+            self._bad_seq = True
+        header.feePool += fee
+        acc_e.lastModifiedLedgerSeq = header.ledgerSeq
+        ltx.update(acc_e)
+        ltx.commit_header(header)
+        return fee
+
+    # -- apply (phase 2) ----------------------------------------------------
+    def process_signatures(self, checker: SignatureChecker,
+                           ltx: LedgerTxn) -> bool:
+        """Tx-level (low-threshold) auth of the fee source."""
+        acc_e = load_account(ltx, self.source_account_id())
+        if acc_e is None:
+            return False
+        acc = acc_e.data.value
+        return check_account_signature(checker, acc, THRESHOLD_LOW)
+
+    def apply(self, ltx: LedgerTxn, close_time: int) -> X.TransactionResult:
+        """All-or-nothing apply in a nested LedgerTxn; fee was already
+        charged and stays regardless of outcome."""
+        header = ltx.get_header()
+        checker = SignatureChecker(header.ledgerVersion, self.content_hash(),
+                                   self.signatures)
+        fee = self.fee_charged(header)
+        C = X.TransactionResultCode
+
+        if getattr(self, "_bad_seq", False):
+            return _tx_result(fee, C.txBAD_SEQ)
+        inner = LedgerTxn(ltx)
+        try:
+            code = self._common_valid(inner, close_time, check_seq=False)
+            if code is not None and code != C.txBAD_SEQ:
+                inner.rollback()
+                return _tx_result(fee, code)
+            if not self.process_signatures(checker, inner):
+                inner.rollback()
+                return _tx_result(fee, C.txBAD_AUTH)
+            op_results: List[X.OperationResult] = []
+            ok = True
+            for op in self._make_op_frames():
+                res_check = op.check_valid(checker, inner)
+                if not _op_ok(res_check):
+                    op_results.append(res_check)
+                    ok = False
+                    continue
+                res = op.do_apply(inner)
+                op_results.append(res)
+                if not _op_ok(res):
+                    ok = False
+            if ok and not self._check_extra_signers(checker):
+                inner.rollback()
+                return _tx_result(fee, C.txBAD_AUTH_EXTRA)
+            if ok and not checker.check_all_signatures_used():
+                inner.rollback()
+                return _tx_result(fee, C.txBAD_AUTH_EXTRA)
+            if not ok:
+                inner.rollback()
+                return _tx_result(fee, C.txFAILED, op_results)
+            self._remove_used_one_time_signers(inner)
+            inner.commit()
+            return _tx_result(fee, C.txSUCCESS, op_results)
+        except Exception:
+            if inner._open:
+                inner.rollback()
+            raise
+
+    def _remove_used_one_time_signers(self, ltx: LedgerTxn) -> None:
+        """Drop preauth-tx signers matching this tx's hash from every source
+        account (reference: removeOneTimeSignerFromAllSourceAccounts)."""
+        ids = {self.source_account_id().to_xdr(): self.source_account_id()}
+        for op in self.operations:
+            if op.sourceAccount is not None:
+                a = X.muxed_to_account_id(op.sourceAccount)
+                ids[a.to_xdr()] = a
+        for acc_id in ids.values():
+            acc_e = load_account(ltx, acc_id)
+            if acc_e is None:
+                continue
+            acc = acc_e.data.value
+            new_signers = [
+                s for s in acc.signers
+                if not (s.key.switch == X.SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
+                        and s.key.value == self.content_hash())]
+            if len(new_signers) != len(acc.signers):
+                removed = len(acc.signers) - len(new_signers)
+                acc.signers = new_signers
+                acc.numSubEntries -= removed
+                ltx.update(acc_e)
+
+    def _make_op_frames(self):
+        from .operations import make_op_frame
+        return [make_op_frame(self, i, op)
+                for i, op in enumerate(self.operations)]
+
+
+TransactionFrameBase = TransactionFrame  # alias; FeeBump subclasses below
+
+
+class FeeBumpTransactionFrame(TransactionFrame):
+    """Reference: src/transactions/FeeBumpTransactionFrame.{h,cpp}.
+    Outer envelope charges the fee; the inner v1 tx applies with its own
+    signatures.  Result wraps the inner result in txFEE_BUMP_INNER_*."""
+
+    def __init__(self, network_id: bytes, envelope: X.TransactionEnvelope):
+        assert envelope.switch == X.EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP
+        self.network_id = network_id
+        self.envelope = envelope
+        self._hash = None
+        self.inner = TransactionFrame(
+            network_id,
+            X.TransactionEnvelope.v1(envelope.value.tx.innerTx.value))
+
+    @property
+    def tx(self):
+        return self.envelope.value.tx  # FeeBumpTransaction
+
+    @property
+    def signatures(self):
+        return self.envelope.value.signatures
+
+    def source_account_id(self) -> X.AccountID:
+        return X.muxed_to_account_id(self.tx.feeSource)
+
+    @property
+    def operations(self):
+        return self.inner.operations
+
+    @property
+    def fee_bid(self) -> int:
+        return self.tx.fee
+
+    @property
+    def seq_num(self) -> int:
+        return self.inner.seq_num
+
+    def time_bounds(self):
+        return self.inner.time_bounds()
+
+    def signature_payload(self) -> bytes:
+        payload = X.TransactionSignaturePayload(
+            networkId=self.network_id,
+            taggedTransaction=X.TransactionSignaturePayloadTaggedTransaction.feeBump(self.tx))
+        return payload.to_xdr()
+
+    def num_operations(self) -> int:
+        return self.inner.num_operations() + 1
+
+    def process_fee_seq_num(self, ltx: LedgerTxn) -> int:
+        """Fee from the fee source; seqNum consumed on the INNER source."""
+        header = ltx.load_header()
+        fee_acc_e = load_account(ltx, self.source_account_id())
+        if fee_acc_e is None:
+            return 0
+        fee_acc = fee_acc_e.data.value
+        fee = min(self.fee_charged(header), max(fee_acc.balance, 0))
+        fee_acc.balance -= fee
+        header.feePool += fee
+        fee_acc_e.lastModifiedLedgerSeq = header.ledgerSeq
+        ltx.update(fee_acc_e)
+        inner_acc_e = load_account(ltx, self.inner.source_account_id())
+        if inner_acc_e is not None:
+            inner_acc_e.data.value.seqNum = self.inner.seq_num
+            inner_acc_e.lastModifiedLedgerSeq = header.ledgerSeq
+            ltx.update(inner_acc_e)
+        ltx.commit_header(header)
+        return fee
+
+    def check_valid(self, ltx: LedgerTxn, close_time: int) -> X.TransactionResult:
+        C = X.TransactionResultCode
+        header = ltx.get_header()
+        fee = self.fee_charged(header)
+        if self.fee_bid < self.min_fee(header):
+            return _tx_result(fee, C.txINSUFFICIENT_FEE)
+        acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
+        if acc_entry is None:
+            return _tx_result(fee, C.txNO_ACCOUNT)
+        checker = SignatureChecker(header.ledgerVersion, self.content_hash(),
+                                   self.signatures)
+        if not check_account_signature(
+                checker, acc_entry.data.value, THRESHOLD_LOW) \
+                or not checker.check_all_signatures_used():
+            return _tx_result(fee, C.txBAD_AUTH)
+        inner_res = self.inner.check_valid(ltx, close_time)
+        code = (C.txFEE_BUMP_INNER_SUCCESS
+                if inner_res.result.switch == C.txSUCCESS
+                else C.txFEE_BUMP_INNER_FAILED)
+        return _fee_bump_result(fee, code, self.inner.content_hash(), inner_res)
+
+    def apply(self, ltx: LedgerTxn, close_time: int) -> X.TransactionResult:
+        C = X.TransactionResultCode
+        header = ltx.get_header()
+        fee = self.fee_charged(header)
+        checker = SignatureChecker(header.ledgerVersion, self.content_hash(),
+                                   self.signatures)
+        acc_e = load_account(ltx, self.source_account_id())
+        if acc_e is None or not check_account_signature(
+                checker, acc_e.data.value, THRESHOLD_LOW) \
+                or not checker.check_all_signatures_used():
+            return _fee_bump_result(
+                fee, C.txFEE_BUMP_INNER_FAILED, self.inner.content_hash(),
+                _tx_result(0, C.txBAD_AUTH))
+        inner_res = self.inner.apply(ltx, close_time)
+        code = (C.txFEE_BUMP_INNER_SUCCESS
+                if inner_res.result.switch == C.txSUCCESS
+                else C.txFEE_BUMP_INNER_FAILED)
+        return _fee_bump_result(fee, code, self.inner.content_hash(), inner_res)
+
+
+# -- helpers ---------------------------------------------------------------
+
+def check_account_signature(checker: SignatureChecker, acc: X.AccountEntry,
+                            threshold_level: int) -> bool:
+    """Master key + signers against the account's threshold at `level`."""
+    needed = utils.threshold_level_value(acc, threshold_level)
+    signers = list(acc.signers)
+    master_weight = utils.threshold_level_value(acc, utils.THRESHOLD_MASTER_WEIGHT)
+    if master_weight > 0:
+        signers.append(X.Signer(
+            key=X.SignerKey.ed25519(acc.accountID.value), weight=master_weight))
+    return checker.check_signature(signers, needed)
+
+
+def _op_ok(res: X.OperationResult) -> bool:
+    if res.switch != X.OperationResultCode.opINNER:
+        return False
+    return res.value.value.switch == 0  # per-op SUCCESS code is always 0
+
+
+def _tx_result(fee: int, code: X.TransactionResultCode,
+               op_results: Optional[List[X.OperationResult]] = None
+               ) -> X.TransactionResult:
+    C = X.TransactionResultCode
+    if code == C.txSUCCESS:
+        rr = X.TransactionResultResult.results(op_results or [])
+    elif code == C.txFAILED:
+        rr = X.TransactionResultResult(C.txFAILED, op_results or [])
+    else:
+        rr = X.TransactionResultResult(code)
+    return X.TransactionResult(feeCharged=fee, result=rr)
+
+
+def _fee_bump_result(fee: int, code: X.TransactionResultCode,
+                     inner_hash: bytes,
+                     inner: X.TransactionResult) -> X.TransactionResult:
+    inner_result = X.InnerTransactionResult(
+        feeCharged=inner.feeCharged,
+        result=X.InnerTransactionResultResult(
+            inner.result.switch, inner.result.value))
+    pair = X.InnerTransactionResultPair(
+        transactionHash=inner_hash, result=inner_result)
+    return X.TransactionResult(
+        feeCharged=fee,
+        result=X.TransactionResultResult(code, pair))

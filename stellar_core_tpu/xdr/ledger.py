@@ -158,11 +158,14 @@ TransactionHistoryResultEntry = xdr_struct("TransactionHistoryResultEntry", [
     ("ext", _THREExt),
 ], defaults={"ext": lambda: _THREExt.v0()})
 
+LedgerHeaderHistoryEntryExt = xdr_union("LedgerHeaderHistoryEntryExt", Int32,
+                                        {0: ("v0", None)})
+
 LedgerHeaderHistoryEntry = xdr_struct("LedgerHeaderHistoryEntry", [
     ("hash", Hash),
     ("header", LedgerHeader),
-    ("ext", xdr_union("LedgerHeaderHistoryEntryExt", Int32, {0: ("v0", None)})),
-])
+    ("ext", LedgerHeaderHistoryEntryExt),
+], defaults={"ext": lambda: LedgerHeaderHistoryEntryExt.v0()})
 
 # --- SCP history ---
 

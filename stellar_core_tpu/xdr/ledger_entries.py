@@ -239,21 +239,26 @@ ClaimableBalanceFlags = xdr_enum("ClaimableBalanceFlags", {
     "CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG": 1,
 })
 
+ClaimableBalanceEntryExtensionV1Ext = xdr_union(
+    "ClaimableBalanceEntryExtensionV1Ext", Int32, {0: ("v0", None)})
+
 ClaimableBalanceEntryExtensionV1 = xdr_struct("ClaimableBalanceEntryExtensionV1", [
-    ("ext", xdr_union("ClaimableBalanceEntryExtensionV1Ext", Int32, {0: ("v0", None)})),
+    ("ext", ClaimableBalanceEntryExtensionV1Ext),
     ("flags", Uint32),
-])
+], defaults={"ext": lambda: ClaimableBalanceEntryExtensionV1Ext.v0()})
+
+ClaimableBalanceEntryExt = xdr_union("ClaimableBalanceEntryExt", Int32, {
+    0: ("v0", None),
+    1: ("v1", ClaimableBalanceEntryExtensionV1),
+})
 
 ClaimableBalanceEntry = xdr_struct("ClaimableBalanceEntry", [
     ("balanceID", ClaimableBalanceID),
     ("claimants", VarArray(Claimant, 10)),
     ("asset", Asset),
     ("amount", Int64),
-    ("ext", xdr_union("ClaimableBalanceEntryExt", Int32, {
-        0: ("v0", None),
-        1: ("v1", ClaimableBalanceEntryExtensionV1),
-    })),
-])
+    ("ext", ClaimableBalanceEntryExt),
+], defaults={"ext": lambda: ClaimableBalanceEntryExt.v0()})
 
 LiquidityPoolType = xdr_enum("LiquidityPoolType", {
     "LIQUIDITY_POOL_CONSTANT_PRODUCT": 0,
@@ -421,3 +426,16 @@ def ledger_entry_key(entry: "LedgerEntry") -> "LedgerKey":
     if t == LedgerEntryType.TTL:
         return LedgerKey.ttl(_LKTtl(keyHash=d.value.keyHash))
     raise ValueError(f"no key for entry type {t}")
+
+
+# public aliases for the per-type LedgerKey structs (used by upper layers)
+LedgerKeyAccount = _LKAccount
+LedgerKeyTrustLine = _LKTrustLine
+LedgerKeyOffer = _LKOffer
+LedgerKeyData = _LKData
+LedgerKeyClaimableBalance = _LKClaimableBalance
+LedgerKeyLiquidityPool = _LKLiquidityPool
+LedgerKeyContractData = _LKContractData
+LedgerKeyContractCode = _LKContractCode
+LedgerKeyConfigSetting = _LKConfigSetting
+LedgerKeyTtl = _LKTtl

@@ -964,11 +964,14 @@ _InnerTransactionResultResult = xdr_union(
         TransactionResultCode.txFAILED: ("results_failed", VarArray(OperationResult)),
     }, default=("void", None))
 
+InnerTransactionResultExt = xdr_union("InnerTransactionResultExt", Int32,
+                                      {0: ("v0", None)})
+
 InnerTransactionResult = xdr_struct("InnerTransactionResult", [
     ("feeCharged", Int64),
     ("result", _InnerTransactionResultResult),
-    ("ext", xdr_union("InnerTransactionResultExt", Int32, {0: ("v0", None)})),
-])
+    ("ext", InnerTransactionResultExt),
+], defaults={"ext": lambda: InnerTransactionResultExt.v0()})
 
 InnerTransactionResultPair = xdr_struct("InnerTransactionResultPair", [
     ("transactionHash", Hash),
@@ -997,3 +1000,13 @@ TransactionResultPair = xdr_struct("TransactionResultPair", [
     ("transactionHash", Hash),
     ("result", TransactionResult),
 ])
+
+
+# public aliases (used by the transaction frames)
+TransactionSignaturePayloadTaggedTransaction = _TSPTaggedTx
+InnerTransactionResultResult = _InnerTransactionResultResult
+FeeBumpInnerTx = _FeeBumpInnerTx
+ManageOfferSuccessResultOffer = _ManageOfferSuccessOffer
+PathPaymentStrictReceiveResultSuccess = _PPSRSuccess
+PathPaymentStrictSendResultSuccess = _PPSSSuccess
+OperationIDId = _OperationIDId

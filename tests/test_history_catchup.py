@@ -1,0 +1,154 @@
+"""THE minimum end-to-end slice (SURVEY.md §7 stage 5): generate a synthetic
+archive with load, replay it on a fresh node, assert exact LCL-hash equality.
+Exercises XDR, crypto, ledger, tx-apply, bucket list, history, catchup.
+
+Mirrors the reference's CatchupSimulation fixture
+(src/history/test/HistoryTestsUtils) with tmp-dir file archives.
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.catchup.catchup import (CatchupError, CatchupManager,
+                                              verify_ledger_chain)
+from stellar_core_tpu.crypto import keys
+from stellar_core_tpu.history.archive import (FileHistoryArchive,
+                                              is_checkpoint_boundary,
+                                              pack_xdr_stream,
+                                              unpack_xdr_stream)
+from stellar_core_tpu.history.manager import HistoryManager
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.simulation.loadgen import LoadGenerator
+from stellar_core_tpu.testutils import network_id
+
+PASSPHRASE = "tpu-core e2e test network"
+NID = network_id(PASSPHRASE)
+
+
+@pytest.fixture(scope="module")
+def published(tmp_path_factory):
+    """One generated+published chain shared by the tests in this module."""
+    archive_dir = tmp_path_factory.mktemp("archive")
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(str(archive_dir))
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=42)
+    gen.create_accounts(30, per_ledger=10)
+    gen.payment_ledgers(25, txs_per_ledger=8)
+    gen.run_to_checkpoint_boundary()
+    assert history.published_checkpoints, "no checkpoint published"
+    return archive, mgr, history
+
+
+def test_xdr_stream_roundtrip():
+    recs = [b"abc", b"", b"x" * 1000]
+    assert list(unpack_xdr_stream(pack_xdr_stream(recs))) == recs
+    with pytest.raises(ValueError):
+        list(unpack_xdr_stream(b"\x80\x00\x00\x05ab"))  # truncated body
+
+
+def test_checkpoint_published_and_has_readable(published):
+    archive, mgr, history = published
+    has = archive.get_state()
+    assert has is not None
+    assert has.current_ledger == history.published_checkpoints[-1]
+    assert is_checkpoint_boundary(has.current_ledger)
+    assert has.network_passphrase == PASSPHRASE
+
+
+def test_catchup_complete_replay_identical_hash(published):
+    archive, mgr, _ = published
+    cm = CatchupManager(NID, PASSPHRASE)
+    replayed = cm.catchup_complete(archive)
+    assert replayed.last_closed_ledger_seq == \
+        archive.get_state().current_ledger
+    # THE invariant: bit-identical ledger hash after full replay
+    target_hash_chainpoint = mgr_lcl_at_checkpoint = None
+    assert replayed.lcl_hash is not None
+    # the source node may have advanced past the checkpoint; compare at the
+    # checkpoint ledger via the archive's own header file
+    from stellar_core_tpu.catchup.catchup import _LHHE
+    from stellar_core_tpu.history.archive import category_path
+    recs = archive.get_xdr_file(category_path(
+        "ledger", archive.get_state().current_ledger))
+    tail = _LHHE.unpack(recs[-1])
+    assert replayed.lcl_hash == tail.hash
+    assert replayed.root.entry_count() == mgr.root.entry_count()
+
+
+def test_catchup_with_accel_identical(published):
+    """TPU-accelerated replay must produce the identical chain."""
+    pytest.importorskip("jax")
+    archive, mgr, _ = published
+    keys.clear_verify_cache()
+    cm = CatchupManager(NID, PASSPHRASE, accel=True, accel_chunk=256)
+    replayed = cm.catchup_complete(archive)
+    cm2 = CatchupManager(NID, PASSPHRASE, accel=False)
+    keys.clear_verify_cache()
+    replayed_cpu = cm2.catchup_complete(archive)
+    assert replayed.lcl_hash == replayed_cpu.lcl_hash
+
+
+def test_catchup_minimal_assumes_state(published):
+    archive, mgr, _ = published
+    cm = CatchupManager(NID, PASSPHRASE)
+    node = cm.catchup_minimal(archive)
+    assert node.lcl_header.ledgerSeq == archive.get_state().current_ledger
+    # assumed state must agree with a full replay
+    replay = cm.catchup_complete(archive)
+    assert node.lcl_hash == replay.lcl_hash
+    assert node.root.entry_count() == replay.root.entry_count()
+    for kb in list(replay.root._entries.keys()):
+        assert node.root.get_entry(kb) == replay.root.get_entry(kb)
+
+
+def test_minimal_node_can_keep_closing(published):
+    """A bucket-assumed node closes subsequent ledgers identically to a
+    replayed node (state equivalence under continued operation)."""
+    archive, _, _ = published
+    cm = CatchupManager(NID, PASSPHRASE)
+    a = cm.catchup_minimal(archive)
+    b = cm.catchup_complete(archive)
+    arts_a = a.close_ledger([], 2_000_000_000)
+    arts_b = b.close_ledger([], 2_000_000_000)
+    assert a.lcl_hash == b.lcl_hash
+    assert arts_a.header_entry.hash == arts_b.header_entry.hash
+
+
+def test_tampered_archive_detected(published, tmp_path):
+    """Corrupting a tx in the archive must break the replay (hash chain or
+    tx-set hash check), mirroring the reference's fail-stop."""
+    import gzip
+    import os
+    import shutil
+    archive, _, _ = published
+    bad_dir = tmp_path / "bad_archive"
+    shutil.copytree(archive.root, bad_dir)
+    bad = FileHistoryArchive(str(bad_dir))
+    cp = bad.get_state().current_ledger
+    from stellar_core_tpu.history.archive import category_path
+    rel = category_path("transactions", cp)
+    recs = bad.get_xdr_file(rel)
+    if not recs:
+        pytest.skip("no txs in final checkpoint")
+    blob = bytearray(recs[0])
+    blob[-1] ^= 0xFF
+    recs[0] = bytes(blob)
+    bad.put_xdr_file(rel, recs)
+    cm = CatchupManager(NID, PASSPHRASE)
+    with pytest.raises(CatchupError):
+        cm.catchup_complete(bad)
+
+
+def test_verify_ledger_chain_rejects_fork(published):
+    archive, _, _ = published
+    from stellar_core_tpu.catchup.catchup import _LHHE
+    from stellar_core_tpu.history.archive import category_path
+    recs = archive.get_xdr_file(category_path(
+        "ledger", archive.get_state().current_ledger))
+    headers = [_LHHE.unpack(r) for r in recs]
+    verify_ledger_chain(headers)  # sane
+    headers[1].header.previousLedgerHash = b"\x13" * 32
+    with pytest.raises(CatchupError):
+        verify_ledger_chain(headers)
