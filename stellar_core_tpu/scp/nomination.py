@@ -1,0 +1,250 @@
+"""Nomination protocol: weighted-leader value proposal + federated voting to
+confirm nomination candidates.
+
+Reference: src/scp/NominationProtocol.{h,cpp} — processEnvelope, nominate,
+updateRoundLeaders, getNewValueFromNomination.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..xdr import scp as SX
+from .driver import NOMINATION_TIMER, ValidationLevel
+
+StType = SX.SCPStatementType
+
+
+class NominationProtocol:
+    def __init__(self, slot):
+        self.slot = slot
+        self.round_number = 0
+        self.votes: Set[bytes] = set()
+        self.accepted: Set[bytes] = set()
+        self.candidates: Set[bytes] = set()
+        self.latest_nominations: Dict[bytes, object] = {}  # node -> envelope
+        self.last_envelope = None            # last nomination we emitted
+        self.round_leaders: Set[bytes] = set()
+        self.nomination_started = False
+        self.latest_composite: Optional[bytes] = None
+        self.previous_value = b""
+
+    # --- statement access -------------------------------------------------
+    def _stmt_map(self) -> Dict[bytes, object]:
+        return {n: env.statement for n, env in self.latest_nominations.items()}
+
+    @staticmethod
+    def _nom(st):
+        return st.pledges.nominate
+
+    def _is_newer(self, st, old_st) -> bool:
+        """Old statement is subsumed if votes+accepted grew."""
+        a, b = self._nom(old_st), self._nom(st)
+        if not (set(a.votes) <= set(b.votes)):
+            return False
+        if not (set(a.accepted) <= set(b.accepted)):
+            return False
+        return (len(b.votes) + len(b.accepted)
+                > len(a.votes) + len(a.accepted))
+
+    @staticmethod
+    def _sane(st) -> bool:
+        nom = st.pledges.nominate
+        return (len(nom.votes) + len(nom.accepted)) > 0
+
+    # --- leader election --------------------------------------------------
+    def _node_priority(self, node_id: bytes) -> int:
+        d, ln = self.slot.driver, self.slot.local_node
+        w = (ln.node_weight(node_id) if node_id != ln.node_id
+             else (1 << 64) - 1)  # local node always max weight (reference)
+        if d.compute_hash_node(self.slot.slot_index, self.previous_value,
+                               False, self.round_number, node_id) < w:
+            return d.compute_hash_node(self.slot.slot_index,
+                                       self.previous_value, True,
+                                       self.round_number, node_id)
+        return 0
+
+    def update_round_leaders(self) -> None:
+        from . import quorum as Q
+        ln = self.slot.local_node
+        qset = Q.normalize_qset(ln.qset, remove=ln.node_id)
+        candidates = {ln.node_id} | Q.qset_nodes(qset)
+        top_priority, leaders = 0, set()
+        for n in candidates:
+            p = self._node_priority(n)
+            if p > top_priority:
+                top_priority, leaders = p, {n}
+            elif p == top_priority and p > 0:
+                leaders.add(n)
+        self.round_leaders |= leaders  # leaders accumulate across rounds
+
+    # --- value adoption ---------------------------------------------------
+    def _validate(self, value: bytes) -> Optional[bytes]:
+        lvl = self.slot.driver.validate_value(self.slot.slot_index, value,
+                                              nomination=True)
+        if lvl in (ValidationLevel.FULLY_VALIDATED,
+                   ValidationLevel.VOTE_TO_NOMINATE):
+            return value
+        if lvl == ValidationLevel.INVALID:
+            return None
+        return self.slot.driver.extract_valid_value(self.slot.slot_index,
+                                                    value)
+
+    def _value_from_nomination(self, nom) -> Optional[bytes]:
+        """Highest-value-hash valid value from one nomination statement.
+        Reference: NominationProtocol::getNewValueFromNomination."""
+        d = self.slot.driver
+        best, best_hash = None, -1
+        for v in list(nom.votes) + list(nom.accepted):
+            vv = self._validate(v)
+            if vv is None:
+                continue
+            h = d.compute_value_hash(self.slot.slot_index,
+                                     self.previous_value,
+                                     self.round_number, vv)
+            if h > best_hash:
+                best, best_hash = vv, h
+        return best
+
+    def _new_value_from_leaders(self) -> Optional[bytes]:
+        d = self.slot.driver
+        best, best_hash = None, -1
+        for leader in self.round_leaders:
+            env = self.latest_nominations.get(leader)
+            if env is None:
+                continue
+            v = self._value_from_nomination(self._nom(env.statement))
+            if v is None:
+                continue
+            h = d.compute_value_hash(self.slot.slot_index,
+                                     self.previous_value,
+                                     self.round_number, v)
+            if h > best_hash:
+                best, best_hash = v, h
+        return best
+
+    # --- emission ---------------------------------------------------------
+    def _emit_nomination(self) -> None:
+        st = SX.SCPStatement(
+            nodeID=self.slot.local_node_xdr_id(),
+            slotIndex=self.slot.slot_index,
+            pledges=SX.SCPStatementPledges.nominate(SX.SCPNomination(
+                quorumSetHash=self.slot.local_node.qset_hash,
+                votes=sorted(self.votes),
+                accepted=sorted(self.accepted))))
+        env = self.slot.create_envelope(st)
+        # process our own statement first (reference: emits only if valid)
+        if self.process_envelope(env, self_env=True):
+            if (self.last_envelope is None
+                    or self._is_newer(env.statement,
+                                      self.last_envelope.statement)):
+                self.last_envelope = env
+                if self.slot.fully_validated:
+                    self.slot.driver.emit_envelope(env)
+
+    # --- protocol entry points -------------------------------------------
+    def nominate(self, value: bytes, previous_value: bytes,
+                 timed_out: bool) -> bool:
+        """Called by herder (round 1) and by the round timer (timed_out)."""
+        if timed_out and not self.nomination_started:
+            return False
+        self.nomination_started = True
+        self.previous_value = previous_value
+        self.round_number += 1
+        self.update_round_leaders()
+
+        updated = False
+        if self.slot.local_node.node_id in self.round_leaders:
+            if value not in self.votes:
+                vv = self._validate(value)
+                if vv is not None:
+                    self.votes.add(vv)
+                    updated = True
+        # always also adopt this round's best value from every leader's stored
+        # nomination — votes only grow, and without this, rounds where every
+        # node is its own (accumulated) leader would stop exchanging values
+        # and nomination would livelock.
+        v = self._new_value_from_leaders()
+        if v is not None and v not in self.votes:
+            self.votes.add(v)
+            updated = True
+
+        d = self.slot.driver
+        timeout = d.compute_timeout(self.round_number, is_nomination=True)
+        d.nominating_value(self.slot.slot_index, value)
+        d.setup_timer(
+            self.slot.slot_index, NOMINATION_TIMER, timeout,
+            lambda: self.slot.nominate(value, previous_value, timed_out=True))
+        if updated:
+            self._emit_nomination()
+        return updated
+
+    def stop_nomination(self) -> None:
+        self.nomination_started = False
+        self.slot.driver.stop_timer(self.slot.slot_index, NOMINATION_TIMER)
+
+    def process_envelope(self, env, self_env: bool = False) -> bool:
+        """Returns True if the envelope was valid and processed."""
+        st = env.statement
+        nid = st.nodeID.value
+        if not self._sane(st):
+            return False
+        old = self.latest_nominations.get(nid)
+        if old is not None and not self._is_newer(st, old.statement):
+            return False
+        self.latest_nominations[nid] = env
+        if not self.nomination_started:
+            return True
+
+        stmt_map = self._stmt_map()
+        qset_of = self.slot.qset_of_statement
+        ln = self.slot.local_node
+        nom = self._nom(st)
+        modified = new_candidates = False
+
+        for v in list(nom.votes) + list(nom.accepted):
+            if v in self.accepted:
+                continue
+            if ln.federated_accept(
+                    lambda s, v=v: v in self._nom(s).votes
+                    or v in self._nom(s).accepted,
+                    lambda s, v=v: v in self._nom(s).accepted,
+                    stmt_map, qset_of):
+                vv = self._validate(v)
+                if vv is None:
+                    continue
+                self.accepted.add(v)
+                self.votes.add(v)
+                modified = True
+        for v in self.accepted - self.candidates:
+            if ln.federated_ratify(
+                    lambda s, v=v: v in self._nom(s).accepted,
+                    stmt_map, qset_of):
+                self.candidates.add(v)
+                new_candidates = True
+
+        # a round leader's nomination arrived: adopt its best value
+        # (reference: processEnvelope → getNewValueFromNomination)
+        if not self_env and nid in self.round_leaders:
+            v = self._value_from_nomination(nom)
+            if v is not None and v not in self.votes:
+                self.votes.add(v)
+                modified = True
+
+        if modified and not self_env:
+            self._emit_nomination()
+        if new_candidates:
+            composite = self.slot.driver.combine_candidates(
+                self.slot.slot_index, sorted(self.candidates))
+            if composite is not None:
+                self.latest_composite = composite
+                self.slot.driver.updated_candidate_value(
+                    self.slot.slot_index, composite)
+                self.slot.bump_state(composite, force=False)
+        return True
+
+    def get_latest_message(self, node_id: bytes):
+        return self.latest_nominations.get(node_id)
+
+    def current_state(self) -> List:
+        return [self.last_envelope] if self.last_envelope else []

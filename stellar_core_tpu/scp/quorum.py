@@ -1,0 +1,176 @@
+"""Quorum-set logic: slices, v-blocking sets, transitive quorum discovery.
+
+Reference: src/scp/LocalNode.{h,cpp} — LocalNode::{isQuorumSlice, isVBlocking,
+isQuorum, forAllNodes}; src/scp/QuorumSetUtils.{h,cpp} — isQuorumSetSane,
+normalizeQSet.  Re-designed as free functions over frozen node-id sets (the
+TPU quorum-intersection enumerator in accel/quorum.py shares the same bitmask
+encoding produced by QGraph below).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional, Set
+
+from ..crypto.sha import sha256
+from ..xdr import scp as SX
+
+# Node ids are the raw 32-byte ed25519 key (hashable); X.NodeID <-> bytes
+# conversion happens at the SCP envelope boundary (slot.py).
+NodeIDb = bytes
+
+MAX_NESTING_LEVEL = 4  # reference: QuorumSetUtils.cpp — MAXIMUM_QUORUM_NESTING_LEVEL
+
+
+def qset_hash(qset) -> bytes:
+    """SHA-256 of the XDR encoding (content address used in SCP statements)."""
+    return sha256(qset.to_xdr())
+
+
+def for_all_nodes(qset, fn: Callable[[NodeIDb], None]) -> None:
+    for v in qset.validators:
+        fn(v.value)
+    for inner in qset.innerSets:
+        for_all_nodes(inner, fn)
+
+
+def qset_nodes(qset) -> Set[NodeIDb]:
+    out: Set[NodeIDb] = set()
+    for_all_nodes(qset, out.add)
+    return out
+
+
+def is_quorum_slice(qset, nodes: Set[NodeIDb]) -> bool:
+    """True iff `nodes` contains at least one slice of `qset`."""
+    count = 0
+    for v in qset.validators:
+        if v.value in nodes:
+            count += 1
+    for inner in qset.innerSets:
+        if is_quorum_slice(inner, nodes):
+            count += 1
+    return count >= qset.threshold
+
+
+def is_v_blocking(qset, nodes: Set[NodeIDb]) -> bool:
+    """True iff `nodes` intersects every slice of `qset` (can block quorum)."""
+    if qset.threshold == 0:
+        return False
+    left = len(qset.validators) + len(qset.innerSets) - qset.threshold + 1
+    for v in qset.validators:
+        if v.value in nodes:
+            left -= 1
+            if left <= 0:
+                return True
+    for inner in qset.innerSets:
+        if is_v_blocking(inner, nodes):
+            left -= 1
+            if left <= 0:
+                return True
+    return False
+
+
+def is_quorum(local_qset, stmt_map: Dict[NodeIDb, object],
+              qset_of: Callable[[object], Optional[object]],
+              voted: Callable[[object], bool]) -> bool:
+    """True iff the nodes whose statement satisfies `voted` contain a quorum
+    that includes a slice of local_qset.
+
+    Transitive fixpoint: repeatedly drop nodes whose own quorum set (looked up
+    from their statement via `qset_of`) has no slice inside the surviving set.
+    Reference: LocalNode::isQuorum.
+    """
+    nodes = {n for n, st in stmt_map.items() if voted(st)}
+    while True:
+        keep = set()
+        for n in nodes:
+            q = qset_of(stmt_map[n])
+            if q is not None and is_quorum_slice(q, nodes):
+                keep.add(n)
+        if keep == nodes:
+            break
+        nodes = keep
+    return is_quorum_slice(local_qset, nodes)
+
+
+def find_closest_v_blocking(qset, nodes: Set[NodeIDb],
+                            excluded: Optional[NodeIDb] = None) -> Set[NodeIDb]:
+    """A small v-blocking subset of `nodes` w.r.t. qset (greedy heuristic).
+    Reference: LocalNode::findClosestVBlocking."""
+    left = qset.threshold
+    members = []
+    for v in qset.validators:
+        nid = v.value
+        if nid == excluded:
+            continue
+        if nid in nodes:
+            members.append({nid})
+        else:
+            left -= 1
+    for inner in qset.innerSets:
+        sub = find_closest_v_blocking(inner, nodes, excluded)
+        if sub:
+            members.append(sub)
+        else:
+            left -= 1
+    # need to hit (n - threshold + 1) slices; the non-member slots already
+    # "hit" themselves by failing.
+    needed = len(members) - left + 1
+    if needed <= 0:
+        return set()
+    members.sort(key=len)
+    out: Set[NodeIDb] = set()
+    for m in members[:needed]:
+        out |= m
+    return out
+
+
+def is_qset_sane(qset, extra_checks: bool = False, depth: int = 0) -> bool:
+    """Reference: QuorumSetUtils.cpp — isQuorumSetSane.  Thresholds within
+    range, nesting bounded, no duplicate nodes."""
+    if depth > MAX_NESTING_LEVEL:
+        return False
+    n = len(qset.validators) + len(qset.innerSets)
+    if n == 0 or qset.threshold < 1 or qset.threshold > n:
+        return False
+    if extra_checks and qset.threshold < 1 + (n + 1) // 2:  # require majority
+        return False
+    for inner in qset.innerSets:
+        if not is_qset_sane(inner, extra_checks, depth + 1):
+            return False
+    seen: Set[NodeIDb] = set()
+
+    ok = [True]
+
+    def check(nid):
+        if nid in seen:
+            ok[0] = False
+        seen.add(nid)
+
+    for_all_nodes(qset, check)
+    return ok[0]
+
+
+def normalize_qset(qset, remove: Optional[NodeIDb] = None):
+    """Flatten trivial inner sets (threshold==n==1) and drop `remove`.
+    Reference: QuorumSetUtils.cpp — normalizeQSet.  Returns a new qset."""
+    validators = [v for v in qset.validators if v.value != remove]
+    inner = []
+    threshold = qset.threshold
+    for i in qset.innerSets:
+        ni = normalize_qset(i, remove)
+        n = len(ni.validators) + len(ni.innerSets)
+        if n == 0:
+            threshold -= 1 if qset.threshold > 0 else 0
+            continue
+        if ni.threshold == 1 and len(ni.validators) == 1 and not ni.innerSets:
+            validators.append(ni.validators[0])
+        else:
+            inner.append(ni)
+    return SX.SCPQuorumSet(threshold=max(threshold, 0), validators=validators,
+                           innerSets=inner)
+
+
+def singleton_qset(node_id: NodeIDb):
+    from ..xdr import types as XT
+    return SX.SCPQuorumSet(threshold=1, validators=[XT.node_id(node_id)],
+                           innerSets=[])

@@ -436,20 +436,44 @@ def xdr_union(name: str, switch_type, arms: Dict[Any, Tuple[str, Any]],
         def __repr__(self):
             return f"{name}({self.switch!r}, {self.value!r})"
 
+        @property
+        def type(self):
+            """Alias for the discriminant (reads like the reference's
+            `pledges.type()` accessor)."""
+            return self.switch
+
+    class _ArmDescriptor:
+        """Class access → constructor; instance access → the arm's value
+        (raises if the union currently holds a different arm)."""
+
+        __slots__ = ("disc", "arm_name", "has_value")
+
+        def __init__(self, disc, arm_name, has_value):
+            self.disc = disc
+            self.arm_name = arm_name
+            self.has_value = has_value
+
+        def __get__(self, obj, objtype=None):
+            if obj is None:
+                disc, has_value = self.disc, self.has_value
+                if has_value:
+                    def make(value):
+                        return objtype(disc, value)
+                else:
+                    def make():
+                        return objtype(disc)
+                make.__name__ = self.arm_name
+                return make
+            if obj.switch != self.disc:
+                raise AttributeError(
+                    f"{name} holds arm {obj.arm!r}, not {self.arm_name!r}")
+            return obj.value
+
     for disc, (arm_name, arm_type) in resolved.items():
         if not arm_name.isidentifier() or hasattr(Union, arm_name):
             continue
-
-        def _maker(disc=disc, arm_type=arm_type):
-            if arm_type is None:
-                def make(cls):
-                    return cls(disc)
-            else:
-                def make(cls, value):
-                    return cls(disc, value)
-            return classmethod(make)
-
-        setattr(Union, arm_name, _maker())
+        setattr(Union, arm_name, _ArmDescriptor(disc, arm_name,
+                                                arm_type is not None))
 
     Union.__name__ = Union.__qualname__ = name
     return Union
