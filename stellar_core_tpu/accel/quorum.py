@@ -1,0 +1,295 @@
+"""TPU quorum-intersection enumerator: the TPUQuorumIntersectionChecker.
+
+The NP-hard min-quorum enumeration (reference: src/herder/
+QuorumIntersectionCheckerImpl.{h,cpp} — MinQuorumEnumerator branch-and-
+bound) restructured for the TPU execution model (SURVEY.md §3.5 design):
+
+- node subsets are bitmasks packed into uint32 lanes ([B, W] words);
+- the branch-and-bound DFS becomes a depth-synchronized frontier BFS with a
+  *global* variable order (sorted by in-degree), so every frontier item at
+  depth d shares the same remaining-mask and the whole frontier is pruned
+  in one batched device dispatch;
+- the expensive primitive — contract-to-maximal-quorum, a fixpoint of
+  "keep nodes whose slice is satisfied" — is a jitted lax.while_loop whose
+  body evaluates all N nodes' two-level quorum slices against all B subsets
+  at once (popcounts via lax.population_count; the bool->bitmask repack is
+  a uint32 power-of-two contraction, MXU/VPU friendly);
+- rare events (a frontier item IS a quorum) drop to the exact CPU oracle
+  (herder/quorum_intersection.py) for minimality + disjoint-complement
+  checks, keeping verdicts bit-identical to the reference semantics;
+- multi-chip: the frontier batch is sharded over a jax.sharding.Mesh with
+  shard_map (data-parallel over subsets — the EP/SPMD analog per SURVEY.md
+  §2.5); masks/thresholds are replicated.
+
+Exactness: no sampling, no floating point — the verdict (intersects or
+not) is differentially tested against the CPU oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as Pspec
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..herder.quorum_intersection import (
+    InterruptedError_, QuorumIntersectionChecker, QuorumIntersectionResult,
+    flatten_qmap)
+
+# Padding sentinel for inner-set thresholds: never satisfiable.
+_PAD_THR = 1 << 30
+
+
+def _masks_to_words(masks: List[int], n_words: int) -> np.ndarray:
+    out = np.zeros((len(masks), n_words), dtype=np.uint32)
+    for i, m in enumerate(masks):
+        for w in range(n_words):
+            out[i, w] = (m >> (32 * w)) & 0xFFFFFFFF
+    return out
+
+
+def _words_to_mask(words: np.ndarray) -> int:
+    m = 0
+    for w in range(words.shape[-1]):
+        m |= int(words[w]) << (32 * w)
+    return m
+
+
+def _popcount_words(x):
+    """Sum of set bits across the word axis: [..., W] uint32 -> [...] int32."""
+    return jnp.sum(jax.lax.population_count(x).astype(jnp.int32), axis=-1)
+
+
+def _satisfied(S, top_thr, top_masks, inner_thr, inner_masks):
+    """For each subset and node: does S contain a slice of node's qset?
+
+    S [B, W] uint32; top_thr [N]; top_masks [N, W]; inner_thr [N, K];
+    inner_masks [N, K, W].  Returns [B, N] bool.
+    """
+    hits = _popcount_words(S[:, None, :] & top_masks[None, :, :])  # [B, N]
+    k = inner_thr.shape[1]
+    for j in range(k):  # K is small (org count); unrolled, fused by XLA
+        inner_ok = (_popcount_words(S[:, None, :] & inner_masks[None, :, j, :])
+                    >= inner_thr[None, :, j])
+        hits = hits + inner_ok.astype(jnp.int32)
+    return hits >= top_thr[None, :]
+
+
+def _pack_bits(sat, n_words: int):
+    """[B, N] bool -> [B, W] uint32 (bit n of word n//32 = sat[:, n])."""
+    b, n = sat.shape
+    pad = n_words * 32 - n
+    bits = jnp.pad(sat, ((0, 0), (0, pad))).reshape(b, n_words, 32)
+    powers = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits.astype(jnp.uint32) * powers[None, None, :], axis=-1,
+                   dtype=jnp.uint32)
+
+
+def _contract_body(S, top_thr, top_masks, inner_thr, inner_masks):
+    n_words = S.shape[-1]
+    sat = _satisfied(S, top_thr, top_masks, inner_thr, inner_masks)
+    return S & _pack_bits(sat, n_words)
+
+
+def _contract_fixpoint(S, top_thr, top_masks, inner_thr, inner_masks):
+    """Greatest quorum within each subset (0 if none): lax.while_loop to a
+    fixpoint of the keep-satisfied-nodes contraction."""
+    def cond(carry):
+        s, changed = carry
+        return changed
+
+    def body(carry):
+        s, _ = carry
+        s2 = _contract_body(s, top_thr, top_masks, inner_thr, inner_masks)
+        return s2, jnp.any(s2 != s)
+
+    # initial flag derived from S so it has the same varying-axes type as
+    # the loop output under shard_map (always True)
+    out, _ = jax.lax.while_loop(cond, body, (S, jnp.any(S >= 0)))
+    return out
+
+
+@partial(jax.jit, static_argnames=("mesh_size",))
+def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
+                inner_masks, mesh_size=None):
+    """One frontier depth step, fully batched.
+
+    children [B, W]: candidate committed-masks after the split expansion.
+    remaining [W]: the shared remaining-mask at the children's depth.
+    Returns (alive [B] bool — survives pruning and is not itself a quorum,
+             is_quorum [B] bool — contract(committed)==committed != 0).
+    """
+    def step(children):
+        perimeter = children | remaining[None, :]
+        mq = _contract_fixpoint(perimeter, top_thr, top_masks, inner_thr,
+                                inner_masks)
+        # prune: committed not inside the max quorum of its perimeter
+        dead = jnp.any(children & ~mq, axis=-1) | ~jnp.any(mq, axis=-1)
+        cq = _contract_fixpoint(children, top_thr, top_masks, inner_thr,
+                                inner_masks)
+        nonzero = jnp.any(children, axis=-1)
+        is_q = nonzero & jnp.all(cq == children, axis=-1)
+        alive = ~dead & ~is_q
+        return alive, is_q
+
+    return step(children)
+
+
+class TPUQuorumIntersectionChecker:
+    """Drop-in TPU-accelerated intersection check over a quorum map.
+
+    Same verdict contract as the CPU QuorumIntersectionChecker; requires
+    the flattened two-level (org-form) qset shape (ValueError otherwise —
+    callers fall back to the CPU oracle, as HerderImpl does).
+    """
+
+    def __init__(self, qmap: Dict[bytes, object],
+                 interrupt: Optional[Callable[[], bool]] = None,
+                 batch_size: int = 2048,
+                 mesh: Optional[Mesh] = None):
+        (self.node_ids, tops, top_masks, inner_thrs,
+         inner_masks) = flatten_qmap(qmap)
+        self.n = len(self.node_ids)
+        self.interrupt = interrupt or (lambda: False)
+        self.batch_size = batch_size
+        self.mesh = mesh
+        # CPU oracle shares index order (flatten_qmap and the checker both
+        # sort node ids) — used for SCC analysis and rare-event checks.
+        self.oracle = QuorumIntersectionChecker(qmap, interrupt)
+        assert self.oracle.node_ids == self.node_ids
+
+        self.n_words = max((self.n + 31) // 32, 1)
+        k = max((len(t) for t in inner_thrs), default=0)
+        k = max(k, 1)
+        n, w = self.n, self.n_words
+        thr = np.full((n, k), _PAD_THR, dtype=np.int32)
+        imask = np.zeros((n, k, w), dtype=np.uint32)
+        for i in range(n):
+            for j, t in enumerate(inner_thrs[i]):
+                thr[i, j] = t
+                imask[i, j] = _masks_to_words([inner_masks[i][j]], w)[0]
+        self.top_thr = jnp.asarray(np.asarray(tops, dtype=np.int32))
+        self.top_masks = jnp.asarray(_masks_to_words(top_masks, w))
+        self.inner_thr = jnp.asarray(thr)
+        self.inner_masks = jnp.asarray(imask)
+
+        if mesh is not None:
+            ndev = mesh.devices.size
+            spec_b = Pspec("data", None)
+            sharded = shard_map(
+                lambda c, r, tt, tm, it, im: _prune_step(c, r, tt, tm, it, im),
+                mesh=mesh,
+                in_specs=(spec_b, Pspec(None), Pspec(None),
+                          Pspec(None, None), Pspec(None, None),
+                          Pspec(None, None, None)),
+                out_specs=(Pspec("data"), Pspec("data")))
+            self._step = jax.jit(sharded)
+            self._pad_to = ndev
+        else:
+            self._step = _prune_step
+            self._pad_to = 1
+
+    # -- batched pruning over the device ---------------------------------
+    def _prune(self, children: np.ndarray, remaining_words: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        alive = np.zeros(len(children), dtype=bool)
+        is_q = np.zeros(len(children), dtype=bool)
+        bs = self.batch_size
+        rem = jnp.asarray(remaining_words)
+        for lo in range(0, len(children), bs):
+            if self.interrupt():
+                raise InterruptedError_()
+            chunk = children[lo:lo + bs]
+            n_real = len(chunk)
+            pad = (-n_real) % self._pad_to
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad, self.n_words), dtype=np.uint32)])
+            a, q = self._step(jnp.asarray(chunk), rem, self.top_thr,
+                              self.top_masks, self.inner_thr,
+                              self.inner_masks)
+            alive[lo:lo + bs] = np.asarray(a)[:n_real]
+            is_q[lo:lo + bs] = np.asarray(q)[:n_real]
+        return alive, is_q
+
+    # -- the frontier search ---------------------------------------------
+    def check(self) -> QuorumIntersectionResult:
+        oracle = self.oracle
+        n = self.n
+        if n == 0:
+            return QuorumIntersectionResult(True, node_count=0)
+
+        # SCC phase on CPU (cheap, irregular graph walk)
+        from ..herder.quorum_intersection import tarjan_sccs
+        oracle._indegree = indeg = [0] * n
+        for qb in oracle.qbs:
+            m = qb.successors
+            while m:
+                bit = m & -m
+                indeg[bit.bit_length() - 1] += 1
+                m ^= bit
+        sccs = tarjan_sccs([qb.successors for qb in oracle.qbs], n)
+        quorum_sccs = [(s, mq) for s in sccs
+                       if (mq := oracle.contract_to_max_quorum(s))]
+        if not quorum_sccs:
+            return QuorumIntersectionResult(True, node_count=n,
+                                            main_scc_size=0)
+        if len(quorum_sccs) > 1:
+            (_, q1), (_, q2) = quorum_sccs[0], quorum_sccs[1]
+            return QuorumIntersectionResult(
+                False, split=(oracle._names(q1), oracle._names(q2)),
+                node_count=n, main_scc_size=0)
+        scc, _ = quorum_sccs[0]
+
+        # global variable order: in-degree desc (matches the CPU split
+        # heuristic; a fixed order is what lets the frontier share masks)
+        order = sorted((i for i in range(n) if (scc >> i) & 1),
+                       key=lambda i: -indeg[i])
+        depth_remaining = [0] * (len(order) + 1)
+        for d in range(len(order) - 1, -1, -1):
+            depth_remaining[d] = depth_remaining[d + 1] | (1 << order[d])
+
+        max_q = 0
+        frontier = np.zeros((1, self.n_words), dtype=np.uint32)  # committed=0
+        for d in range(len(order)):
+            if len(frontier) == 0:
+                break
+            bit_words = _masks_to_words([1 << order[d]], self.n_words)[0]
+            # children: exclude-branch keeps committed, include-branch adds
+            # the split bit; both advance to depth d+1
+            children = np.concatenate([frontier, frontier | bit_words])
+            rem_words = _masks_to_words([depth_remaining[d + 1]],
+                                        self.n_words)[0]
+            alive, is_q = self._prune(children, rem_words)
+            # rare path: exact minimality + disjoint-complement on CPU
+            for idx in np.nonzero(is_q)[0]:
+                committed = _words_to_mask(children[idx])
+                max_q += 1
+                if oracle.is_minimal_quorum(committed):
+                    disjoint = oracle.contract_to_max_quorum(scc & ~committed)
+                    if disjoint:
+                        return QuorumIntersectionResult(
+                            False,
+                            split=(oracle._names(committed),
+                                   oracle._names(disjoint)),
+                            node_count=n, main_scc_size=scc.bit_count(),
+                            max_quorums_found=max_q)
+            frontier = children[alive]
+        return QuorumIntersectionResult(
+            True, node_count=n, main_scc_size=scc.bit_count(),
+            max_quorums_found=max_q)
+
+
+def check_intersection_tpu(qmap, interrupt=None, mesh=None,
+                           batch_size=2048) -> QuorumIntersectionResult:
+    """One-shot API mirroring herder.quorum_intersection.check_intersection."""
+    return TPUQuorumIntersectionChecker(qmap, interrupt, batch_size,
+                                        mesh).check()

@@ -152,20 +152,27 @@ class BallotProtocol:
         return self._st_order(st) > self._st_order(old)
 
     @staticmethod
-    def _sane(st) -> bool:
+    def _sane(st, self_st: bool = False) -> bool:
+        """Reference: BallotProtocol::isStatementSane.  A self statement may
+        carry ballot counter 0 (never emitted; see _emit_current_state)."""
         pl = st.pledges
         if pl.type == StType.SCP_ST_PREPARE:
             pr = pl.prepare
-            if pr.ballot.counter == 0:
+            if not self_st and pr.ballot.counter == 0:
                 return False
-            ok = pr.nC <= pr.nH <= pr.ballot.counter
-            if pr.prepared is not None:
-                ok = ok and _b(pr.prepared) <= _b(pr.ballot) or True
             if pr.prepared is not None and pr.preparedPrime is not None:
-                ok = ok and (_b(pr.preparedPrime) < _b(pr.prepared)
-                             and not compatible(_b(pr.preparedPrime),
-                                                _b(pr.prepared)))
-            return ok
+                # p' < p and incompatible
+                if not (_b(pr.preparedPrime) < _b(pr.prepared)
+                        and not compatible(_b(pr.preparedPrime),
+                                           _b(pr.prepared))):
+                    return False
+            if pr.nH != 0 and (pr.prepared is None
+                               or pr.nH > pr.prepared.counter):
+                return False
+            if pr.nC != 0 and not (pr.nH != 0
+                                   and pr.ballot.counter >= pr.nH >= pr.nC):
+                return False
+            return True
         if pl.type == StType.SCP_ST_CONFIRM:
             co = pl.confirm
             return (co.ballot.counter > 0
@@ -371,6 +378,10 @@ class BallotProtocol:
             self.phase = PHASE_CONFIRM
             if self.b is not None and not less_and_compatible(h, self.b):
                 self._bump_to_ballot(h, False)
+            # accepting commit(c..h) implies prepared(h): keep the CONFIRM-
+            # phase invariant that p is set (the CONFIRM statement carries
+            # nPrepared)
+            self._set_prepared(h)
             self.pp = None
             did = True
         if did:
@@ -427,14 +438,14 @@ class BallotProtocol:
         counters = {n: self._counter_of(st)
                     for n, st in self._stmt_map().items()}
         ahead = sorted({c for c in counters.values() if c > target})
+        # v-blocking-ness is monotone in the node set, so only the smallest
+        # ahead counter (largest node set) can qualify
         for n in ahead:
             nodes = {nid for nid, c in counters.items() if c >= n}
             if ln.is_v_blocking(nodes):
-                value = self.z if self.z is not None else (
-                    self.b[1] if self.b else None)
-                if value is None:
-                    return False
-                return self._bump_state(value, n)
+                # abandon_ballot owns the value selection (z, then the
+                # nomination composite, then the current ballot's value)
+                return self.abandon_ballot(n)
             break
         return False
 
@@ -518,7 +529,7 @@ class BallotProtocol:
     def process_envelope(self, env, self_env: bool = False) -> bool:
         st = env.statement
         nid = st.nodeID.value
-        if not self._sane(st):
+        if not self._sane(st, self_st=self_env):
             return False
         if not self._validate_values(st):
             return False
@@ -599,12 +610,20 @@ class BallotProtocol:
             return
         st = self._build_statement()
         env = self.slot.create_envelope(st)
-        if self.process_envelope(env, self_env=True) or True:
-            if (self.last_envelope is None
-                    or self._is_newer(st, self.last_envelope.statement)):
-                self.last_envelope = env
-                if self.slot.fully_validated:
-                    self.slot.driver.emit_envelope(env)
+        if not self.process_envelope(env, self_env=True):
+            # Rejection for "not newer than our previous statement" is
+            # benign (don't re-emit); rejection for sanity/validation means
+            # protocol state corruption.  Reference: emitCurrentStateStatement
+            # throws "moved to a bad state (ballot protocol)".
+            if not (self._sane(st, self_st=True)
+                    and self._validate_values(st)):
+                raise RuntimeError("moved to a bad state (ballot protocol)")
+            return
+        if (self.last_envelope is None
+                or self._is_newer(st, self.last_envelope.statement)):
+            self.last_envelope = env
+            if self.slot.fully_validated:
+                self.slot.driver.emit_envelope(env)
 
     def get_latest_message(self, node_id: bytes):
         return self.latest_envelopes.get(node_id)

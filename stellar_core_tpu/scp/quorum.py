@@ -151,16 +151,24 @@ def is_qset_sane(qset, extra_checks: bool = False, depth: int = 0) -> bool:
 
 
 def normalize_qset(qset, remove: Optional[NodeIDb] = None):
-    """Flatten trivial inner sets (threshold==n==1) and drop `remove`.
+    """Flatten trivial inner sets (threshold==n==1) and drop `remove`,
+    decrementing the threshold per removed member (removal models "that
+    node always agrees", e.g. removing self from the local qset).
     Reference: QuorumSetUtils.cpp — normalizeQSet.  Returns a new qset."""
-    validators = [v for v in qset.validators if v.value != remove]
-    inner = []
+    validators = []
     threshold = qset.threshold
+    for v in qset.validators:
+        if v.value == remove:
+            threshold -= 1
+        else:
+            validators.append(v)
+    inner = []
     for i in qset.innerSets:
         ni = normalize_qset(i, remove)
         n = len(ni.validators) + len(ni.innerSets)
-        if n == 0:
-            threshold -= 1 if qset.threshold > 0 else 0
+        if n == 0 or ni.threshold <= 0:
+            # inner set auto-satisfied (or emptied) by the removal
+            threshold -= 1
             continue
         if ni.threshold == 1 and len(ni.validators) == 1 and not ni.innerSets:
             validators.append(ni.validators[0])

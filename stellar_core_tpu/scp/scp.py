@@ -43,7 +43,10 @@ class SCP:
     # --- consensus drive ---------------------------------------------------
     def nominate(self, slot_index: int, value: bytes,
                  previous_value: bytes) -> bool:
-        assert self.local_node.is_validator
+        if not self.local_node.is_validator:
+            # watchers never cast votes (reference: SCP::nominate returns
+            # false after logging)
+            return False
         return self.get_slot(slot_index).nominate(value, previous_value)
 
     def stop_nomination(self, slot_index: int) -> None:

@@ -1,0 +1,134 @@
+"""Differential tests: TPU quorum-intersection enumerator vs CPU oracle.
+
+Reference test model: src/herder/test/QuorumIntersectionTests.cpp, plus the
+SURVEY.md §4 rule that TPU offloads are differentially tested against the
+CPU path with identical verdicts.
+"""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stellar_core_tpu.accel.quorum import (TPUQuorumIntersectionChecker,
+                                           check_intersection_tpu)
+from stellar_core_tpu.herder.quorum_intersection import (
+    InterruptedError_, check_intersection)
+from stellar_core_tpu.xdr import scp as SX
+from stellar_core_tpu.xdr import types as XT
+
+
+def nid(i: int) -> bytes:
+    return bytes([i & 0xFF, i >> 8]) + bytes(30)
+
+
+def qset(threshold, validators=(), inner=()):
+    return SX.SCPQuorumSet(threshold=threshold,
+                           validators=[XT.node_id(v) for v in validators],
+                           innerSets=list(inner))
+
+
+def org_qmap(n_orgs, org_size, top_thr, inner_thr):
+    orgs = [[nid(100 * o + i) for i in range(org_size)]
+            for o in range(n_orgs)]
+    top = lambda: qset(top_thr, inner=[qset(inner_thr, org) for org in orgs])
+    return {v: top() for org in orgs for v in org}
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("n,thr", [(4, 3), (4, 2), (5, 3), (6, 4),
+                                       (6, 3), (7, 5), (8, 4)])
+    def test_flat_maps(self, n, thr):
+        ids = [nid(i) for i in range(n)]
+        qmap = {v: qset(thr, ids) for v in ids}
+        cpu = check_intersection(qmap)
+        tpu = check_intersection_tpu(qmap)
+        assert cpu.intersects == tpu.intersects, (n, thr)
+        if not tpu.intersects:
+            a, b = tpu.split
+            assert set(a) & set(b) == set()
+
+    @pytest.mark.parametrize("n_orgs,top", [(3, 2), (4, 2), (4, 3), (5, 3),
+                                            (5, 4), (7, 5)])
+    def test_org_maps(self, n_orgs, top):
+        qmap = org_qmap(n_orgs, 3, top, 2)
+        cpu = check_intersection(qmap)
+        tpu = check_intersection_tpu(qmap)
+        assert cpu.intersects == tpu.intersects, (n_orgs, top)
+
+    def test_random_maps(self):
+        rng = random.Random(42)
+        for trial in range(12):
+            n = rng.randrange(3, 9)
+            ids = [nid(i) for i in range(n)]
+            qmap = {}
+            for v in ids:
+                peers = rng.sample(ids, rng.randrange(2, n + 1))
+                if v not in peers:
+                    peers.append(v)
+                thr = rng.randrange(1, len(peers) + 1)
+                qmap[v] = qset(thr, peers)
+            cpu = check_intersection(qmap)
+            tpu = check_intersection_tpu(qmap)
+            assert cpu.intersects == tpu.intersects, (trial, n)
+
+    def test_split_witness_is_two_quorums(self):
+        qmap = org_qmap(4, 3, 2, 2)  # 2-of-4 orgs: splits
+        tpu = check_intersection_tpu(qmap)
+        assert not tpu.intersects
+        from stellar_core_tpu.herder.quorum_intersection import (
+            QuorumIntersectionChecker)
+        ck = QuorumIntersectionChecker(qmap)
+        a, b = tpu.split
+        mask = lambda names: sum(1 << ck.index[x] for x in names)
+        assert ck.is_quorum(mask(a)) and ck.is_quorum(mask(b))
+        assert mask(a) & mask(b) == 0
+
+
+class TestMeshSharded:
+    def test_sharded_matches(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices (see conftest)")
+        mesh = Mesh(np.array(devs[:8]), axis_names=("data",))
+        qmap = org_qmap(5, 3, 3, 2)
+        plain = check_intersection_tpu(qmap)
+        sharded = check_intersection_tpu(qmap, mesh=mesh, batch_size=64)
+        assert plain.intersects == sharded.intersects == \
+            check_intersection(qmap).intersects
+
+    def test_sharded_split_case(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices (see conftest)")
+        mesh = Mesh(np.array(devs[:8]), axis_names=("data",))
+        qmap = org_qmap(4, 2, 2, 2)
+        res = check_intersection_tpu(qmap, mesh=mesh, batch_size=64)
+        assert not res.intersects
+
+
+class TestBigMap:
+    def test_tier1_shape_21_nodes(self):
+        # 7 orgs x 3 validators, 5-of-7 top: the pubnet tier-1 shape
+        qmap = org_qmap(7, 3, 5, 2)
+        res = check_intersection_tpu(qmap)
+        assert res.intersects
+        assert res.node_count == 21
+
+    def test_interrupt(self):
+        qmap = org_qmap(6, 3, 4, 2)
+        with pytest.raises(InterruptedError_):
+            check_intersection_tpu(qmap, interrupt=lambda: True)
+
+    def test_deep_nesting_raises(self):
+        a, b = nid(1), nid(2)
+        deep = qset(1, inner=[qset(1, inner=[qset(1, [a])])])
+        with pytest.raises(ValueError):
+            TPUQuorumIntersectionChecker({a: deep, b: deep})

@@ -1,0 +1,180 @@
+"""Quorum intersection checker tests.
+
+Reference test model: src/herder/test/QuorumIntersectionTests.cpp —
+interior/exterior split cases, org-level (nested) configs, critical groups,
+interruption.
+"""
+
+import pytest
+
+from stellar_core_tpu.herder.quorum_intersection import (
+    InterruptedError_, QuorumIntersectionChecker, check_intersection,
+    intersection_critical_groups, flatten_qmap, tarjan_sccs)
+from stellar_core_tpu.xdr import scp as SX
+from stellar_core_tpu.xdr import types as XT
+
+
+def nid(i: int) -> bytes:
+    return bytes([i]) + bytes(31)
+
+
+def qset(threshold, validators=(), inner=()):
+    return SX.SCPQuorumSet(threshold=threshold,
+                           validators=[XT.node_id(v) for v in validators],
+                           innerSets=list(inner))
+
+
+def flat_qmap(n, threshold, ids=None):
+    ids = ids or [nid(i) for i in range(n)]
+    return {v: qset(threshold, ids) for v in ids}
+
+
+class TestTarjan:
+    def test_single_cycle(self):
+        # 0->1->2->0
+        succs = [0b010, 0b100, 0b001]
+        sccs = tarjan_sccs(succs, 3)
+        assert sorted(s.bit_count() for s in sccs) == [3]
+
+    def test_two_components_and_chain(self):
+        # 0<->1, 2<->3, 1->2 (cross edge, no back edge)
+        succs = [0b0010, 0b0101, 0b1000, 0b0100]
+        sccs = tarjan_sccs(succs, 4)
+        assert sorted(s.bit_count() for s in sccs) == [2, 2]
+        assert {0b0011, 0b1100} == set(sccs)
+
+    def test_self_only(self):
+        sccs = tarjan_sccs([0b1], 1)
+        assert sccs == [0b1]
+
+
+class TestIntersection:
+    def test_majority_intersects(self):
+        res = check_intersection(flat_qmap(4, 3))
+        assert res.intersects
+        assert res.node_count == 4
+        assert res.main_scc_size == 4
+
+    def test_below_majority_splits_same_scc(self):
+        # threshold 2 of 4: {0,1} and {2,3} are disjoint quorums in one SCC
+        res = check_intersection(flat_qmap(4, 2))
+        assert not res.intersects
+        a, b = res.split
+        assert set(a) & set(b) == set()
+        ck = QuorumIntersectionChecker(flat_qmap(4, 2))
+        mask_of = lambda names: sum(1 << ck.index[x] for x in names)
+        assert ck.is_quorum(mask_of(a))
+        assert ck.is_quorum(mask_of(b))
+
+    def test_disjoint_sccs_split(self):
+        ids_a = [nid(i) for i in range(3)]
+        ids_b = [nid(10 + i) for i in range(3)]
+        qmap = {v: qset(2, ids_a) for v in ids_a}
+        qmap.update({v: qset(2, ids_b) for v in ids_b})
+        res = check_intersection(qmap)
+        assert not res.intersects
+        a, b = res.split
+        assert set(a) & set(b) == set()
+
+    def test_single_node(self):
+        v = nid(1)
+        res = check_intersection({v: qset(1, [v])})
+        assert res.intersects
+
+    def test_no_quorum_vacuous(self):
+        # Node requires a peer that has no qset (treated failed) => no quorum
+        a, b = nid(1), nid(2)
+        res = check_intersection({a: qset(2, [a, b]), b: None})
+        assert res.intersects
+        assert res.main_scc_size == 0
+
+    def test_org_config_intersects(self):
+        # 3 orgs x 3 validators, top 2-of-3 orgs, inner 2-of-3: safe
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(3)]
+        top = lambda: qset(2, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        res = check_intersection(qmap)
+        assert res.intersects
+
+    def test_org_config_splits(self):
+        # 4 orgs, top 2-of-4: org pair {0,1} vs {2,3} => split
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(4)]
+        top = lambda: qset(2, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        res = check_intersection(qmap)
+        assert not res.intersects
+
+    def test_tier1_like_config_intersects(self):
+        # 7 orgs x 3, top 5-of-7 (mirrors pubnet tier-1 shape)
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(7)]
+        top = lambda: qset(5, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        res = check_intersection(qmap)
+        assert res.intersects
+
+    def test_asymmetric_dependency(self):
+        # leaf nodes depend on a safe core but aren't depended on
+        core = [nid(i) for i in range(4)]
+        leaf = nid(9)
+        qmap = flat_qmap(4, 3, core)
+        qmap[leaf] = qset(3, core)
+        res = check_intersection(qmap)
+        assert res.intersects
+
+    def test_interrupt(self):
+        with pytest.raises(InterruptedError_):
+            # interrupt immediately; 16-node t=8 search is big enough that
+            # the poll counter (1024 calls) trips
+            check_intersection(flat_qmap(16, 8), interrupt=lambda: True)
+
+
+class TestMinimalQuorums:
+    def test_contract_and_minimal(self):
+        ck = QuorumIntersectionChecker(flat_qmap(4, 3))
+        full = 0b1111
+        assert ck.contract_to_max_quorum(full) == full
+        assert ck.is_quorum(0b0111)
+        assert ck.is_minimal_quorum(0b0111)
+        assert not ck.is_minimal_quorum(0b1111)
+        assert ck.contract_to_max_quorum(0b0011) == 0
+
+
+class TestCriticalGroups:
+    def test_critical_org(self):
+        # 3 orgs, top 2-of-3: if one org turns arbitrary it can join both
+        # halves of a split of the other two => every org is critical
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(3)]
+        top = lambda: qset(2, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        crit = intersection_critical_groups(qmap, [set(o) for o in orgs])
+        assert len(crit) == 3
+
+    def test_non_critical(self):
+        # threshold 3-of-3 orgs: a faulty org still can't split the
+        # remaining 2-of-2 requirement... (2 orgs remain, both needed in
+        # any quorum => intersection holds)
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(3)]
+        top = lambda: qset(3, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        crit = intersection_critical_groups(qmap, [set(o) for o in orgs])
+        assert crit == []
+
+
+class TestFlatten:
+    def test_flatten_org_map(self):
+        orgs = [[nid(10 * o + i) for i in range(3)] for o in range(3)]
+        top = lambda: qset(2, inner=[qset(2, org) for org in orgs])
+        qmap = {v: top() for org in orgs for v in org}
+        node_ids, tops, top_masks, ithrs, imasks = flatten_qmap(qmap)
+        assert len(node_ids) == 9
+        assert tops == [2] * 9
+        assert top_masks == [0] * 9
+        assert all(len(t) == 3 for t in ithrs)
+        # each inner mask covers exactly 3 nodes
+        assert all(m.bit_count() == 3 for masks in imasks for m in masks)
+
+    def test_flatten_rejects_deep_nesting(self):
+        a, b = nid(1), nid(2)
+        deep = qset(1, inner=[qset(1, inner=[qset(1, [a])])])
+        with pytest.raises(ValueError):
+            flatten_qmap({a: deep, b: deep})

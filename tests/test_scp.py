@@ -239,3 +239,66 @@ def test_laggard_catches_up_via_vblocking_bump():
     assert len(set(non_lag)) == 1
     assert bus.nodes[laggard].driver.externalized.get(slot) in (
         None, non_lag[0])
+
+
+class TestStatementSanity:
+    """Regression tests for BallotProtocol::isStatementSane semantics."""
+
+    def _prepare_st(self, ballot_n=5, nC=0, nH=0, prepared=None,
+                    prepared_prime=None):
+        from stellar_core_tpu.scp.ballot import BallotProtocol
+        val = b"v" * 32
+        pr = SX.SCPPrepare(
+            quorumSetHash=b"\0" * 32,
+            ballot=SX.SCPBallot(counter=ballot_n, value=val),
+            prepared=prepared, preparedPrime=prepared_prime, nC=nC, nH=nH)
+        st = SX.SCPStatement(nodeID=XT.node_id(nid(0)), slotIndex=1,
+                             pledges=SX.SCPStatementPledges.prepare(pr))
+        return BallotProtocol._sane(st), st
+
+    def test_nc_above_nh_rejected(self):
+        prepared = SX.SCPBallot(counter=5, value=b"v" * 32)
+        ok, _ = self._prepare_st(nC=4, nH=2, prepared=prepared)
+        assert not ok
+
+    def test_nh_without_prepared_rejected(self):
+        ok, _ = self._prepare_st(nH=3, prepared=None)
+        assert not ok
+
+    def test_nh_above_prepared_counter_rejected(self):
+        prepared = SX.SCPBallot(counter=2, value=b"v" * 32)
+        ok, _ = self._prepare_st(nH=3, prepared=prepared)
+        assert not ok
+
+    def test_prepared_prime_must_be_less_incompatible(self):
+        prepared = SX.SCPBallot(counter=4, value=b"v" * 32)
+        pp_bad = SX.SCPBallot(counter=3, value=b"v" * 32)  # compatible: bad
+        ok, _ = self._prepare_st(prepared=prepared, prepared_prime=pp_bad)
+        assert not ok
+        pp_good = SX.SCPBallot(counter=3, value=b"w" * 32)
+        ok, _ = self._prepare_st(prepared=prepared, prepared_prime=pp_good)
+        assert ok
+
+    def test_zero_counter_rejected_unless_self(self):
+        from stellar_core_tpu.scp.ballot import BallotProtocol
+        _, st = self._prepare_st(ballot_n=0)
+        assert not BallotProtocol._sane(st)
+        assert BallotProtocol._sane(st, self_st=True)
+
+
+def test_watcher_nominate_returns_false():
+    bus = Bus(3)
+    qset = next(iter(bus.qsets.values()))
+    watcher = S.SCP(BusDriver(bus, nid(0)), nid(0),
+                    is_validator=False, qset=qset)
+    assert watcher.nominate(1, b"x" * 32, b"p" * 32) is False
+
+
+def test_normalize_removal_decrements_threshold():
+    q = make_qset([nid(0), nid(1)], 2)
+    n = S.normalize_qset(q, remove=nid(0))
+    assert n.threshold == 1 and len(n.validators) == 1
+    # inner set consisting solely of the removed node: auto-satisfied
+    q2 = make_qset([nid(1)], 2, inner=[make_qset([nid(0)], 1)])
+    n2 = S.normalize_qset(q2, remove=nid(0))
+    assert n2.threshold == 1 and len(n2.validators) == 1 and not n2.innerSets

@@ -1,0 +1,202 @@
+"""Work framework tests (virtual-time, deterministic).
+
+Reference test model: src/work/test/WorkTests.cpp — success/failure
+propagation, retries with backoff, sequences, batch concurrency bounds,
+abort.
+"""
+
+from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+from stellar_core_tpu.work import (BasicWork, BatchWork, ConditionalWork,
+                                   State, Work, WorkScheduler, WorkSequence,
+                                   function_work)
+
+
+def make_sched():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    return clock, WorkScheduler(clock)
+
+
+class CountedWork(BasicWork):
+    """Succeeds after `steps` cranks; optionally fails `fail_times` first."""
+
+    def __init__(self, clock, name="counted", steps=3, fail_times=0,
+                 max_retries=5):
+        super().__init__(clock, name, max_retries)
+        self.steps = steps
+        self.fail_times = fail_times
+        self.runs = 0
+        self.resets = 0
+
+    def on_reset(self):
+        self.runs = 0
+        self.resets += 1
+
+    def on_run(self):
+        self.runs += 1
+        if self.runs < self.steps:
+            return State.RUNNING
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            return State.FAILURE
+        return State.SUCCESS
+
+
+class TestBasicWork:
+    def test_simple_success(self):
+        clock, sched = make_sched()
+        w = CountedWork(clock, steps=4)
+        assert sched.execute(w)
+        assert w.state == State.SUCCESS
+        assert w.runs == 4
+
+    def test_failure_exhausts_retries(self):
+        clock, sched = make_sched()
+        w = CountedWork(clock, steps=1, fail_times=99, max_retries=3)
+        assert not sched.execute(w)
+        assert w.state == State.FAILURE
+        assert w.resets == 4  # initial + 3 retries
+
+    def test_retry_then_success(self):
+        clock, sched = make_sched()
+        w = CountedWork(clock, steps=2, fail_times=2, max_retries=5)
+        t0 = clock.now()
+        assert sched.execute(w)
+        # two retries: backoff 1s + 2s of virtual time must have elapsed
+        assert clock.now() - t0 >= 3.0
+        assert w.resets == 3
+
+    def test_raising_work_fails(self):
+        clock, sched = make_sched()
+
+        class Boom(BasicWork):
+            def on_run(self):
+                raise ValueError("boom")
+
+        w = Boom(clock, "boom", max_retries=0)
+        assert not sched.execute(w)
+        assert w.state == State.FAILURE
+
+    def test_abort(self):
+        clock, sched = make_sched()
+        w = CountedWork(clock, steps=10**9)
+        sched.schedule(w)
+        clock.crank(block=False)
+        w.shutdown()
+        clock.crank_until(lambda: w.done, 10)
+        assert w.state == State.ABORTED
+
+
+class TestWorkChildren:
+    def test_parent_waits_for_children(self):
+        clock, sched = make_sched()
+
+        class Parent(Work):
+            def __init__(self, clock):
+                super().__init__(clock, "parent")
+                self.did_own_work = False
+
+            def do_work(self):
+                self.did_own_work = True
+                return State.SUCCESS
+
+        p = Parent(clock)
+        kids = [CountedWork(clock, f"kid{i}", steps=i + 2) for i in range(3)]
+        sched.schedule(p)
+        for k in kids:
+            p.add_work(k)
+        clock.crank_until(lambda: p.done, 60)
+        assert p.succeeded and p.did_own_work
+        assert all(k.succeeded for k in kids)
+
+    def test_child_failure_fails_parent(self):
+        clock, sched = make_sched()
+        p = Work(clock, "parent", max_retries=0)
+        p_ok = CountedWork(clock, "ok", steps=2)
+        p_bad = CountedWork(clock, "bad", steps=1, fail_times=9, max_retries=1)
+        sched.schedule(p)
+        p.add_work(p_ok)
+        p.add_work(p_bad)
+        clock.crank_until(lambda: p.done, 60)
+        assert p.failed
+
+
+class TestWorkSequence:
+    def test_runs_in_order(self):
+        clock, sched = make_sched()
+        order = []
+
+        def step(i):
+            def fn():
+                order.append(i)
+                return True
+            return function_work(clock, f"s{i}", fn)
+
+        seq = WorkSequence(clock, "seq", [step(i) for i in range(5)])
+        assert sched.execute(seq)
+        assert order == list(range(5))
+
+    def test_stops_on_failure(self):
+        clock, sched = make_sched()
+        order = []
+
+        def step(i, ok=True):
+            def fn():
+                order.append(i)
+                return ok
+            return function_work(clock, f"s{i}", fn)
+
+        seq = WorkSequence(clock, "seq",
+                           [step(0), step(1, ok=False), step(2)])
+        assert not sched.execute(seq)
+        assert order == [0, 1]
+
+
+class TestBatchWork:
+    def test_concurrency_bound(self):
+        clock, sched = make_sched()
+        in_flight = [0]
+        peak = [0]
+
+        class Job(BasicWork):
+            def __init__(self, clock, i):
+                super().__init__(clock, f"job{i}", max_retries=0)
+                self.ticks = 0
+
+            def on_reset(self):
+                in_flight[0] += 1
+                peak[0] = max(peak[0], in_flight[0])
+
+            def on_run(self):
+                self.ticks += 1
+                if self.ticks < 3:
+                    return State.RUNNING
+                in_flight[0] -= 1
+                return State.SUCCESS
+
+        jobs = (Job(clock, i) for i in range(20))
+        bw = BatchWork(clock, "batch", jobs, max_concurrency=4)
+        assert sched.execute(bw)
+        assert peak[0] <= 4
+        assert in_flight[0] == 0
+
+    def test_batch_failure(self):
+        clock, sched = make_sched()
+        jobs = iter([CountedWork(clock, "a", steps=1),
+                     CountedWork(clock, "b", steps=1, fail_times=5,
+                                 max_retries=0)])
+        bw = BatchWork(clock, "batch", jobs, max_concurrency=2)
+        assert not sched.execute(bw)
+
+
+class TestConditionalWork:
+    def test_waits_for_condition(self):
+        clock, sched = make_sched()
+        gate = [False]
+        inner = CountedWork(clock, steps=2)
+        cw = ConditionalWork(clock, "cond", lambda: gate[0], inner)
+        sched.schedule(cw)
+        clock.crank_for(2.0)
+        assert not cw.done and inner.state == State.PENDING
+        gate[0] = True
+        clock.crank_until(lambda: cw.done, 30)
+        assert cw.succeeded and inner.succeeded
