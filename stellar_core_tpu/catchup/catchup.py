@@ -207,9 +207,13 @@ class CatchupManager:
                 raise CatchupError(f"tx set hash mismatch at ledger {seq}")
             frames = [TransactionFrame.make_from_wire(self.network_id, env)
                       for env in tx_set.txs]
+            # the historical scpValue must be stored (and its upgrades
+            # applied) verbatim, or the replayed header hash diverges from
+            # the live close path
             mgr.close_ledger(frames, entry.header.scpValue.closeTime,
                              tx_set=tx_set,
-                             expected_ledger_hash=entry.hash)
+                             expected_ledger_hash=entry.hash,
+                             stellar_value=entry.header.scpValue)
 
     # -- minimal (assume state from buckets, no replay) ---------------------
     def catchup_minimal(self, archive: FileHistoryArchive) -> LedgerManager:

@@ -1,0 +1,451 @@
+"""Herder — drives SCP per ledger and glues consensus to the ledger.
+
+Reference: src/herder/HerderImpl.{h,cpp} — recvSCPEnvelope, recvTransaction,
+recvTxSet/recvSCPQuorumSet, triggerNextLedger, valueExternalized,
+processSCPQueue, out-of-sync detection; src/herder/HerderSCPDriver.{h,cpp} —
+the SCPDriver implementation (validateValue, combineCandidates,
+signEnvelope/verifyEnvelope with the network-bound SCP envelope domain,
+emitEnvelope, timers).  Merged into one class here: the driver half is the
+SCPDriver overrides, the herder half is the public node API — the split in
+the reference exists for header-dependency reasons this package doesn't
+have.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import xdr as X
+from ..crypto import keys
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..scp.driver import SCPDriver, ValidationLevel
+from ..scp.scp import SCP, EnvelopeState
+from ..util import logging as slog
+from ..util.clock import VirtualClock, VirtualTimer
+from .pending_envelopes import (ENVELOPE_STATUS_DISCARDED,
+                                ENVELOPE_STATUS_FETCHING,
+                                ENVELOPE_STATUS_PROCESSED,
+                                ENVELOPE_STATUS_READY, PendingEnvelopes)
+from .quorum_tracker import QuorumTracker
+from .tx_queue import AddResult, TransactionQueue
+from .upgrades import Upgrades
+
+log = slog.get("Herder")
+
+# Reference: src/herder/Herder.h
+EXP_LEDGER_TIMESPAN_SECONDS = 5
+MAX_SCP_TIMEOUT_SECONDS = 240
+CONSENSUS_STUCK_TIMEOUT_SECONDS = 35
+MAX_TIME_SLIP_SECONDS = 60
+NODE_EXPIRATION_SECONDS = 240
+LEDGER_VALIDITY_BRACKET = 100        # max slots ahead we accept
+MAX_SLOTS_TO_REMEMBER = 12
+
+ENVELOPE_TYPE_SCP = 1  # reference: Stellar-ledger-entries.x — EnvelopeType
+
+
+class HerderState:
+    # Reference: Herder::State
+    BOOTING = "booting"
+    SYNCING = "syncing"
+    TRACKING = "tracking"
+
+
+class Herder(SCPDriver):
+    """One node's consensus engine.
+
+    Wiring: `broadcast` is injected by the overlay (or the in-process
+    simulation bus); `out_of_sync_handler` is the catchup handoff.
+    """
+
+    def __init__(self, clock: VirtualClock, ledger_manager,
+                 secret: SecretKey, qset,
+                 is_validator: bool = True,
+                 upgrades: Optional[Upgrades] = None):
+        self.clock = clock
+        self.lm = ledger_manager
+        self.secret = secret
+        self.node_id = secret.public_key.ed25519
+        self.network_id = ledger_manager.network_id
+        self.is_validator = is_validator
+        self.upgrades = upgrades or Upgrades()
+
+        self.scp = SCP(self, self.node_id, is_validator, qset)
+        self.pending = PendingEnvelopes()
+        self.tx_queue = TransactionQueue(ledger_manager)
+        self.quorum_tracker = QuorumTracker(self.node_id)
+        self.pending.add_qset(qset)
+
+        self.state = HerderState.BOOTING
+        self.broadcast: Callable[[object], None] = lambda env: None
+        self.tx_flood: Callable[[object], None] = lambda frame: None
+        self.out_of_sync_handler: Callable[[], None] = lambda: None
+        self.ledger_closed_hook: Callable[[object], None] = lambda arts: None
+
+        self._timers: Dict[Tuple[int, int], VirtualTimer] = {}
+        self._trigger_timer: Optional[VirtualTimer] = None
+        self._last_trigger_at: float = clock.now()
+        # slot -> externalized StellarValue waiting for its ledger turn
+        self._buffered: Dict[int, X.StellarValue] = {}
+        self._processing_ready = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def bootstrap(self) -> None:
+        """Go live assuming the LCL is current (standalone/test networks).
+        Reference: HerderImpl::bootstrap (FORCE_SCP path)."""
+        self.state = HerderState.TRACKING
+        self._last_trigger_at = self.clock.now()
+        self.trigger_next_ledger(self.tracking_consensus_ledger_index() + 1)
+
+    def start(self) -> None:
+        """Go live and wait for consensus traffic before participating.
+        Reference: HerderImpl::start/restoreState."""
+        self.state = HerderState.SYNCING
+
+    def tracking_consensus_ledger_index(self) -> int:
+        return self.lm.last_closed_ledger_seq
+
+    def next_ledger_index(self) -> int:
+        return self.tracking_consensus_ledger_index() + 1
+
+    # ------------------------------------------------------------------
+    # intake (called by overlay / HTTP / simulation)
+    # ------------------------------------------------------------------
+    def recv_scp_envelope(self, env) -> str:
+        st = env.statement
+        slot = st.slotIndex
+        lcl = self.tracking_consensus_ledger_index()
+        if slot <= lcl - MAX_SLOTS_TO_REMEMBER or \
+                slot > lcl + LEDGER_VALIDITY_BRACKET:
+            return ENVELOPE_STATUS_DISCARDED
+        if not self.verify_envelope(env):
+            return ENVELOPE_STATUS_DISCARDED
+        status = self.pending.recv_envelope(env)
+        if status == ENVELOPE_STATUS_READY:
+            self._process_scp_queue()
+        return status
+
+    def recv_tx_set(self, txset_hash: bytes, txset) -> bool:
+        """Reference: HerderImpl::recvTxSet."""
+        frames = [self.lm.make_frame(e) for e in txset.txs]
+        if sha256(txset.to_xdr()) != txset_hash:
+            return False
+        self.pending.add_txset(txset_hash, txset, frames)
+        self._process_scp_queue()
+        return True
+
+    def recv_qset(self, qset) -> bool:
+        """Reference: HerderImpl::recvSCPQuorumSet."""
+        ok = self.pending.add_qset(qset)
+        if ok:
+            self._process_scp_queue()
+        return ok
+
+    def recv_transaction(self, frame) -> AddResult:
+        """Reference: HerderImpl::recvTransaction (from /tx or overlay).
+        Newly-pending txs are flooded to peers (overlay broadcast; pull-mode
+        adverts once the TCP overlay is wired)."""
+        res = self.tx_queue.try_add(frame)
+        if res.code == AddResult.STATUS_PENDING:
+            self.tx_flood(frame)
+        return res
+
+    def _process_scp_queue(self) -> None:
+        if self._processing_ready:
+            return
+        self._processing_ready = True
+        try:
+            progressed = True
+            while progressed:
+                progressed = False
+                for slot in self.pending.ready_slots():
+                    for env in self.pending.pop_ready(slot):
+                        self._track_qset(env.statement)
+                        self.scp.receive_envelope(env)
+                        progressed = True
+        finally:
+            self._processing_ready = False
+
+    def _track_qset(self, st) -> None:
+        from .pending_envelopes import statement_qset_hash
+        q = self.pending.get_qset(statement_qset_hash(st))
+        if q is not None:
+            if not self.quorum_tracker.expand(st.nodeID.value, q):
+                self.quorum_tracker.rebuild(self._qset_of_node)
+
+    def _qset_of_node(self, node_id: bytes):
+        if node_id == self.node_id:
+            return self.scp.local_node.qset
+        env = None
+        for slot_idx in sorted(self.scp.slots, reverse=True):
+            env = self.scp.slots[slot_idx].get_latest_message(node_id)
+            if env is not None:
+                break
+        if env is None:
+            return None
+        from .pending_envelopes import statement_qset_hash
+        return self.pending.get_qset(statement_qset_hash(env.statement))
+
+    # ------------------------------------------------------------------
+    # consensus drive
+    # ------------------------------------------------------------------
+    def trigger_next_ledger(self, seq: int) -> None:
+        """Nominate a value for `seq`.  Reference:
+        HerderImpl::triggerNextLedger."""
+        if not self.is_validator or self.state != HerderState.TRACKING:
+            return
+        if seq != self.next_ledger_index():
+            return
+        self._last_trigger_at = self.clock.now()
+        frames = self.tx_queue.tx_set_frames()
+        tx_set, tx_set_hash, _ordered = self.lm.make_tx_set(frames)
+        self.pending.add_txset(tx_set_hash, tx_set,
+                               sorted(frames, key=lambda f: f.content_hash()))
+
+        lcl = self.lm.lcl_header
+        close_time = max(self.clock.system_now(), lcl.scpValue.closeTime + 1)
+        ups = self.upgrades.create_upgrades_for(lcl, close_time)
+        sv = X.StellarValue(txSetHash=tx_set_hash, closeTime=close_time,
+                            upgrades=ups)
+        prev = lcl.scpValue.to_xdr()
+        self.scp.nominate(seq, sv.to_xdr(), prev)
+
+    # ------------------------------------------------------------------
+    # SCPDriver: value semantics
+    # ------------------------------------------------------------------
+    def _decode_value(self, value: bytes) -> Optional[X.StellarValue]:
+        try:
+            return X.StellarValue.from_xdr(value)
+        except Exception:
+            return None
+
+    def validate_value(self, slot_index: int, value: bytes,
+                       nomination: bool) -> ValidationLevel:
+        """Reference: HerderSCPDriver::validateValue/validateValueHelper."""
+        sv = self._decode_value(value)
+        if sv is None:
+            return ValidationLevel.INVALID
+        lcl = self.lm.lcl_header
+        next_seq = self.next_ledger_index()
+        if slot_index == next_seq:
+            if sv.closeTime <= lcl.scpValue.closeTime:
+                return ValidationLevel.INVALID
+            if sv.closeTime > self.clock.system_now() + MAX_TIME_SLIP_SECONDS:
+                return ValidationLevel.INVALID
+        got = self.pending.get_txset(sv.txSetHash)
+        if got is None:
+            # can't fully check yet (tx set still fetching)
+            return ValidationLevel.MAYBE_VALID
+        txset, _frames = got
+        if slot_index == next_seq \
+                and txset.previousLedgerHash != self.lm.lcl_hash:
+            return ValidationLevel.INVALID
+        for up in sv.upgrades:
+            if not self.upgrades.is_valid(up, lcl, nomination=nomination,
+                                          close_time=sv.closeTime):
+                if nomination:
+                    return ValidationLevel.INVALID
+                # ballot: tolerate upgrades we don't want but others voted
+                if not self.upgrades.is_valid(up, lcl, nomination=False):
+                    return ValidationLevel.INVALID
+        return ValidationLevel.FULLY_VALIDATED
+
+    def extract_valid_value(self, slot_index: int,
+                            value: bytes) -> Optional[bytes]:
+        """Strip invalid upgrades (reference:
+        HerderSCPDriver::extractValidValue)."""
+        sv = self._decode_value(value)
+        if sv is None:
+            return None
+        lcl = self.lm.lcl_header
+        kept = [u for u in sv.upgrades
+                if self.upgrades.is_valid(u, lcl, nomination=True,
+                                          close_time=sv.closeTime)]
+        if self.validate_value(slot_index, value, True) == \
+                ValidationLevel.INVALID:
+            return None
+        sv2 = X.StellarValue(txSetHash=sv.txSetHash, closeTime=sv.closeTime,
+                             upgrades=kept)
+        return sv2.to_xdr()
+
+    def combine_candidates(self, slot_index: int,
+                           candidates: List[bytes]) -> Optional[bytes]:
+        """Reference: HerderSCPDriver::combineCandidates — highest
+        closeTime; the tx set with most operations (hash tiebreak);
+        upgrades merged per type taking the max parameter."""
+        best_sv = None
+        best_key = None
+        max_ct = 0
+        upgrades_by_type: Dict[int, bytes] = {}
+        for cand in candidates:
+            sv = self._decode_value(cand)
+            if sv is None:
+                continue
+            max_ct = max(max_ct, sv.closeTime)
+            got = self.pending.get_txset(sv.txSetHash)
+            n_ops = 0
+            if got is not None:
+                _txset, frames = got
+                n_ops = sum(f.num_operations() for f in frames)
+            key = (n_ops, sv.txSetHash)
+            if best_key is None or key > best_key:
+                best_key, best_sv = key, sv
+            for u in sv.upgrades:
+                try:
+                    up = X.LedgerUpgrade.from_xdr(u)
+                except Exception:
+                    continue
+                t = int(up.switch)
+                cur = upgrades_by_type.get(t)
+                if cur is None or X.LedgerUpgrade.from_xdr(cur).value < up.value:
+                    upgrades_by_type[t] = u
+        if best_sv is None:
+            return None
+        out = X.StellarValue(
+            txSetHash=best_sv.txSetHash, closeTime=max_ct,
+            upgrades=[upgrades_by_type[t]
+                      for t in sorted(upgrades_by_type)])
+        return out.to_xdr()
+
+    # ------------------------------------------------------------------
+    # SCPDriver: plumbing
+    # ------------------------------------------------------------------
+    def get_qset(self, qset_hash: bytes):
+        if qset_hash == self.scp.local_node.qset_hash:
+            return self.scp.local_node.qset
+        return self.pending.get_qset(qset_hash)
+
+    def emit_envelope(self, envelope) -> None:
+        self.broadcast(envelope)
+
+    def _envelope_payload(self, statement) -> bytes:
+        # Reference: HerderSCPDriver::signEnvelope — sign over
+        # (networkID, ENVELOPE_TYPE_SCP, statement)
+        return (self.network_id + struct.pack(">i", ENVELOPE_TYPE_SCP)
+                + statement.to_xdr())
+
+    def sign_envelope(self, envelope) -> None:
+        envelope.signature = self.secret.sign(
+            self._envelope_payload(envelope.statement))
+
+    def verify_envelope(self, envelope) -> bool:
+        try:
+            return keys.verify_sig(
+                keys.PublicKey(envelope.statement.nodeID.value),
+                envelope.signature,
+                self._envelope_payload(envelope.statement))
+        except Exception:
+            return False
+
+    def setup_timer(self, slot_index: int, timer_id: int, timeout: float,
+                    callback) -> None:
+        key = (slot_index, timer_id)
+        t = self._timers.pop(key, None)
+        if t is not None:
+            t.cancel()
+        if callback is None:
+            return
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(timeout, callback)
+        self._timers[key] = t
+
+    # ------------------------------------------------------------------
+    # externalization → ledger close
+    # ------------------------------------------------------------------
+    def value_externalized(self, slot_index: int, value: bytes) -> None:
+        """Reference: HerderImpl::valueExternalized →
+        LedgerManager::valueExternalized; out-of-order slots are buffered
+        (CatchupManager::processLedger) and drained in sequence."""
+        sv = self._decode_value(value)
+        if sv is None:
+            log.error("externalized undecodable value at slot %d", slot_index)
+            return
+        lcl = self.tracking_consensus_ledger_index()
+        if slot_index <= lcl:
+            return
+        self._buffered[slot_index] = sv
+        self.state = HerderState.TRACKING if slot_index == lcl + 1 \
+            else self.state
+        self._drain_buffered()
+
+    def _drain_buffered(self) -> None:
+        while True:
+            nxt = self.tracking_consensus_ledger_index() + 1
+            sv = self._buffered.pop(nxt, None)
+            if sv is None:
+                break
+            got = self.pending.get_txset(sv.txSetHash)
+            if got is None:
+                # externalized a tx set we never fetched: must catch up
+                self._buffered[nxt] = sv
+                self._lost_sync()
+                return
+            txset, frames = got
+            arts = self.lm.close_ledger(frames, sv.closeTime, tx_set=txset,
+                                        stellar_value=sv)
+            self.state = HerderState.TRACKING
+            self.ledger_closed_hook(arts)
+            self.tx_queue.remove_applied(frames)
+            self.tx_queue.shift()
+            seq = self.lm.last_closed_ledger_seq
+            self.scp.purge_slots(seq + 1 - MAX_SLOTS_TO_REMEMBER
+                                 if seq + 1 > MAX_SLOTS_TO_REMEMBER else 0,
+                                 keep=0)
+            self.pending.erase_below(seq + 1 - MAX_SLOTS_TO_REMEMBER
+                                     if seq + 1 > MAX_SLOTS_TO_REMEMBER else 0)
+            self._arm_trigger(seq + 1)
+        if self._buffered and min(self._buffered) > \
+                self.tracking_consensus_ledger_index() + 1:
+            self._lost_sync()
+
+    def _lost_sync(self) -> None:
+        if self.state != HerderState.SYNCING:
+            log.warning("herder out of sync at lcl=%d buffered=%s",
+                        self.tracking_consensus_ledger_index(),
+                        sorted(self._buffered))
+            self.state = HerderState.SYNCING
+            self.out_of_sync_handler()
+
+    def _arm_trigger(self, next_seq: int) -> None:
+        """Arm the ledger trigger so consensus rounds pace at
+        EXP_LEDGER_TIMESPAN_SECONDS.  Reference: HerderImpl::
+        ledgerClosed → triggerNextLedger timer."""
+        if not self.is_validator:
+            return
+        if self._trigger_timer is not None:
+            self._trigger_timer.cancel()
+        due = self._last_trigger_at + EXP_LEDGER_TIMESPAN_SECONDS
+        delay = max(0.0, due - self.clock.now())
+        self._trigger_timer = VirtualTimer(self.clock)
+        self._trigger_timer.expires_from_now(
+            delay, lambda: self.trigger_next_ledger(next_seq))
+
+    # ------------------------------------------------------------------
+    # SCP state sync (peer (re)connect / out-of-sync recovery)
+    # ------------------------------------------------------------------
+    def get_scp_state(self, from_seq: int) -> List:
+        """Latest envelopes for every remembered slot >= from_seq, for
+        bringing a lagging peer up to date.  Reference:
+        HerderImpl::getSCPState / sendSCPStateToPeer (on peer auth) and
+        getMoreSCPState (out-of-sync node pulling)."""
+        out: List = []
+        for idx in sorted(self.scp.slots):
+            if idx >= from_seq:
+                out.extend(self.scp.slots[idx].get_current_state())
+        return out
+
+    # ------------------------------------------------------------------
+    # introspection (CLI/HTTP)
+    # ------------------------------------------------------------------
+    def get_state_human(self) -> str:
+        return self.state
+
+    def quorum_map(self) -> Dict[bytes, Optional[object]]:
+        m = {}
+        for nid in self.quorum_tracker.known_map():
+            m[nid] = self._qset_of_node(nid)
+        return m

@@ -1,0 +1,166 @@
+"""PendingEnvelopes — SCP envelope intake: hold envelopes until their
+referenced quorum sets and tx sets are available, fetching missing items.
+
+Reference: src/herder/PendingEnvelopes.{h,cpp} — recvSCPEnvelope,
+recvSCPQuorumSet, recvTxSet, envelope state machine (FETCHING/READY/
+PROCESSED), caches; src/overlay/ItemFetcher.h — hash-addressed fetch
+(the fetch transport is a callback here; overlay wires it to peers).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .. import xdr as X
+from ..scp.quorum import is_qset_sane, qset_hash
+from ..util import logging as slog
+from ..util.cache import RandomEvictionCache
+
+log = slog.get("Herder")
+
+# envelope intake verdicts (reference: Herder::EnvelopeStatus)
+ENVELOPE_STATUS_DISCARDED = "discarded"
+ENVELOPE_STATUS_FETCHING = "fetching"
+ENVELOPE_STATUS_READY = "ready"
+ENVELOPE_STATUS_PROCESSED = "processed"
+
+QSET_CACHE_SIZE = 10000
+TXSET_CACHE_SIZE = 10000
+
+
+def statement_qset_hash(st) -> bytes:
+    from ..xdr import scp as SX
+    pl = st.pledges
+    t = pl.type
+    if t == SX.SCPStatementType.SCP_ST_NOMINATE:
+        return pl.nominate.quorumSetHash
+    if t == SX.SCPStatementType.SCP_ST_PREPARE:
+        return pl.prepare.quorumSetHash
+    if t == SX.SCPStatementType.SCP_ST_CONFIRM:
+        return pl.confirm.quorumSetHash
+    return pl.externalize.commitQuorumSetHash
+
+
+def statement_values(st) -> List[bytes]:
+    """All StellarValue blobs referenced by a statement.
+    Reference: Slot::getStatementValues."""
+    from ..xdr import scp as SX
+    pl = st.pledges
+    t = pl.type
+    if t == SX.SCPStatementType.SCP_ST_NOMINATE:
+        return list(pl.nominate.votes) + list(pl.nominate.accepted)
+    if t == SX.SCPStatementType.SCP_ST_PREPARE:
+        out = [pl.prepare.ballot.value]
+        if pl.prepare.prepared is not None:
+            out.append(pl.prepare.prepared.value)
+        if pl.prepare.preparedPrime is not None:
+            out.append(pl.prepare.preparedPrime.value)
+        return out
+    if t == SX.SCPStatementType.SCP_ST_CONFIRM:
+        return [pl.confirm.ballot.value]
+    return [pl.externalize.commit.value]
+
+
+def statement_txset_hashes(st) -> List[bytes]:
+    """Tx set hashes referenced by a statement's StellarValues (malformed
+    values are reported by validation later, not here)."""
+    out = []
+    for v in statement_values(st):
+        try:
+            sv = X.StellarValue.from_xdr(v)
+            out.append(sv.txSetHash)
+        except Exception:
+            pass
+    return out
+
+
+class PendingEnvelopes:
+    def __init__(self,
+                 fetch_qset: Optional[Callable[[bytes], None]] = None,
+                 fetch_txset: Optional[Callable[[bytes], None]] = None):
+        # hash -> SCPQuorumSet / (TransactionSet, frames)
+        self.qsets = RandomEvictionCache(QSET_CACHE_SIZE)
+        self.txsets = RandomEvictionCache(TXSET_CACHE_SIZE)
+        self.fetch_qset = fetch_qset or (lambda h: None)
+        self.fetch_txset = fetch_txset or (lambda h: None)
+        # slot -> list of (env, missing_qset_hashes, missing_txset_hashes)
+        self.fetching: Dict[int, List] = {}
+        self.ready: Dict[int, List] = {}
+        self.processed_index: Set[bytes] = set()  # env xdr hashes seen
+
+    # -- item intake ------------------------------------------------------
+    def add_qset(self, qset) -> bool:
+        """Reference: PendingEnvelopes::recvSCPQuorumSet (+ sanity gate)."""
+        if not is_qset_sane(qset):
+            return False
+        self.qsets.put(qset_hash(qset), qset)
+        self._recheck()
+        return True
+
+    def add_txset(self, txset_hash: bytes, txset, frames) -> None:
+        """Reference: PendingEnvelopes::recvTxSet."""
+        self.txsets.put(txset_hash, (txset, frames))
+        self._recheck()
+
+    def get_qset(self, h: bytes):
+        return self.qsets.get(h)
+
+    def get_txset(self, h: bytes):
+        got = self.txsets.get(h)
+        return got if got is not None else None
+
+    # -- envelope intake --------------------------------------------------
+    def recv_envelope(self, env) -> str:
+        """Returns an ENVELOPE_STATUS_*.  READY envelopes are queued in
+        self.ready[slot] for the herder to pop."""
+        slot = env.statement.slotIndex
+        missing_q, missing_t = self._missing(env.statement)
+        if not missing_q and not missing_t:
+            self.ready.setdefault(slot, []).append(env)
+            return ENVELOPE_STATUS_READY
+        for h in missing_q:
+            self.fetch_qset(h)
+        for h in missing_t:
+            self.fetch_txset(h)
+        self.fetching.setdefault(slot, []).append(env)
+        return ENVELOPE_STATUS_FETCHING
+
+    def _missing(self, st) -> Tuple[List[bytes], List[bytes]]:
+        missing_q = []
+        qh = statement_qset_hash(st)
+        if self.qsets.get(qh) is None:
+            missing_q.append(qh)
+        missing_t = [h for h in statement_txset_hashes(st)
+                     if self.txsets.get(h) is None]
+        return missing_q, missing_t
+
+    def _recheck(self) -> None:
+        for slot in list(self.fetching):
+            still = []
+            for env in self.fetching[slot]:
+                mq, mt = self._missing(env.statement)
+                if not mq and not mt:
+                    self.ready.setdefault(slot, []).append(env)
+                else:
+                    still.append(env)
+            if still:
+                self.fetching[slot] = still
+            else:
+                del self.fetching[slot]
+
+    def pop_ready(self, slot: int) -> List:
+        return self.ready.pop(slot, [])
+
+    def has_ready(self) -> bool:
+        return any(self.ready.values())
+
+    def ready_slots(self) -> List[int]:
+        return sorted(self.ready)
+
+    # -- slot GC ----------------------------------------------------------
+    def erase_below(self, slot: int) -> None:
+        """Reference: PendingEnvelopes::eraseBelow (keep caches; drop
+        per-slot pending envelopes)."""
+        for d in (self.fetching, self.ready):
+            for s in [s for s in d if s < slot]:
+                del d[s]

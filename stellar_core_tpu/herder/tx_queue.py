@@ -1,0 +1,173 @@
+"""TransactionQueue — pending transactions between submission and inclusion.
+
+Reference: src/herder/TransactionQueue.{h,cpp} — tryAdd (checkValid gating,
+one pending tx per source account, fee-bump replace-by-fee at >=10x), ban
+list with ban depth, size limiting with lowest-fee eviction, removeApplied /
+shift after ledger close; src/herder/TxSetUtils — surge pricing (sort by
+fee-per-op, trim to the ledger's op limit).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from .. import xdr as X
+from ..ledger.ledger_txn import LedgerTxn
+from ..transactions.frame import TransactionFrame
+from ..util import logging as slog
+
+log = slog.get("Herder")
+
+# Reference: TransactionQueue.h
+FEE_MULTIPLIER = 10          # replace-by-fee bump factor
+BAN_DEPTH = 10               # ledgers a banned tx stays banned
+QUEUE_SIZE_MULTIPLIER = 4    # pool size = multiplier * max ledger ops
+
+
+class AddResult:
+    # Reference: TransactionQueue::AddResult::Code
+    STATUS_PENDING = "pending"
+    STATUS_DUPLICATE = "duplicate"
+    STATUS_ERROR = "error"
+    STATUS_TRY_AGAIN_LATER = "try-again-later"
+    STATUS_BANNED = "banned"
+    STATUS_FILTERED = "filtered"
+
+    def __init__(self, code: str, result=None):
+        self.code = code
+        self.result = result
+
+    def __repr__(self):
+        return f"AddResult({self.code})"
+
+
+def fee_per_op(frame: TransactionFrame) -> float:
+    return frame.fee_bid / max(frame.num_operations(), 1)
+
+
+def surge_sort_key(frame: TransactionFrame):
+    """Surge pricing order: highest fee-per-op first, tx hash as the
+    deterministic tiebreak (reference: TxSetUtils — feeRate comparison)."""
+    return (-fee_per_op(frame), frame.content_hash())
+
+
+class TransactionQueue:
+    def __init__(self, ledger_manager, pool_ledger_multiplier: int =
+                 QUEUE_SIZE_MULTIPLIER):
+        self.lm = ledger_manager
+        self.pool_multiplier = pool_ledger_multiplier
+        # source account id bytes -> frame (ONE pending tx per account)
+        self.by_account: Dict[bytes, TransactionFrame] = {}
+        self.by_hash: Dict[bytes, TransactionFrame] = {}
+        # banned tx hash -> ledgers remaining
+        self.banned: Dict[bytes, int] = {}
+
+    # ------------------------------------------------------------------
+    def _account_key(self, frame: TransactionFrame) -> bytes:
+        return frame.source_account_id().to_xdr()
+
+    def _max_queue_size(self) -> int:
+        return self.pool_multiplier * max(
+            self.lm.lcl_header.maxTxSetSize, 1)
+
+    def try_add(self, frame: TransactionFrame,
+                close_time: Optional[int] = None) -> AddResult:
+        """Validate and enqueue.  Reference: TransactionQueue::tryAdd."""
+        h = frame.content_hash()
+        if h in self.banned:
+            return AddResult(AddResult.STATUS_BANNED)
+        if h in self.by_hash:
+            return AddResult(AddResult.STATUS_DUPLICATE)
+
+        akey = self._account_key(frame)
+        existing = self.by_account.get(akey)
+        if existing is not None:
+            # replace-by-fee: same account needs >= FEE_MULTIPLIER x fee
+            # (full fee comparison; reference compares fee bids)
+            if frame.fee_bid < FEE_MULTIPLIER * existing.fee_bid:
+                return AddResult(AddResult.STATUS_TRY_AGAIN_LATER)
+
+        ct = close_time if close_time is not None \
+            else self.lm.lcl_header.scpValue.closeTime
+        with LedgerTxn(self.lm.root) as ltx:  # read-only: rolls back on exit
+            res = frame.check_valid(ltx, ct)
+        if res.result.switch != X.TransactionResultCode.txSUCCESS:
+            return AddResult(AddResult.STATUS_ERROR, res)
+
+        if existing is not None:
+            self._drop(existing)
+        elif len(self.by_hash) >= self._max_queue_size():
+            victim = min(self.by_hash.values(), key=fee_per_op)
+            if fee_per_op(victim) >= fee_per_op(frame):
+                return AddResult(AddResult.STATUS_TRY_AGAIN_LATER)
+            self._drop(victim)
+            self.banned[victim.content_hash()] = BAN_DEPTH
+
+        self.by_account[akey] = frame
+        self.by_hash[h] = frame
+        return AddResult(AddResult.STATUS_PENDING)
+
+    def _drop(self, frame: TransactionFrame) -> None:
+        self.by_hash.pop(frame.content_hash(), None)
+        akey = self._account_key(frame)
+        if self.by_account.get(akey) is frame:
+            del self.by_account[akey]
+
+    # ------------------------------------------------------------------
+    def remove_applied(self, frames: Sequence[TransactionFrame]) -> None:
+        """Drop txs included in the last closed ledger.
+        Reference: TransactionQueue::removeApplied."""
+        for f in frames:
+            got = self.by_hash.get(f.content_hash())
+            if got is not None:
+                self._drop(got)
+            else:
+                # a different tx from the same account was applied: ours is
+                # now stale (bad seq) — drop it too
+                mine = self.by_account.get(self._account_key(f))
+                if mine is not None and mine.seq_num <= f.seq_num:
+                    self._drop(mine)
+
+    def ban(self, frames: Sequence[TransactionFrame]) -> None:
+        for f in frames:
+            self.banned[f.content_hash()] = BAN_DEPTH
+            got = self.by_hash.get(f.content_hash())
+            if got is not None:
+                self._drop(got)
+
+    def shift(self) -> None:
+        """Age the ban list one ledger.  Reference: TransactionQueue::shift."""
+        for h in list(self.banned):
+            self.banned[h] -= 1
+            if self.banned[h] <= 0:
+                del self.banned[h]
+
+    def is_banned(self, tx_hash: bytes) -> bool:
+        return tx_hash in self.banned
+
+    # ------------------------------------------------------------------
+    def get_transactions(self) -> List[TransactionFrame]:
+        return list(self.by_hash.values())
+
+    def tx_set_frames(self, max_ops: Optional[int] = None
+                      ) -> List[TransactionFrame]:
+        """Candidate tx set under surge pricing: best fee-per-op first,
+        trimmed to the ledger operation limit.  Reference:
+        TxSetUtils/TxSetFrame — surge pricing + trimInvalid."""
+        header = self.lm.lcl_header
+        limit = max_ops if max_ops is not None else header.maxTxSetSize
+        # protocol >= 11 counts operations; earlier protocols count txs
+        count_ops = header.ledgerVersion >= 11
+        out: List[TransactionFrame] = []
+        used = 0
+        for f in sorted(self.by_hash.values(), key=surge_sort_key):
+            cost = f.num_operations() if count_ops else 1
+            if used + cost > limit:
+                continue
+            out.append(f)
+            used += cost
+        return out
+
+    @property
+    def size(self) -> int:
+        return len(self.by_hash)

@@ -110,25 +110,36 @@ class LedgerManager:
     def close_ledger(self, frames: Sequence[TransactionFrame],
                      close_time: int,
                      tx_set: Optional[X.TransactionSet] = None,
-                     expected_ledger_hash: Optional[bytes] = None
+                     expected_ledger_hash: Optional[bytes] = None,
+                     stellar_value: Optional[X.StellarValue] = None
                      ) -> ClosedLedgerArtifacts:
         """Apply one ledger.  `frames` may arrive unsorted; the canonical
         order is derived.  If expected_ledger_hash is given (catchup replay),
-        a mismatch raises — fail-stop, like the reference's hash checks."""
+        a mismatch raises — fail-stop, like the reference's hash checks.
+        `stellar_value` is the externalized consensus value (carries voted
+        upgrades, applied after the tx phase — reference:
+        LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
         assert self.root is not None, "start_new_ledger/load first"
         if tx_set is None:
             tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
         else:
             ordered = sorted(frames, key=lambda f: f.content_hash())
             tx_set_hash = sha256(tx_set.to_xdr())
+        if stellar_value is not None:
+            if stellar_value.txSetHash != tx_set_hash:
+                # fail-stop: committing a header that names a tx set other
+                # than the one applied would corrupt the hash chain
+                raise RuntimeError(
+                    "externalized value names a different tx set")
+            close_time = stellar_value.closeTime
 
         seq = self.lcl_header.ledgerSeq + 1
         ltx = LedgerTxn(self.root)
         header = ltx.load_header()
         header.ledgerSeq = seq
         header.previousLedgerHash = self.lcl_hash
-        header.scpValue = X.StellarValue(txSetHash=tx_set_hash,
-                                         closeTime=close_time)
+        header.scpValue = stellar_value if stellar_value is not None else \
+            X.StellarValue(txSetHash=tx_set_hash, closeTime=close_time)
         ltx.commit_header(header)
 
         # phase 1: fees + seq nums for every tx, before any applies
@@ -147,6 +158,15 @@ class LedgerManager:
         result_set = X.TransactionResultSet(results=result_pairs)
         header = ltx.load_header()
         header.txSetResultHash = sha256(result_set.to_xdr())
+
+        # voted upgrades apply after the tx phase (reference: applyLedger →
+        # Upgrades::applyTo, which re-validates and skips-with-log rather
+        # than crashing mid-close; skipping is deterministic so live close
+        # and catchup replay stay hash-identical)
+        if stellar_value is not None and stellar_value.upgrades:
+            from ..herder.upgrades import Upgrades
+            for up in stellar_value.upgrades:
+                Upgrades.apply_to_checked(up, header)
         ltx.commit_header(header)
 
         # split delta into INIT/LIVE/DEAD vs the pre-close state

@@ -231,7 +231,10 @@ class NominationProtocol:
                 self.votes.add(v)
                 modified = True
 
-        if modified and not self_env:
+        if modified:
+            # also on self_env: accepting values while processing our own
+            # statement must still be announced (the recursion terminates —
+            # votes/accepted only grow, and unchanged state isn't re-emitted)
             self._emit_nomination()
         if new_candidates:
             composite = self.slot.driver.combine_candidates(

@@ -1,0 +1,10 @@
+"""Simulation layer: in-process multi-node networks + load generation.
+
+Reference: src/simulation/ (SURVEY.md §2.1).
+"""
+
+from .loadgen import LoadGenerator
+from .simulation import SimNode, Simulation, make_core_topology, qset_of
+
+__all__ = ["LoadGenerator", "SimNode", "Simulation", "make_core_topology",
+           "qset_of"]

@@ -1,0 +1,215 @@
+"""Simulation — N full in-process nodes on one shared VirtualClock.
+
+Reference: src/simulation/Simulation.{h,cpp} — addNode, addPendingConnection,
+startAllNodes, crankUntil/crankForAtLeast, Topologies (src/simulation/
+Topologies.cpp — core, cycle, hierarchical); nodes wired over loopback.
+This is THE deterministic multi-node test pattern (SURVEY.md §4): no
+threads, no sockets, no wall clock — every message delivery is a posted
+clock action, every timeout is virtual.
+
+Until the TCP overlay lands, message transport is a direct loopback bus:
+broadcast posts delivery actions to every peer; hash-addressed item fetch
+(tx sets / qsets) asks peers' caches asynchronously, standing in for
+overlay ItemFetcher round-trips with the same observable semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .. import xdr as X
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..herder.herder import Herder, HerderState
+from ..herder.upgrades import Upgrades
+from ..ledger.manager import LedgerManager
+from ..scp.quorum import qset_hash
+from ..util import logging as slog
+from ..util.clock import ClockMode, VirtualClock
+from ..xdr import scp as SX
+from ..xdr import types as XT
+
+log = slog.get("Herder")
+
+
+class SimNode:
+    """One in-process validator: ledger manager + herder (+ history later).
+    Reference analog: a full Application instance inside Simulation."""
+
+    def __init__(self, sim: "Simulation", secret: SecretKey, qset,
+                 is_validator: bool = True,
+                 upgrades: Optional[Upgrades] = None):
+        self.sim = sim
+        self.secret = secret
+        self.node_id = secret.public_key.ed25519
+        self.lm = LedgerManager(sim.network_id)
+        self.lm.start_new_ledger()
+        self.herder = Herder(sim.clock, self.lm, secret, qset,
+                             is_validator=is_validator, upgrades=upgrades)
+        self.herder.broadcast = self._broadcast
+        self.herder.tx_flood = self._tx_flood
+        self.herder.pending.fetch_qset = self._fetch_qset
+        self.herder.pending.fetch_txset = self._fetch_txset
+        self.partition = 0  # nodes only hear peers in the same partition
+        self.closed: Dict[int, bytes] = {}  # seq -> ledger hash
+        self.herder.ledger_closed_hook = self._on_ledger_closed
+        self.herder.out_of_sync_handler = self._on_out_of_sync
+
+    def _on_out_of_sync(self) -> None:
+        # pull recent SCP state from peers (reference: getMoreSCPState;
+        # archive-based catchup takes over when the gap exceeds
+        # MAX_SLOTS_TO_REMEMBER)
+        self.sim.request_scp_state(self)
+
+    def _on_ledger_closed(self, arts) -> None:
+        self.closed[arts.header_entry.header.ledgerSeq] = arts.header_entry.hash
+
+    # -- transport ---------------------------------------------------------
+    def _broadcast(self, env) -> None:
+        self.sim.broadcast_from(self, env)
+
+    def _tx_flood(self, frame) -> None:
+        # epidemic flooding with dedup: peers re-flood only on first sight
+        # (STATUS_PENDING), mirroring Floodgate semantics
+        for peer in self.sim._reachable(self):
+            self.sim.clock.post_action(
+                lambda p=peer, f=frame: p.herder.recv_transaction(f),
+                name="flood-tx")
+
+    def _fetch_qset(self, h: bytes) -> None:
+        self.sim.fetch_item(self, "qset", h)
+
+    def _fetch_txset(self, h: bytes) -> None:
+        self.sim.fetch_item(self, "txset", h)
+
+    # -- convenience -------------------------------------------------------
+    @property
+    def lcl(self) -> int:
+        return self.lm.last_closed_ledger_seq
+
+    @property
+    def lcl_hash(self) -> bytes:
+        return self.lm.lcl_hash
+
+    def submit(self, frame) -> object:
+        return self.herder.recv_transaction(frame)
+
+
+class Simulation:
+    OVER_LOOPBACK = "loopback"
+
+    def __init__(self, network_passphrase: bytes = b"sim network",
+                 mode: str = OVER_LOOPBACK):
+        self.network_id = sha256(network_passphrase)
+        self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        self.nodes: List[SimNode] = []
+        self.by_id: Dict[bytes, SimNode] = {}
+        self.dropped_messages = 0
+
+    # -- topology ----------------------------------------------------------
+    def add_node(self, secret: SecretKey, qset,
+                 is_validator: bool = True,
+                 upgrades: Optional[Upgrades] = None) -> SimNode:
+        node = SimNode(self, secret, qset, is_validator, upgrades)
+        self.nodes.append(node)
+        self.by_id[node.node_id] = node
+        return node
+
+    def start_all_nodes(self) -> None:
+        for n in self.nodes:
+            if n.herder.is_validator:
+                n.herder.bootstrap()
+            else:
+                n.herder.start()
+
+    # -- transport ---------------------------------------------------------
+    def _reachable(self, src: SimNode) -> List[SimNode]:
+        return [n for n in self.nodes
+                if n is not src and n.partition == src.partition]
+
+    def broadcast_from(self, src: SimNode, env) -> None:
+        for peer in self._reachable(src):
+            self.clock.post_action(
+                lambda p=peer, e=env: p.herder.recv_scp_envelope(e),
+                name="deliver-scp")
+
+    def fetch_item(self, requester: SimNode, kind: str, h: bytes) -> None:
+        """Async hash-addressed fetch from any reachable peer (stands in
+        for overlay ItemFetcher; one posted round-trip of latency)."""
+        def attempt():
+            for peer in self._reachable(requester):
+                if kind == "qset":
+                    q = peer.herder.get_qset(h)
+                    if q is not None:
+                        requester.herder.recv_qset(q)
+                        return
+                else:
+                    got = peer.herder.pending.get_txset(h)
+                    if got is not None:
+                        requester.herder.recv_tx_set(h, got[0])
+                        return
+            self.dropped_messages += 1
+        self.clock.post_action(attempt, name=f"fetch-{kind}")
+
+    def request_scp_state(self, requester: SimNode) -> None:
+        """Deliver peers' remembered SCP envelopes for slots the requester
+        is missing (reference: GET_SCP_STATE overlay message)."""
+        def attempt():
+            for peer in self._reachable(requester):
+                for env in peer.herder.get_scp_state(requester.lcl + 1):
+                    requester.herder.recv_scp_envelope(env)
+        self.clock.post_action(attempt, name="fetch-scp-state")
+
+    # -- partitions (fault injection) --------------------------------------
+    def partition_nodes(self, groups: List[List[SimNode]]) -> None:
+        for i, grp in enumerate(groups):
+            for n in grp:
+                n.partition = i
+
+    def heal_partitions(self) -> None:
+        for n in self.nodes:
+            n.partition = 0
+
+    # -- cranking ----------------------------------------------------------
+    def crank_until(self, pred: Callable[[], bool],
+                    timeout: float = 120.0) -> bool:
+        return self.clock.crank_until(pred, timeout)
+
+    def crank_for_at_least(self, duration: float) -> None:
+        self.clock.crank_for(duration)
+
+    def crank_until_ledger(self, seq: int, timeout: float = 120.0) -> bool:
+        """Crank until every validator has closed ledger `seq`."""
+        vs = [n for n in self.nodes if n.herder.is_validator]
+        return self.crank_until(lambda: all(n.lcl >= seq for n in vs),
+                                timeout)
+
+    def hashes_agree(self, seq: Optional[int] = None) -> bool:
+        """All validators that closed ledger `seq` derived the same hash
+        (default: highest ledger every validator has closed)."""
+        vs = [n for n in self.nodes if n.herder.is_validator]
+        if not vs:
+            return True
+        if seq is None:
+            seq = min(n.lcl for n in vs)
+        hashes = {n.closed.get(seq) for n in vs if seq in n.closed}
+        return len(hashes) <= 1
+
+
+def qset_of(node_ids: List[bytes], threshold: int):
+    return SX.SCPQuorumSet(threshold=threshold,
+                           validators=[XT.node_id(n) for n in node_ids],
+                           innerSets=[])
+
+
+def make_core_topology(n: int, threshold: Optional[int] = None,
+                       passphrase: bytes = b"sim network") -> Simulation:
+    """Fully-connected n-validator network with a shared flat qset.
+    Reference: Topologies::core."""
+    sim = Simulation(passphrase)
+    secrets = [SecretKey(bytes([i + 1]) * 32) for i in range(n)]
+    ids = [s.public_key.ed25519 for s in secrets]
+    q = qset_of(ids, threshold if threshold is not None else (2 * n + 2) // 3)
+    for s in secrets:
+        sim.add_node(s, q)
+    return sim

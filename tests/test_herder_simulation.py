@@ -1,0 +1,137 @@
+"""Herder + multi-node simulation tests: full consensus rounds closing real
+ledgers with real transactions, on virtual time.
+
+Reference test model: src/herder/test/HerderTests.cpp +
+src/simulation/test/ — networks of in-process nodes reach consensus,
+ledgers close with identical hashes, txs submitted to one node are
+externalized everywhere, upgrades apply when voted.
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.herder import (AddResult, HerderState,
+                                     UpgradeParameters, Upgrades)
+from stellar_core_tpu.simulation import make_core_topology
+from stellar_core_tpu.testutils import TestAccount, create_account_op
+from stellar_core_tpu.crypto.keys import SecretKey
+
+
+def make_running_sim(n=4, threshold=None):
+    sim = make_core_topology(n, threshold)
+    sim.start_all_nodes()
+    return sim
+
+
+class TestConsensusRounds:
+    def test_three_nodes_close_empty_ledgers(self):
+        sim = make_running_sim(3)
+        assert sim.crank_until_ledger(3, timeout=120)
+        assert sim.hashes_agree(2)
+        assert sim.hashes_agree(3)
+
+    def test_four_nodes_progress_many_ledgers(self):
+        sim = make_running_sim(4)
+        assert sim.crank_until_ledger(6, timeout=300)
+        for seq in range(2, 7):
+            assert sim.hashes_agree(seq), f"fork at ledger {seq}"
+
+    def test_ledger_cadence_is_five_seconds(self):
+        sim = make_running_sim(3)
+        t0 = sim.clock.now()
+        assert sim.crank_until_ledger(5, timeout=300)
+        elapsed = sim.clock.now() - t0
+        # 4 rounds at ~5s each; wide brackets (first round is immediate)
+        assert 10.0 <= elapsed <= 60.0, elapsed
+
+
+class TestTransactionFlow:
+    def test_submitted_tx_externalizes_on_all_nodes(self):
+        sim = make_running_sim(3)
+        node = sim.nodes[0]
+        root_sk = node.lm.root_account_secret()
+        root_entry = node.lm.root.get_entry(
+            X.LedgerKey.account(X.LedgerKeyAccount(
+                accountID=X.AccountID.ed25519(
+                    root_sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(node.lm, root_sk, root_entry.data.value.seqNum)
+
+        dest = SecretKey(b"\x77" * 32)
+        frame = root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 50_000_000_000)])
+        res = node.submit(frame)
+        assert res.code == AddResult.STATUS_PENDING
+
+        target = node.lcl + 2
+        assert sim.crank_until_ledger(target, timeout=120)
+        # the new account must exist on every node
+        key = X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(dest.public_key.ed25519))).to_xdr()
+        for n in sim.nodes:
+            entry = n.lm.root.get_entry(key)
+            assert entry is not None, "tx not applied on some node"
+            assert entry.data.value.balance == 50_000_000_000
+        assert sim.hashes_agree()
+
+    def test_duplicate_submission_rejected(self):
+        sim = make_running_sim(3)
+        node = sim.nodes[0]
+        root_sk = node.lm.root_account_secret()
+        root_entry = node.lm.root.get_entry(
+            X.LedgerKey.account(X.LedgerKeyAccount(
+                accountID=X.AccountID.ed25519(
+                    root_sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(node.lm, root_sk, root_entry.data.value.seqNum)
+        dest = SecretKey(b"\x66" * 32)
+        frame = root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10_000_000_000)])
+        assert node.submit(frame).code == AddResult.STATUS_PENDING
+        assert node.submit(frame).code == AddResult.STATUS_DUPLICATE
+
+
+class TestUpgradeVoting:
+    def test_base_fee_upgrade_applies(self):
+        import stellar_core_tpu.simulation.simulation as simmod
+        from stellar_core_tpu.crypto.sha import sha256
+
+        sim = simmod.Simulation()
+        secrets = [SecretKey(bytes([i + 1]) * 32) for i in range(3)]
+        ids = [s.public_key.ed25519 for s in secrets]
+        q = simmod.qset_of(ids, 2)
+        ups = Upgrades(UpgradeParameters(upgrade_time=0, base_fee=250))
+        for s in secrets:
+            sim.add_node(s, q, upgrades=ups)
+        sim.start_all_nodes()
+        assert sim.crank_until_ledger(3, timeout=120)
+        for n in sim.nodes:
+            assert n.lm.lcl_header.baseFee == 250
+        assert sim.hashes_agree()
+
+
+class TestPartition:
+    def test_minority_partition_stalls_then_recovers(self):
+        sim = make_running_sim(4, threshold=3)
+        assert sim.crank_until_ledger(2, timeout=60)
+        # cut one node off: the trio keeps going, the loner stalls
+        loner, rest = sim.nodes[0], sim.nodes[1:]
+        sim.partition_nodes([[loner], rest])
+        start = min(n.lcl for n in rest)
+        assert sim.crank_until(lambda: all(n.lcl >= start + 2 for n in rest),
+                               timeout=120)
+        assert loner.lcl < start + 2
+        # heal: the loner hears newer slots and buffers/out-of-syncs; in
+        # this transport it catches up via buffered externalize once the
+        # missing tx sets are fetchable
+        sim.heal_partitions()
+        target = max(n.lcl for n in rest) + 2
+        assert sim.crank_until(
+            lambda: all(n.lcl >= target for n in sim.nodes), timeout=240)
+        assert sim.hashes_agree()
+
+
+class TestQuorumTracking:
+    def test_quorum_tracker_sees_all_nodes(self):
+        sim = make_running_sim(3)
+        assert sim.crank_until_ledger(3, timeout=120)
+        for n in sim.nodes:
+            assert n.herder.quorum_tracker.node_count == 3

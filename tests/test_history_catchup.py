@@ -152,3 +152,41 @@ def test_verify_ledger_chain_rejects_fork(published):
     headers[1].header.previousLedgerHash = b"\x13" * 32
     with pytest.raises(CatchupError):
         verify_ledger_chain(headers)
+
+
+def test_catchup_replays_upgraded_ledgers(tmp_path):
+    """Regression: a ledger whose externalized value carried upgrades must
+    replay to the identical hash (scpValue stored verbatim, upgrades
+    re-applied).  Reference: Upgrades::applyTo on the catchup path."""
+    from stellar_core_tpu.crypto.sha import sha256
+
+    archive = FileHistoryArchive(str(tmp_path / "arc"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=7)
+    gen.create_accounts(5, per_ledger=5)
+
+    # close one ledger carrying a voted baseFee upgrade
+    up = X.LedgerUpgrade.newBaseFee(275).to_xdr()
+    tx_set, tx_set_hash, _ = mgr.make_tx_set([])
+    sv = X.StellarValue(txSetHash=tx_set_hash,
+                        closeTime=mgr.lcl_header.scpValue.closeTime + 5,
+                        upgrades=[up])
+    arts = mgr.close_ledger([], sv.closeTime, tx_set=tx_set,
+                            stellar_value=sv)
+    history.ledger_closed(arts)
+    assert mgr.lcl_header.baseFee == 275
+
+    gen.payment_ledgers(3, txs_per_ledger=2)
+    gen.run_to_checkpoint_boundary()
+    assert history.published_checkpoints
+
+    cm = CatchupManager(NID, PASSPHRASE)
+    replayed = cm.catchup_complete(archive)
+    assert replayed.lcl_header.baseFee == 275
+    from stellar_core_tpu.catchup.catchup import _LHHE
+    from stellar_core_tpu.history.archive import category_path
+    recs = archive.get_xdr_file(category_path(
+        "ledger", archive.get_state().current_ledger))
+    assert replayed.lcl_hash == _LHHE.unpack(recs[-1]).hash

@@ -1,0 +1,114 @@
+"""TransactionQueue unit tests.
+
+Reference test model: src/herder/test/TransactionQueueTests.cpp —
+replace-by-fee, bans, queue limits, surge-priced tx set building.
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.herder.tx_queue import (AddResult, BAN_DEPTH,
+                                              FEE_MULTIPLIER,
+                                              TransactionQueue)
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.testutils import TestAccount, create_account_op, \
+    native_payment_op
+
+
+@pytest.fixture
+def env():
+    lm = LedgerManager(sha256(b"txq test net"))
+    lm.start_new_ledger()
+    root_sk = lm.root_account_secret()
+    root_entry = lm.root.get_entry(
+        X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(
+                root_sk.public_key.ed25519))).to_xdr())
+    root = TestAccount(lm, root_sk, root_entry.data.value.seqNum)
+    # fund two accounts
+    a_sk, b_sk = SecretKey(b"\x01" * 32), SecretKey(b"\x02" * 32)
+    lm.close_ledger([root.tx([
+        create_account_op(X.AccountID.ed25519(a_sk.public_key.ed25519),
+                          100_000_000_000),
+        create_account_op(X.AccountID.ed25519(b_sk.public_key.ed25519),
+                          100_000_000_000)])], close_time=100)
+    def acct(sk):
+        e = lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        return TestAccount(lm, sk, e.data.value.seqNum)
+    return lm, TransactionQueue(lm), acct(a_sk), acct(b_sk), root
+
+
+def payment(frm, to, amount=1_000_000, fee=None, seq_bump=1):
+    op = native_payment_op(X.AccountID.ed25519(to.secret.public_key.ed25519),
+                           amount)
+    return frm.tx([op], fee=fee) if fee else frm.tx([op])
+
+
+class TestTryAdd:
+    def test_pending_then_duplicate(self, env):
+        lm, q, a, b, root = env
+        f = payment(a, b)
+        assert q.try_add(f).code == AddResult.STATUS_PENDING
+        assert q.try_add(f).code == AddResult.STATUS_DUPLICATE
+        assert q.size == 1
+
+    def test_second_tx_same_account_needs_fee_bump(self, env):
+        lm, q, a, b, root = env
+        f1 = payment(a, b)
+        assert q.try_add(f1).code == AddResult.STATUS_PENDING
+        # same account, new seq, normal fee: rejected
+        f2 = payment(a, b, amount=2_000_000)
+        assert q.try_add(f2).code == AddResult.STATUS_TRY_AGAIN_LATER
+        # with >=10x fee: replaces (same seq as f1)
+        from stellar_core_tpu.testutils import build_tx
+        f3 = build_tx(lm.network_id, a.secret, f1.seq_num,
+                      [native_payment_op(
+                          X.AccountID.ed25519(b.secret.public_key.ed25519),
+                          3_000_000)],
+                      fee=f1.fee_bid * FEE_MULTIPLIER)
+        assert q.try_add(f3).code == AddResult.STATUS_PENDING
+        assert q.size == 1
+        assert f3.content_hash() in q.by_hash
+
+    def test_invalid_tx_rejected(self, env):
+        lm, q, a, b, root = env
+        from stellar_core_tpu.testutils import build_tx
+        f = build_tx(lm.network_id, a.secret, a.seq_num + 1000,
+                     [native_payment_op(
+                         X.AccountID.ed25519(b.secret.public_key.ed25519),
+                         1)])  # bad seq
+        res = q.try_add(f)
+        assert res.code == AddResult.STATUS_ERROR
+
+    def test_banned_rejected(self, env):
+        lm, q, a, b, root = env
+        f = payment(a, b)
+        q.ban([f])
+        assert q.try_add(f).code == AddResult.STATUS_BANNED
+        # bans age out after BAN_DEPTH shifts
+        for _ in range(BAN_DEPTH):
+            q.shift()
+        assert q.try_add(f).code == AddResult.STATUS_PENDING
+
+
+class TestLedgerInteraction:
+    def test_remove_applied_drops_stale(self, env):
+        lm, q, a, b, root = env
+        f = payment(a, b)
+        assert q.try_add(f).code == AddResult.STATUS_PENDING
+        q.remove_applied([f])
+        assert q.size == 0
+
+    def test_tx_set_surge_pricing_order(self, env):
+        lm, q, a, b, root = env
+        fa = payment(a, b, fee=200)
+        fb = payment(b, a, fee=5000)
+        assert q.try_add(fa).code == AddResult.STATUS_PENDING
+        assert q.try_add(fb).code == AddResult.STATUS_PENDING
+        frames = q.tx_set_frames()
+        assert frames[0] is fb  # higher fee-per-op first
+        # trim to 1 op: only the best survives
+        assert q.tx_set_frames(max_ops=1) == [fb]
