@@ -130,9 +130,21 @@ class Herder(SCPDriver):
         return status
 
     def recv_tx_set(self, txset_hash: bytes, txset) -> bool:
-        """Reference: HerderImpl::recvTxSet."""
-        frames = [self.lm.make_frame(e) for e in txset.txs]
-        if sha256(txset.to_xdr()) != txset_hash:
+        """Reference: HerderImpl::recvTxSet.  The hash gate runs FIRST so
+        no frame-construction work (or exception) can be triggered by a tx
+        set whose hash doesn't match what was requested."""
+        try:
+            if sha256(txset.to_xdr()) != txset_hash:
+                return False
+        except Exception:
+            return False
+        try:
+            frames = [self.lm.make_frame(e) for e in txset.txs]
+        except Exception:
+            # Hash-correct tx set we cannot build frames for: this is a bug
+            # (or unsupported tx shape) worth surfacing, not a peer lying.
+            log.exception("frame construction failed for tx set %s",
+                          txset_hash.hex()[:16])
             return False
         self.pending.add_txset(txset_hash, txset, frames)
         self._process_scp_queue()
@@ -265,11 +277,18 @@ class Herder(SCPDriver):
         kept = [u for u in sv.upgrades
                 if self.upgrades.is_valid(u, lcl, nomination=True,
                                           close_time=sv.closeTime)]
-        if self.validate_value(slot_index, value, True) == \
-                ValidationLevel.INVALID:
-            return None
         sv2 = X.StellarValue(txSetHash=sv.txSetHash, closeTime=sv.closeTime,
                              upgrades=kept)
+        # Validate the STRIPPED value: its remaining upgrades are all wanted,
+        # so this is the reference's validateValueHelper (which skips upgrade
+        # checks) applied to the repaired value.  Validating the original
+        # would return INVALID exactly when an unwanted upgrade is present —
+        # the case this method exists to repair.  MAYBE_VALID (tx set evicted
+        # from cache between processing steps) keeps the repaired value:
+        # dropping it would stall nomination on the leader's value.
+        if self.validate_value(slot_index, sv2.to_xdr(), True) == \
+                ValidationLevel.INVALID:
+            return None
         return sv2.to_xdr()
 
     def combine_candidates(self, slot_index: int,
@@ -373,6 +392,12 @@ class Herder(SCPDriver):
         self._drain_buffered()
 
     def _drain_buffered(self) -> None:
+        # Drop stale entries at or below the LCL (catchup may have advanced
+        # past them); they would otherwise accumulate and suppress the
+        # min(buffered) > lcl+1 out-of-sync check below.
+        lcl = self.tracking_consensus_ledger_index()
+        for s in [s for s in self._buffered if s <= lcl]:
+            del self._buffered[s]
         while True:
             nxt = self.tracking_consensus_ledger_index() + 1
             sv = self._buffered.pop(nxt, None)

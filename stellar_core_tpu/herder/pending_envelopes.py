@@ -9,9 +9,10 @@ PROCESSED), caches; src/overlay/ItemFetcher.h — hash-addressed fetch
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import xdr as X
+from ..crypto.sha import sha256
 from ..scp.quorum import is_qset_sane, qset_hash
 from ..util import logging as slog
 from ..util.cache import RandomEvictionCache
@@ -86,7 +87,12 @@ class PendingEnvelopes:
         # slot -> list of (env, missing_qset_hashes, missing_txset_hashes)
         self.fetching: Dict[int, List] = {}
         self.ready: Dict[int, List] = {}
-        self.processed_index: Set[bytes] = set()  # env xdr hashes seen
+        # env xdr hash -> slot, for envelopes already handed to SCP
+        # (GC'd with the slot in erase_below)
+        self.processed_index: Dict[bytes, int] = {}
+        # env xdr hash -> ENVELOPE_STATUS_{FETCHING,READY} for envelopes
+        # currently queued (dedups re-received floods before processing)
+        self.queued_index: Dict[bytes, str] = {}
 
     # -- item intake ------------------------------------------------------
     def add_qset(self, qset) -> bool:
@@ -114,15 +120,37 @@ class PendingEnvelopes:
         """Returns an ENVELOPE_STATUS_*.  READY envelopes are queued in
         self.ready[slot] for the herder to pop."""
         slot = env.statement.slotIndex
+        env_hash = sha256(env.to_xdr())
+        if env_hash in self.processed_index:
+            # Re-received (flooded or re-requested) envelope already handed
+            # to SCP: discard without re-queuing (reference envelope state
+            # machine returns PROCESSED for these).
+            return ENVELOPE_STATUS_PROCESSED
+        queued = self.queued_index.get(env_hash)
+        if queued is not None:
+            # Duplicate still in flight: report its current state without
+            # re-queuing.  A FETCHING duplicate re-issues fetches for the
+            # still-missing items — re-floods are the retry path for fetches
+            # that found no peer with the item the first time.
+            if queued == ENVELOPE_STATUS_FETCHING:
+                mq, mt = self._missing(env.statement)
+                for h in mq:
+                    self.fetch_qset(h)
+                for h in mt:
+                    self.fetch_txset(h)
+                self._recheck()
+            return queued
         missing_q, missing_t = self._missing(env.statement)
         if not missing_q and not missing_t:
-            self.ready.setdefault(slot, []).append(env)
+            self.ready.setdefault(slot, []).append((env, env_hash))
+            self.queued_index[env_hash] = ENVELOPE_STATUS_READY
             return ENVELOPE_STATUS_READY
         for h in missing_q:
             self.fetch_qset(h)
         for h in missing_t:
             self.fetch_txset(h)
-        self.fetching.setdefault(slot, []).append(env)
+        self.fetching.setdefault(slot, []).append((env, env_hash))
+        self.queued_index[env_hash] = ENVELOPE_STATUS_FETCHING
         return ENVELOPE_STATUS_FETCHING
 
     def _missing(self, st) -> Tuple[List[bytes], List[bytes]]:
@@ -137,19 +165,25 @@ class PendingEnvelopes:
     def _recheck(self) -> None:
         for slot in list(self.fetching):
             still = []
-            for env in self.fetching[slot]:
+            for env, env_hash in self.fetching[slot]:
                 mq, mt = self._missing(env.statement)
                 if not mq and not mt:
-                    self.ready.setdefault(slot, []).append(env)
+                    self.ready.setdefault(slot, []).append((env, env_hash))
+                    self.queued_index[env_hash] = ENVELOPE_STATUS_READY
                 else:
-                    still.append(env)
+                    still.append((env, env_hash))
             if still:
                 self.fetching[slot] = still
             else:
                 del self.fetching[slot]
 
     def pop_ready(self, slot: int) -> List:
-        return self.ready.pop(slot, [])
+        out = []
+        for env, env_hash in self.ready.pop(slot, []):
+            self.processed_index[env_hash] = slot
+            self.queued_index.pop(env_hash, None)
+            out.append(env)
+        return out
 
     def has_ready(self) -> bool:
         return any(self.ready.values())
@@ -163,4 +197,8 @@ class PendingEnvelopes:
         per-slot pending envelopes)."""
         for d in (self.fetching, self.ready):
             for s in [s for s in d if s < slot]:
+                for _env, env_hash in d[s]:
+                    self.queued_index.pop(env_hash, None)
                 del d[s]
+        for h in [h for h, s in self.processed_index.items() if s < slot]:
+            del self.processed_index[h]

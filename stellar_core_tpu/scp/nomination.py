@@ -85,8 +85,10 @@ class NominationProtocol:
         if lvl in (ValidationLevel.FULLY_VALIDATED,
                    ValidationLevel.VOTE_TO_NOMINATE):
             return value
-        if lvl == ValidationLevel.INVALID:
-            return None
+        # Any non-fully-valid value (INVALID included) goes through
+        # extract_valid_value, which may repair it by stripping unwanted
+        # upgrades (reference: getNewValueFromNomination calls
+        # extractValidValue for every non-fully-valid value).
         return self.slot.driver.extract_valid_value(self.slot.slot_index,
                                                     value)
 
