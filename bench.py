@@ -18,7 +18,7 @@ def main():
 
     rng = random.Random(7)
     n_total = 65536
-    chunk = 2048
+    chunk = 8192
     n_base = 3000
 
     # Synthetic workload shaped like catchup: few distinct signing accounts,

@@ -166,7 +166,8 @@ def double_scalarmult_w2(windows, c_point: PointBatch):
         r = point_add(r, picked, d2)
         return r.tree(), None
 
-    final, _ = lax.scan(step, PointBatch.identity_like(c_point).tree(), windows)
+    final, _ = lax.scan(step, PointBatch.identity_like(c_point).tree(), windows,
+                        unroll=1)
     return PointBatch.from_tree(final)
 
 

@@ -153,17 +153,70 @@ def verify_forward(windows, cx, cy, ct, r_bytes):
 _verify_kernel = jax.jit(verify_forward)
 
 
-class Ed25519BatchVerifier:
-    """Chunked, jit-cached batch verifier (one compile per chunk size)."""
+def _windows_on_device(s_raw, h_raw):
+    """(N, 32) uint8 LE scalar bytes x2 -> (127, N) int32 joint 2-bit
+    windows, MSB first — the device-side equivalent of _windows_msb_first
+    (the host link is the scarcest resource: ship 64 bytes/sig, not a
+    4-byte-per-window int32 matrix)."""
+    s = s_raw.astype(jnp.int32)
+    h = h_raw.astype(jnp.int32)
+    j = jnp.arange(127, dtype=jnp.int32)
+    byte_idx = j // 4
+    shift = (2 * j) % 8
+    s2 = (s[:, byte_idx] >> shift) & 3       # (N, 127)
+    h2 = (h[:, byte_idx] >> shift) & 3
+    w = 4 * s2 + h2
+    return w[:, ::-1].T
 
-    def __init__(self, chunk_size: int = 512):
+
+def verify_forward_raw(s_raw, h_raw, key_idx, ucx, ucy, uct, r_bytes):
+    """Transfer-lean generic path: raw scalar bytes + per-signature index
+    into a deduplicated key-limb table; windows and key gathers happen on
+    device."""
+    windows = _windows_on_device(s_raw, h_raw)
+    cx = ucx[key_idx]
+    cy = ucy[key_idx]
+    ct = uct[key_idx]
+    return verify_forward(windows, cx, cy, ct, r_bytes)
+
+
+_verify_kernel_raw = jax.jit(verify_forward_raw)
+
+
+class Ed25519BatchVerifier:
+    """Chunked, jit-cached batch verifier (one compile per chunk size).
+
+    Two device paths, dispatched per signature by key temperature:
+
+    * **table path** (accel/tables.py): keys seen >= `hot_threshold` times
+      get a precomputed per-key window table in device HBM; verification is
+      128 table adds with zero doublings (~2.4x fewer field mults).  This is
+      the common case in catchup replay, where per-account sequence numbers
+      serialize each account's transactions into a repeated-key stream.
+    * **generic path**: joint 2-bit-windowed double-scalarmult for cold keys.
+
+    Both paths ship raw bytes (96 B/sig + a key index) to the device and
+    derive windows/digits there: the host<->device link, not the chip, is
+    the scarcest resource (see PROFILE.md).
+    """
+
+    def __init__(self, chunk_size: int = 8192, table_slots: int = 192,
+                 hot_threshold: int = 4):
         self.chunk_size = chunk_size
+        self.hot_threshold = hot_threshold
         # pk -> (cx, cy, ct) limbs of -A, or None if the key fails decoding /
         # canonicality / small-order checks.  Catchup replay re-verifies the
         # same accounts' keys constantly; decompression (two field exps in
         # python ints) is the dominant CPU prep cost, so this cache is load-
         # bearing for end-to-end throughput.
         self._pk_cache: dict = {}
+        from . import tables as _tables
+        self._tables = _tables.KeyTableCache(table_slots)
+        self._use_counts: dict = {}
+        # offload observability (VERDICT r1 weak #4): how much of the work
+        # runs on which device path.
+        self.stats = {"table_sigs": 0, "generic_sigs": 0, "rejected_prep": 0,
+                      "tables_built": 0}
 
     @staticmethod
     def _decode_pk(pk: bytes):
@@ -181,6 +234,8 @@ class Ed25519BatchVerifier:
 
     def verify(self, pks: Sequence[bytes], sigs: Sequence[bytes],
                msgs: Sequence[bytes]) -> np.ndarray:
+        from . import tables as _tables
+
         n = len(pks)
         assert len(sigs) == n and len(msgs) == n
 
@@ -203,17 +258,13 @@ class Ed25519BatchVerifier:
         ok &= ~_small_order_vec(pk_mat)                     # pk not small order
 
         # -- per-element: pk decompress (cached) + challenge hash --------
-        cx = np.zeros((n, field.NLIMB), dtype=np.int64)
-        cy = np.zeros((n, field.NLIMB), dtype=np.int64)
-        ct = np.zeros((n, field.NLIMB), dtype=np.int64)
         h_raw = np.zeros((n, 32), dtype=np.uint8)
+        decoded = [None] * n       # per-sig (cx, cy, ct) limbs of -A
         cache = self._pk_cache
+        counts = self._use_counts
         sha512 = hashlib.sha512
         for i in range(n):
             if not ok[i]:
-                cx[i, 0] = 1  # harmless dummy (not a curve point; verdict is
-                cy[i, 0] = 1  # masked by ok anyway, math stays finite)
-                ct[i, 0] = 1
                 continue
             pk = bytes(pks[i])
             cached = cache.get(pk, _PK_UNSEEN)
@@ -223,43 +274,119 @@ class Ed25519BatchVerifier:
                     cache[pk] = cached
             if cached is None:
                 ok[i] = False
-                cx[i, 0] = 1
-                cy[i, 0] = 1
-                ct[i, 0] = 1
                 continue
-            cx[i], cy[i], ct[i] = cached
+            decoded[i] = cached
+            counts[pk] = counts.get(pk, 0) + 1
             sig = bytes(sigs[i])
             h = int.from_bytes(sha512(sig[:32] + pk + bytes(msgs[i])).digest(),
                                "little") % L
             h_raw[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+        self.stats["rejected_prep"] += int(n - ok.sum())
 
-        # -- chunked async dispatch (prep of chunk k+1 overlaps device
-        #    compute of chunk k; jax dispatch is non-blocking) -----------
-        cs = self.chunk_size
-        pending = []
-        for start in range(0, n, cs):
-            end = min(start + cs, n)
-            pad = cs - (end - start)
-
-            def padded(a):
-                if pad == 0:
-                    return a[start:end]
-                return np.concatenate(
-                    [a[start:end], np.zeros((pad,) + a.shape[1:], a.dtype)])
-
-            windows = _windows_msb_first(padded(sig_mat[:, 32:]), padded(h_raw))
-            pcx = padded(cx)
-            if pad:
-                pcx[-pad:, 0] = 1  # keep dummy rows finite
-            verdict = _verify_kernel(
-                jnp.asarray(windows), jnp.asarray(pcx),
-                jnp.asarray(padded(cy)), jnp.asarray(padded(ct)),
-                jnp.asarray(padded(sig_mat[:, :32])))
-            pending.append((start, end, verdict))
+        # -- hot/cold key split -----------------------------------------
+        tabs = self._tables
+        live = [i for i in range(n) if ok[i]]
+        hot_pks = set()
+        for i in live:
+            pk = bytes(pks[i])
+            if pk in tabs.slot_of or counts.get(pk, 0) >= self.hot_threshold:
+                hot_pks.add(pk)
+        to_install = [pk for pk in hot_pks if pk not in tabs.slot_of]
+        if to_install:
+            installed = tabs.install(
+                [(pk, cache[pk]) for pk in to_install], protect=hot_pks)
+            self.stats["tables_built"] += len(installed)
+            hot_pks -= {pk for pk in to_install if pk not in installed}
+        hot_idx = [i for i in live if bytes(pks[i]) in hot_pks]
+        cold_idx = [i for i in live if bytes(pks[i]) not in hot_pks]
+        self.stats["table_sigs"] += len(hot_idx)
+        self.stats["generic_sigs"] += len(cold_idx)
 
         out = np.zeros(n, dtype=bool)
-        for start, end, verdict in pending:
-            out[start:end] = np.asarray(verdict)[:end - start]
+        cs = self.chunk_size
+        pending = []
+
+        def _tail_width(count: int) -> int:
+            """Full chunks stay chunk_size; a tail pads only to a
+            power-of-two bucket (min 256) so a small remainder stream does
+            not dispatch an almost-empty full-width kernel, while the set of
+            compiled shapes stays bounded."""
+            if count >= cs:
+                return cs
+            return min(cs, max(256, 1 << (count - 1).bit_length()))
+
+        # -- table path (hot keys): raw bytes + slot ids, no doublings ---
+        if hot_idx:
+            idx = np.asarray(hot_idx)
+            s_raw = sig_mat[idx, 32:]
+            hh = h_raw[idx]
+            rb = sig_mat[idx, :32]
+            slots = np.asarray([tabs.lookup(bytes(pks[i])) for i in hot_idx],
+                               dtype=np.int32)
+            base_tab = _tables.base_point_table()
+            for start in range(0, len(idx), cs):
+                end = min(start + cs, len(idx))
+                pad = _tail_width(end - start) - (end - start)
+
+                def padded(a, pad=pad, start=start, end=end):
+                    if pad == 0:
+                        return a[start:end]
+                    return np.concatenate(
+                        [a[start:end],
+                         np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+                verdict = _tables._verify_tables_jit(
+                    jnp.asarray(padded(s_raw)), jnp.asarray(padded(hh)),
+                    jnp.asarray(padded(slots)), jnp.asarray(padded(rb)),
+                    tabs.table, base_tab)
+                pending.append((idx[start:end], verdict, end - start))
+
+        # -- generic path (cold keys): dedup'd key limbs + raw bytes -----
+        if cold_idx:
+            idx = np.asarray(cold_idx)
+            key_of = {}
+            key_rows = []
+            kidx = np.zeros(len(idx), dtype=np.int32)
+            for j, i in enumerate(cold_idx):
+                pk = bytes(pks[i])
+                ki = key_of.get(pk)
+                if ki is None:
+                    ki = key_of[pk] = len(key_rows)
+                    key_rows.append(decoded[i])
+                kidx[j] = ki
+            # pad the key table to a power-of-two bucket: jit compiles once
+            # per bucket size instead of once per distinct key count
+            nk = max(64, 1 << (len(key_rows) - 1).bit_length())
+            ucx = np.zeros((nk, field.NLIMB), dtype=np.int64)
+            ucy = np.zeros((nk, field.NLIMB), dtype=np.int64)
+            uct = np.zeros((nk, field.NLIMB), dtype=np.int64)
+            ucx[:, 0] = ucy[:, 0] = uct[:, 0] = 1  # finite dummy rows
+            for ki, r in enumerate(key_rows):
+                ucx[ki], ucy[ki], uct[ki] = r
+            ucx_d, ucy_d, uct_d = (jnp.asarray(ucx), jnp.asarray(ucy),
+                                   jnp.asarray(uct))
+            s_raw = sig_mat[idx, 32:]
+            hh = h_raw[idx]
+            rb = sig_mat[idx, :32]
+            for start in range(0, len(idx), cs):
+                end = min(start + cs, len(idx))
+                pad = _tail_width(end - start) - (end - start)
+
+                def padded(a, pad=pad, start=start, end=end):
+                    if pad == 0:
+                        return a[start:end]
+                    return np.concatenate(
+                        [a[start:end],
+                         np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+                verdict = _verify_kernel_raw(
+                    jnp.asarray(padded(s_raw)), jnp.asarray(padded(hh)),
+                    jnp.asarray(padded(kidx)), ucx_d, ucy_d, uct_d,
+                    jnp.asarray(padded(rb)))
+                pending.append((idx[start:end], verdict, end - start))
+
+        for which, verdict, count in pending:
+            out[which] = np.asarray(verdict)[:count]
         return out & ok
 
 

@@ -26,8 +26,6 @@ RADIX = 16
 MASK = (1 << RADIX) - 1
 
 P = (1 << 255) - 19
-# 2*p, limbwise, for subtraction bias
-_P2_LIMBS = tuple(((2 * P) >> (RADIX * i)) & MASK for i in range(NLIMB))
 _P_LIMBS = tuple((P >> (RADIX * i)) & MASK for i in range(NLIMB))
 
 
@@ -59,21 +57,35 @@ def _carry_round(v):
 
 
 def fe_carry(a):
-    """Partially reduce: after 3 vectorized rounds limbs are < 2^16 + 2^10
-    (round-3 carries are at most a few tens, folded as 38*c into limb 0),
-    which is a closed invariant for fe_mul/fe_add/fe_sub inputs: products
-    stay < 2^32.1, column sums < 2^41.5 — exact in int64.  Full [0, 2^16)
-    normalization happens only in fe_canonical (once per encode)."""
+    """Partially reduce with 3 vectorized rounds (see fe_mul's invariant)."""
     return _carry_round(_carry_round(_carry_round(a)))
 
 
+# Lazy-reduction discipline (the int64 headroom makes carries after add/sub
+# unnecessary — this is the main throughput lever on the VPU):
+#
+#   * fe_mul/fe_square outputs are carried (3 rounds): limbs <= 2^16 + eps.
+#   * fe_add is a plain vector add, NO carry: limbs <= 2^17 + eps.
+#   * fe_sub adds a 64p limbwise bias (each bias limb in [2^21, 2^22), value
+#     == 64p == 0 mod p) and does NOT carry: limbs <= 2^22.2, and >= 0
+#     because bias limbs dominate any subtrahend limb (<= 2^17.2).
+#   * fe_mul accepts inputs with limbs <= 2^22.2: 16x16 products are
+#     <= 2^44.4, column sums <= 2^48.4, and the 38-fold keeps everything
+#     <= 624 * 2^44.4 < 2^54 — comfortably exact in int64.  Three carry
+#     rounds bring the result back under 2^16 + eps, closing the loop.
+_BIAS64P = tuple(64 * l for l in _P_LIMBS)  # limbwise 64*p, value == 64p
+
+
 def fe_add(a, b):
-    return fe_carry(a + b)
+    """Lazy add: no carry (safe straight into fe_mul — see invariant above)."""
+    return a + b
 
 
 def fe_sub(a, b):
-    bias = jnp.array(_P2_LIMBS, dtype=jnp.int64)
-    return fe_carry(a + bias - b)
+    """Lazy subtract: adds a 64p limbwise bias so limbs stay non-negative;
+    no carry (safe straight into fe_mul — see invariant above)."""
+    bias = jnp.array(_BIAS64P, dtype=jnp.int64)
+    return a + bias - b
 
 
 def fe_mul(a, b):
