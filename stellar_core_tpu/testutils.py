@@ -89,3 +89,126 @@ def network_id(passphrase: str) -> bytes:
 
 
 TESTNET_PASSPHRASE = "Test SDF Network ; September 2015"
+
+
+# --- offer / trust / path-payment / pool op builders ----------------------
+
+def make_asset(code: str, issuer: X.AccountID) -> X.Asset:
+    raw = code.encode()
+    if len(raw) <= 4:
+        return X.Asset.alphaNum4(X.AlphaNum4(
+            assetCode=raw.ljust(4, b"\x00"), issuer=issuer))
+    return X.Asset.alphaNum12(X.AlphaNum12(
+        assetCode=raw.ljust(12, b"\x00"), issuer=issuer))
+
+
+def _src(source):
+    return (X.muxed_from_account_id(source) if source is not None else None)
+
+
+def change_trust_op(asset: X.Asset, limit: int = 2**63 - 1,
+                    source=None) -> X.Operation:
+    line = X.ChangeTrustAsset(asset.switch, asset.value)
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.changeTrustOp(
+                           X.ChangeTrustOp(line=line, limit=limit)))
+
+
+def change_trust_pool_op(asset_a: X.Asset, asset_b: X.Asset,
+                         limit: int = 2**63 - 1, fee: int = 30,
+                         source=None) -> X.Operation:
+    params = X.LiquidityPoolParameters.constantProduct(
+        X.LiquidityPoolConstantProductParameters(
+            assetA=asset_a, assetB=asset_b, fee=fee))
+    line = X.ChangeTrustAsset.liquidityPool(params)
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.changeTrustOp(
+                           X.ChangeTrustOp(line=line, limit=limit)))
+
+
+def payment_op(dest: X.AccountID, asset: X.Asset, amount: int,
+               source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.paymentOp(X.PaymentOp(
+                           destination=X.muxed_from_account_id(dest),
+                           asset=asset, amount=amount)))
+
+
+def manage_sell_offer_op(selling: X.Asset, buying: X.Asset, amount: int,
+                         price_n: int, price_d: int, offer_id: int = 0,
+                         source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.manageSellOfferOp(
+                           X.ManageSellOfferOp(
+                               selling=selling, buying=buying, amount=amount,
+                               price=X.Price(n=price_n, d=price_d),
+                               offerID=offer_id)))
+
+
+def manage_buy_offer_op(selling: X.Asset, buying: X.Asset, buy_amount: int,
+                        price_n: int, price_d: int, offer_id: int = 0,
+                        source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.manageBuyOfferOp(
+                           X.ManageBuyOfferOp(
+                               selling=selling, buying=buying,
+                               buyAmount=buy_amount,
+                               price=X.Price(n=price_n, d=price_d),
+                               offerID=offer_id)))
+
+
+def create_passive_sell_offer_op(selling: X.Asset, buying: X.Asset,
+                                 amount: int, price_n: int, price_d: int,
+                                 source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.createPassiveSellOfferOp(
+                           X.CreatePassiveSellOfferOp(
+                               selling=selling, buying=buying, amount=amount,
+                               price=X.Price(n=price_n, d=price_d))))
+
+
+def path_payment_strict_receive_op(send_asset: X.Asset, send_max: int,
+                                   dest: X.AccountID, dest_asset: X.Asset,
+                                   dest_amount: int, path=(),
+                                   source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.pathPaymentStrictReceiveOp(
+                           X.PathPaymentStrictReceiveOp(
+                               sendAsset=send_asset, sendMax=send_max,
+                               destination=X.muxed_from_account_id(dest),
+                               destAsset=dest_asset, destAmount=dest_amount,
+                               path=list(path))))
+
+
+def path_payment_strict_send_op(send_asset: X.Asset, send_amount: int,
+                                dest: X.AccountID, dest_asset: X.Asset,
+                                dest_min: int, path=(),
+                                source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.pathPaymentStrictSendOp(
+                           X.PathPaymentStrictSendOp(
+                               sendAsset=send_asset, sendAmount=send_amount,
+                               destination=X.muxed_from_account_id(dest),
+                               destAsset=dest_asset, destMin=dest_min,
+                               path=list(path))))
+
+
+def liquidity_pool_deposit_op(pool_id: bytes, max_a: int, max_b: int,
+                              min_price=(1, 10**7), max_price=(10**7, 1),
+                              source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.liquidityPoolDepositOp(
+                           X.LiquidityPoolDepositOp(
+                               liquidityPoolID=pool_id,
+                               maxAmountA=max_a, maxAmountB=max_b,
+                               minPrice=X.Price(n=min_price[0], d=min_price[1]),
+                               maxPrice=X.Price(n=max_price[0], d=max_price[1]))))
+
+
+def liquidity_pool_withdraw_op(pool_id: bytes, amount: int, min_a: int = 0,
+                               min_b: int = 0, source=None) -> X.Operation:
+    return X.Operation(sourceAccount=_src(source),
+                       body=X.OperationBody.liquidityPoolWithdrawOp(
+                           X.LiquidityPoolWithdrawOp(
+                               liquidityPoolID=pool_id, amount=amount,
+                               minAmountA=min_a, minAmountB=min_b)))

@@ -382,8 +382,8 @@ class SetOptionsOpFrame(OperationFrame):
 
 
 class ChangeTrustOpFrame(OperationFrame):
-    """Reference: src/transactions/ChangeTrustOpFrame.cpp (classic assets;
-    pool-share trustlines not implemented yet)."""
+    """Reference: src/transactions/ChangeTrustOpFrame.cpp (classic assets
+    and CAP-38 pool-share trustlines)."""
     OP_TYPE = OT.CHANGE_TRUST
     RESULT_CLS = X.ChangeTrustResult
     C = X.ChangeTrustResultCode
@@ -391,7 +391,13 @@ class ChangeTrustOpFrame(OperationFrame):
     def do_check_valid(self, ltx):
         line = self.body.line
         if line.switch == X.AssetType.ASSET_TYPE_POOL_SHARE:
-            return self.result(self.C.CHANGE_TRUST_MALFORMED)  # gap: LP shares
+            params = line.value.value  # LiquidityPoolParameters.constantProduct
+            from .offer_exchange import POOL_FEE_BPS, asset_order
+            if (not asset_valid(params.assetA) or not asset_valid(params.assetB)
+                    or asset_order(params.assetA, params.assetB) >= 0
+                    or params.fee != POOL_FEE_BPS or self.body.limit < 0):
+                return self.result(self.C.CHANGE_TRUST_MALFORMED)
+            return self.success()
         if line.switch == X.AssetType.ASSET_TYPE_NATIVE:
             return self.result(self.C.CHANGE_TRUST_MALFORMED)
         asset = X.Asset(line.switch, line.value)
@@ -405,6 +411,8 @@ class ChangeTrustOpFrame(OperationFrame):
 
     def do_apply(self, ltx):
         C = self.C
+        if self.body.line.switch == X.AssetType.ASSET_TYPE_POOL_SHARE:
+            return self._apply_pool_share(ltx)
         header = ltx.get_header()
         src_id = self.source_account_id()
         asset = X.Asset(self.body.line.switch, self.body.line.value)
@@ -455,6 +463,111 @@ class ChangeTrustOpFrame(OperationFrame):
         existing.lastModifiedLedgerSeq = header.ledgerSeq
         ltx.update(existing)
         return self.success()
+
+    def _apply_pool_share(self, ltx):
+        """CAP-38 pool-share trustline create/update/delete: requires
+        trustlines to both non-native constituents, counts 2 subentries,
+        and maintains the LiquidityPoolEntry's poolSharesTrustLineCount
+        plus each constituent trustline's liquidityPoolUseCount
+        (reference: ChangeTrustOpFrame::tryIncrementPoolUseCount)."""
+        from .offer_exchange import pool_id_for
+        C = self.C
+        header = ltx.get_header()
+        src_id = self.source_account_id()
+        params = self.body.line.value.value
+        pool_id = pool_id_for(params.assetA, params.assetB, params.fee)
+        key = trustline_key(src_id, X.TrustLineAsset.liquidityPoolID(pool_id))
+        existing = ltx.load(key)
+        src_e = load_account(ltx, src_id)
+        src = src_e.data.value
+        pool_key = X.LedgerKey.liquidityPool(
+            X.LedgerKeyLiquidityPool(liquidityPoolID=pool_id))
+
+        if existing is None:
+            if self.body.limit == 0:
+                return self.result(C.CHANGE_TRUST_INVALID_LIMIT)
+            # constituents: native needs nothing; credit assets need an
+            # authorized trustline, whose pool-use count we bump
+            for asset in (params.assetA, params.assetB):
+                if asset.switch == X.AssetType.ASSET_TYPE_NATIVE \
+                        or is_issuer(src_id, asset):
+                    continue
+                tl_e = utils.load_trustline(ltx, src_id, asset)
+                if tl_e is None:
+                    return self.result(C.CHANGE_TRUST_TRUST_LINE_MISSING)
+                # CAP-38: maintain-liabilities suffices for pool membership
+                if not utils.is_authorized_to_maintain_liabilities(
+                        tl_e.data.value):
+                    return self.result(C.CHANGE_TRUST_NOT_AUTH_MAINTAIN_LIABILITIES)
+                self._bump_pool_use(tl_e, +1)
+                ltx.update(tl_e)
+            if not add_num_entries(header, src, 2):
+                return self.result(C.CHANGE_TRUST_LOW_RESERVE)
+            ltx.update(src_e)
+            pe = ltx.load(pool_key)
+            if pe is None:
+                cp = X.LiquidityPoolEntryConstantProduct(
+                    params=params, reserveA=0, reserveB=0,
+                    totalPoolShares=0, poolSharesTrustLineCount=1)
+                ltx.create(X.LedgerEntry(
+                    lastModifiedLedgerSeq=header.ledgerSeq,
+                    data=X.LedgerEntryData.liquidityPool(X.LiquidityPoolEntry(
+                        liquidityPoolID=pool_id,
+                        body=X.LiquidityPoolEntryBody.constantProduct(cp)))))
+            else:
+                pe.data.value.body.value.poolSharesTrustLineCount += 1
+                ltx.update(pe)
+            ltx.create(X.LedgerEntry(
+                lastModifiedLedgerSeq=header.ledgerSeq,
+                data=X.LedgerEntryData.trustLine(X.TrustLineEntry(
+                    accountID=src_id,
+                    asset=X.TrustLineAsset.liquidityPoolID(pool_id),
+                    balance=0, limit=self.body.limit,
+                    flags=X.TrustLineFlags.AUTHORIZED_FLAG))))
+            return self.success()
+
+        tl = existing.data.value
+        if self.body.limit == 0:
+            if tl.balance != 0:
+                return self.result(C.CHANGE_TRUST_INVALID_LIMIT)
+            ltx.erase(key)
+            add_num_entries(header, src, -2)
+            ltx.update(src_e)
+            pe = ltx.load(pool_key)
+            cp = pe.data.value.body.value
+            cp.poolSharesTrustLineCount -= 1
+            if cp.poolSharesTrustLineCount == 0:
+                ltx.erase(pool_key)
+            else:
+                ltx.update(pe)
+            for asset in (params.assetA, params.assetB):
+                if asset.switch == X.AssetType.ASSET_TYPE_NATIVE \
+                        or is_issuer(src_id, asset):
+                    continue
+                tl_e = utils.load_trustline(ltx, src_id, asset)
+                if tl_e is not None:
+                    self._bump_pool_use(tl_e, -1)
+                    ltx.update(tl_e)
+            return self.success()
+        if self.body.limit < tl.balance:
+            return self.result(C.CHANGE_TRUST_INVALID_LIMIT)
+        tl.limit = self.body.limit
+        existing.lastModifiedLedgerSeq = header.ledgerSeq
+        ltx.update(existing)
+        return self.success()
+
+    @staticmethod
+    def _bump_pool_use(tl_entry, delta: int) -> None:
+        """Adjust TrustLineEntry ext-v2 liquidityPoolUseCount."""
+        tl = tl_entry.data.value
+        if tl.ext.switch == 0:
+            tl.ext = X.TrustLineEntryExt.v1(X.TrustLineEntryV1(
+                liabilities=X.Liabilities(buying=0, selling=0)))
+        v1 = tl.ext.value
+        if v1.ext.switch != 2:
+            v1.ext = X.TrustLineEntryV1Ext.v2(X.TrustLineEntryExtensionV2(
+                liquidityPoolUseCount=0))
+        v1.ext.value.liquidityPoolUseCount += delta
 
 
 class AllowTrustOpFrame(OperationFrame):
@@ -940,3 +1053,8 @@ def register_op_class(op_type: OT, cls) -> None:
     """Extension point for op frames defined in other modules
     (offer_exchange.py registers the order-book ops)."""
     _OP_CLASSES[op_type] = cls
+
+
+# Offer/path-payment/pool frames register themselves on import (bottom of
+# module to avoid a circular import — offer_ops subclasses OperationFrame).
+from . import offer_ops  # noqa: E402,F401

@@ -134,13 +134,15 @@ TrustLineEntryExtensionV2 = xdr_struct("TrustLineEntryExtensionV2", [
     ("ext", _TLEv2Ext),
 ], defaults={"liquidityPoolUseCount": 0, "ext": lambda: _TLEv2Ext.v0()})
 
+TrustLineEntryV1Ext = xdr_union("TrustLineEntryV1Ext", Int32, {
+    0: ("v0", None),
+    2: ("v2", TrustLineEntryExtensionV2),
+})
+
 TrustLineEntryV1 = xdr_struct("TrustLineEntryV1", [
     ("liabilities", Liabilities),
-    ("ext", xdr_union("TrustLineEntryV1Ext", Int32, {
-        0: ("v0", None),
-        2: ("v2", TrustLineEntryExtensionV2),
-    })),
-])
+    ("ext", TrustLineEntryV1Ext),
+], defaults={"ext": lambda: TrustLineEntryV1Ext.v0()})
 
 TrustLineEntryExt = xdr_union("TrustLineEntryExt", Int32, {
     0: ("v0", None),
@@ -273,20 +275,26 @@ LiquidityPoolConstantProductParameters = xdr_struct(
 
 LIQUIDITY_POOL_FEE_V18 = 30
 
-_LPConstantProduct = xdr_struct("LiquidityPoolEntryConstantProduct", [
-    ("params", LiquidityPoolConstantProductParameters),
-    ("reserveA", Int64),
-    ("reserveB", Int64),
-    ("totalPoolShares", Int64),
-    ("poolSharesTrustLineCount", Int64),
-])
+LiquidityPoolEntryConstantProduct = xdr_struct(
+    "LiquidityPoolEntryConstantProduct", [
+        ("params", LiquidityPoolConstantProductParameters),
+        ("reserveA", Int64),
+        ("reserveB", Int64),
+        ("totalPoolShares", Int64),
+        ("poolSharesTrustLineCount", Int64),
+    ],
+    defaults={"reserveA": 0, "reserveB": 0, "totalPoolShares": 0,
+              "poolSharesTrustLineCount": 0})
+_LPConstantProduct = LiquidityPoolEntryConstantProduct
+
+LiquidityPoolEntryBody = xdr_union("LiquidityPoolEntryBody", LiquidityPoolType, {
+    LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT:
+        ("constantProduct", _LPConstantProduct),
+})
 
 LiquidityPoolEntry = xdr_struct("LiquidityPoolEntry", [
     ("liquidityPoolID", PoolID),
-    ("body", xdr_union("LiquidityPoolEntryBody", LiquidityPoolType, {
-        LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT:
-            ("constantProduct", _LPConstantProduct),
-    })),
+    ("body", LiquidityPoolEntryBody),
 ])
 
 # --- Soroban entries (storage shape only; host execution is out of scope,

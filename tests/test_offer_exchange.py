@@ -1,0 +1,543 @@
+"""Order-book engine + offer/path-payment/pool op tests.
+
+Mirrors reference coverage in src/transactions/test/{OfferTests,
+ExchangeTests, PathPaymentTests, PathPaymentStrictSendTests,
+LiquidityPoolDepositTests, LiquidityPoolWithdrawTests,
+LiquidityPoolTradeTests}.cpp, driven through LedgerManager.close_ledger.
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.transactions.offer_exchange import (
+    ExchangeResultV10, ROUND_NORMAL, ROUND_PATH_STRICT_RECEIVE,
+    ROUND_PATH_STRICT_SEND, adjust_offer, exchange_v10, pool_id_for,
+    pool_swap_in_given_out, pool_swap_out_given_in)
+from stellar_core_tpu.testutils import (TestAccount, change_trust_op,
+                                        change_trust_pool_op,
+                                        create_account_op,
+                                        create_passive_sell_offer_op,
+                                        liquidity_pool_deposit_op,
+                                        liquidity_pool_withdraw_op,
+                                        make_asset, manage_buy_offer_op,
+                                        manage_sell_offer_op, network_id,
+                                        path_payment_strict_receive_op,
+                                        path_payment_strict_send_op,
+                                        payment_op)
+
+NID = network_id("tpu-core test network")
+P = X.Price
+
+
+# ---------------------------------------------------------------------------
+# exchangeV10 unit tests (reference: ExchangeTests.cpp)
+
+def test_exchange_v10_offer_bigger_than_demand():
+    # offer sells 1000 wheat at 2 sheep/wheat; taker has 100 sheep
+    r = exchange_v10(P(n=2, d=1), 1000, 10**10, 100, 10**10, ROUND_NORMAL)
+    assert r.wheat_stays
+    assert r.num_wheat_received == 50          # floor(100/2)
+    assert r.num_sheep_send == 100             # exactly the price
+
+
+def test_exchange_v10_rounding_favors_resting_offer():
+    # price 3 sheep / 2 wheat; taker pays 100 sheep -> wheat = floor(200/3)=66
+    # sheep recomputed = ceil(66*3/2) = 99 (taker never overpays the price)
+    r = exchange_v10(P(n=3, d=2), 10**6, 10**10, 100, 10**10, ROUND_NORMAL)
+    assert r.wheat_stays
+    assert r.num_wheat_received == 66
+    assert r.num_sheep_send == 99
+    # effective price paid >= offer price: 99/66 >= 3/2
+    assert 99 * 2 >= 3 * 66
+
+
+def test_exchange_v10_offer_taken_whole():
+    r = exchange_v10(P(n=3, d=2), 10, 10**10, 10**6, 10**10, ROUND_NORMAL)
+    assert not r.wheat_stays
+    assert r.num_wheat_received == 10
+    assert r.num_sheep_send == 15              # ceil(10*3/2)
+
+
+def test_exchange_v10_dust_cancelled():
+    # 1 sheep at price 3/1 buys 0 wheat -> whole exchange cancelled
+    r = exchange_v10(P(n=3, d=1), 1000, 10**10, 1, 10**10, ROUND_NORMAL)
+    assert r.num_wheat_received == 0 and r.num_sheep_send == 0
+
+
+def test_exchange_v10_strict_send_keeps_send_exact():
+    r = exchange_v10(P(n=3, d=2), 10**6, 10**10, 100, 10**10,
+                     ROUND_PATH_STRICT_SEND)
+    assert r.num_sheep_send == 100             # send side exact
+    assert r.num_wheat_received == 66
+
+
+def test_adjust_offer_drops_dust():
+    assert adjust_offer(P(n=3, d=1), 1000, 2) == 0
+    assert adjust_offer(P(n=1, d=1), 1000, 10**10) == 1000
+
+
+def test_pool_swap_formulas_round_trip():
+    # CAP-38 30bp fee; depositing the strict-receive quote must actually
+    # buy the requested amount per the strict-send formula
+    X_, Y_ = 10**7, 2 * 10**7
+    out = 10**5
+    inp = pool_swap_in_given_out(X_, Y_, out)
+    assert pool_swap_out_given_in(X_, Y_, inp) >= out
+    assert pool_swap_out_given_in(X_, Y_, inp - 1) < out or inp == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger-level fixtures
+
+@pytest.fixture
+def mgr():
+    m = LedgerManager(NID)
+    m.start_new_ledger()
+    return m
+
+
+@pytest.fixture
+def root(mgr):
+    sk = mgr.root_account_secret()
+    acc = mgr.root.get_entry(
+        X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, acc.data.value.seqNum)
+
+
+def _close(mgr, *frames, close_time=1000):
+    return mgr.close_ledger(list(frames), close_time)
+
+
+def _result_of(arts, frame):
+    for pair in arts.result_entry.txResultSet.results:
+        if pair.transactionHash == frame.content_hash():
+            return pair.result
+    raise AssertionError("tx not in result set")
+
+
+def _ok(mgr, frame):
+    arts = _close(mgr, frame)
+    res = _result_of(arts, frame)
+    assert res.result.switch == X.TransactionResultCode.txSUCCESS, res
+    return res.result.value
+
+
+def _fail_op(mgr, frame):
+    arts = _close(mgr, frame)
+    res = _result_of(arts, frame)
+    assert res.result.switch in (X.TransactionResultCode.txFAILED,), res
+    return res.result.value[0]
+
+
+def _acc(mgr, account_id):
+    e = mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=account_id)).to_xdr())
+    return e.data.value if e else None
+
+
+def _tl(mgr, account_id, asset):
+    tla = X.TrustLineAsset(asset.switch, asset.value) \
+        if asset.switch != X.AssetType.ASSET_TYPE_POOL_SHARE else asset
+    e = mgr.root.get_entry(X.LedgerKey.trustLine(X.LedgerKeyTrustLine(
+        accountID=account_id, asset=tla)).to_xdr())
+    return e.data.value if e else None
+
+
+def _offers(mgr):
+    out = []
+    for kb in mgr.root.all_keys():
+        k = X.LedgerKey.from_xdr(kb)
+        if k.switch == X.LedgerEntryType.OFFER:
+            out.append(mgr.root.get_entry(kb).data.value)
+    return sorted(out, key=lambda o: o.offerID)
+
+
+def _new_account(mgr, root, balance=10_000_000_000, tag=0):
+    import random
+    sk = SecretKey.pseudo_random_for_testing(
+        random.Random(mgr.last_closed_ledger_seq * 7919 + tag * 104729 + 7))
+    tx = root.tx([create_account_op(
+        X.AccountID.ed25519(sk.public_key.ed25519), balance)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == X.TransactionResultCode.txSUCCESS
+    acc = _acc(mgr, X.AccountID.ed25519(sk.public_key.ed25519))
+    return TestAccount(mgr, sk, acc.seqNum)
+
+
+@pytest.fixture
+def market(mgr, root):
+    """issuer + two traders with EUR/USD trustlines and balances."""
+    issuer = _new_account(mgr, root, tag=1)
+    a = _new_account(mgr, root, tag=2)
+    b = _new_account(mgr, root, tag=3)
+    eur = make_asset("EUR", issuer.account_id)
+    usd = make_asset("USD", issuer.account_id)
+    _ok(mgr, a.tx([change_trust_op(eur), change_trust_op(usd)]))
+    _ok(mgr, b.tx([change_trust_op(eur), change_trust_op(usd)]))
+    _ok(mgr, issuer.tx([payment_op(a.account_id, eur, 10_000),
+                        payment_op(a.account_id, usd, 10_000),
+                        payment_op(b.account_id, eur, 10_000),
+                        payment_op(b.account_id, usd, 10_000)]))
+    return issuer, a, b, eur, usd
+
+
+# ---------------------------------------------------------------------------
+# manage offer
+
+def test_create_offer_rests_on_book(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    res = _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    mres = res[0].value.value
+    assert mres.switch == X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_SUCCESS
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_CREATED
+    offers = _offers(mgr)
+    assert len(offers) == 1
+    assert offers[0].amount == 100 and offers[0].price == X.Price(n=2, d=1)
+    # selling liabilities recorded on the EUR line
+    tl = _tl(mgr, a.account_id, eur)
+    assert tl.ext.value.liabilities.selling == 100
+    # offer consumes a subentry
+    assert _acc(mgr, a.account_id).numSubEntries == 3
+
+
+def test_crossing_full_fill(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    # b sells 200 USD for EUR at 1/2 EUR per USD -> exactly crosses
+    res = _ok(mgr, b.tx([manage_sell_offer_op(usd, eur, 200, 1, 2)]))
+    mres = res[0].value.value
+    assert mres.switch == X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_SUCCESS
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_DELETED
+    claimed = mres.value.offersClaimed
+    assert len(claimed) == 1
+    atom = claimed[0].value
+    assert atom.assetSold == eur and atom.amountSold == 100
+    assert atom.amountBought == 200
+    assert _offers(mgr) == []
+    assert _tl(mgr, a.account_id, eur).balance == 9_900
+    assert _tl(mgr, a.account_id, usd).balance == 10_200
+    assert _tl(mgr, b.account_id, eur).balance == 10_100
+    assert _tl(mgr, b.account_id, usd).balance == 9_800
+    # liabilities fully released
+    assert _acc(mgr, a.account_id).numSubEntries == 2
+
+
+def test_crossing_partial_fill_keeps_residual(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    res = _ok(mgr, b.tx([manage_sell_offer_op(usd, eur, 60, 1, 2)]))
+    mres = res[0].value.value
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_DELETED
+    offers = _offers(mgr)
+    assert len(offers) == 1
+    assert offers[0].sellerID == a.account_id
+    assert offers[0].amount == 70       # 100 - 60/2
+    assert _tl(mgr, b.account_id, eur).balance == 10_030
+
+
+def test_taker_at_worse_price_does_not_cross(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    # b bids only 1.5 USD per EUR -> no cross, both offers rest
+    res = _ok(mgr, b.tx([manage_sell_offer_op(usd, eur, 150, 2, 3)]))
+    mres = res[0].value.value
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_CREATED
+    assert len(_offers(mgr)) == 2
+    assert mres.value.offersClaimed == []
+
+
+def test_passive_offer_does_not_cross_equal_price(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 1, 1)]))
+    res = _ok(mgr, b.tx([create_passive_sell_offer_op(usd, eur, 100, 1, 1)]))
+    mres = res[0].value.value
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_CREATED
+    assert len(_offers(mgr)) == 2      # both rest
+    # non-passive same-price offer crosses
+    res = _ok(mgr, b.tx([manage_sell_offer_op(usd, eur, 50, 1, 1)]))
+    assert len(res[0].value.value.value.offersClaimed) == 1
+
+
+def test_update_and_delete_offer(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    res = _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    oid = res[0].value.value.value.offer.value.offerID
+    res = _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 40, 3, 1, offer_id=oid)]))
+    assert res[0].value.value.value.offer.switch == \
+        X.ManageOfferEffect.MANAGE_OFFER_UPDATED
+    offers = _offers(mgr)
+    assert offers[0].amount == 40 and offers[0].price == X.Price(n=3, d=1)
+    assert offers[0].offerID == oid
+    res = _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 0, 1, 1, offer_id=oid)]))
+    assert res[0].value.value.value.offer.switch == \
+        X.ManageOfferEffect.MANAGE_OFFER_DELETED
+    assert _offers(mgr) == []
+    assert _acc(mgr, a.account_id).numSubEntries == 2
+    tl = _tl(mgr, a.account_id, eur)
+    assert tl.ext.switch == 0 or tl.ext.value.liabilities.selling == 0
+
+
+def test_update_missing_offer_not_found(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    op_res = _fail_op(mgr, a.tx([manage_sell_offer_op(eur, usd, 10, 1, 1,
+                                                      offer_id=999)]))
+    assert op_res.value.value.switch == \
+        X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_NOT_FOUND
+
+
+def test_cross_self_rejected(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    op_res = _fail_op(mgr, a.tx([manage_sell_offer_op(usd, eur, 200, 1, 2)]))
+    assert op_res.value.value.switch == \
+        X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_CROSS_SELF
+
+
+def test_manage_buy_offer(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 100, 2, 1)]))
+    # b buys exactly 30 EUR paying USD at up to 2 USD/EUR
+    res = _ok(mgr, b.tx([manage_buy_offer_op(usd, eur, 30, 2, 1)]))
+    mres = res[0].value.value
+    assert mres.switch == X.ManageBuyOfferResultCode.MANAGE_BUY_OFFER_SUCCESS
+    assert mres.value.offer.switch == X.ManageOfferEffect.MANAGE_OFFER_DELETED
+    assert _tl(mgr, b.account_id, eur).balance == 10_030
+    assert _tl(mgr, b.account_id, usd).balance == 10_000 - 60
+    assert _offers(mgr)[0].amount == 70
+
+
+def test_offer_low_reserve(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    base = mgr.root.get_header().baseReserve
+    poor = _new_account(mgr, root, balance=4 * base + 200, tag=9)
+    _ok(mgr, poor.tx([change_trust_op(eur), change_trust_op(usd)]))
+    _ok(mgr, issuer.tx([payment_op(poor.account_id, eur, 100)]))
+    # 2 trustlines consumed the headroom: offer trips the reserve check
+    op_res = _fail_op(mgr, poor.tx([manage_sell_offer_op(eur, usd, 10, 1, 1)]))
+    assert op_res.value.value.switch == \
+        X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_LOW_RESERVE
+
+
+def test_sell_no_trust(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    c = _new_account(mgr, root, tag=11)
+    op_res = _fail_op(mgr, c.tx([manage_sell_offer_op(eur, usd, 10, 1, 1)]))
+    assert op_res.value.value.switch == \
+        X.ManageSellOfferResultCode.MANAGE_SELL_OFFER_SELL_NO_TRUST
+
+
+# ---------------------------------------------------------------------------
+# path payments
+
+def test_path_payment_strict_receive_one_hop(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 1000, 2, 1)]))
+    # b pays c 100 EUR, sending USD through the book (2 USD per EUR)
+    c = _new_account(mgr, root, tag=21)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    res = _ok(mgr, b.tx([path_payment_strict_receive_op(
+        usd, 300, c.account_id, eur, 100)]))
+    pres = res[0].value.value
+    assert pres.switch == \
+        X.PathPaymentStrictReceiveResultCode.PATH_PAYMENT_STRICT_RECEIVE_SUCCESS
+    assert _tl(mgr, c.account_id, eur).balance == 100
+    assert _tl(mgr, b.account_id, usd).balance == 10_000 - 200
+    assert pres.value.last.amount == 100
+
+
+def test_path_payment_over_sendmax(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 1000, 2, 1)]))
+    c = _new_account(mgr, root, tag=22)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    op_res = _fail_op(mgr, b.tx([path_payment_strict_receive_op(
+        usd, 150, c.account_id, eur, 100)]))
+    assert op_res.value.value.switch == \
+        X.PathPaymentStrictReceiveResultCode.PATH_PAYMENT_STRICT_RECEIVE_OVER_SENDMAX
+
+
+def test_path_payment_too_few_offers(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    c = _new_account(mgr, root, tag=23)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    op_res = _fail_op(mgr, b.tx([path_payment_strict_receive_op(
+        usd, 10**9, c.account_id, eur, 100)]))
+    assert op_res.value.value.switch == \
+        X.PathPaymentStrictReceiveResultCode.PATH_PAYMENT_STRICT_RECEIVE_TOO_FEW_OFFERS
+
+
+def test_path_payment_two_hops(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    # books: XLM->USD (a sells USD for XLM at 1), USD->EUR (a sells EUR for USD at 2)
+    xlm = X.Asset.native()
+    _ok(mgr, a.tx([manage_sell_offer_op(usd, xlm, 1000, 1, 1),
+                   manage_sell_offer_op(eur, usd, 1000, 2, 1)]))
+    c = _new_account(mgr, root, tag=24)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    res = _ok(mgr, b.tx([path_payment_strict_receive_op(
+        xlm, 10**9, c.account_id, eur, 100, path=[usd])]))
+    pres = res[0].value.value
+    assert pres.switch == \
+        X.PathPaymentStrictReceiveResultCode.PATH_PAYMENT_STRICT_RECEIVE_SUCCESS
+    assert _tl(mgr, c.account_id, eur).balance == 100
+    assert len(pres.value.offers) == 2
+
+
+def test_path_payment_strict_send(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 1000, 2, 1)]))
+    c = _new_account(mgr, root, tag=25)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    res = _ok(mgr, b.tx([path_payment_strict_send_op(
+        usd, 200, c.account_id, eur, 90)]))
+    pres = res[0].value.value
+    assert pres.switch == \
+        X.PathPaymentStrictSendResultCode.PATH_PAYMENT_STRICT_SEND_SUCCESS
+    assert _tl(mgr, c.account_id, eur).balance == 100
+    assert pres.value.last.amount == 100
+
+
+def test_path_payment_under_destmin(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    _ok(mgr, a.tx([manage_sell_offer_op(eur, usd, 1000, 2, 1)]))
+    c = _new_account(mgr, root, tag=26)
+    _ok(mgr, c.tx([change_trust_op(eur)]))
+    op_res = _fail_op(mgr, b.tx([path_payment_strict_send_op(
+        usd, 200, c.account_id, eur, 101)]))
+    assert op_res.value.value.switch == \
+        X.PathPaymentStrictSendResultCode.PATH_PAYMENT_STRICT_SEND_UNDER_DESTMIN
+
+
+# ---------------------------------------------------------------------------
+# liquidity pools
+
+@pytest.fixture
+def pool(mgr, root, market):
+    issuer, a, b, eur, usd = market
+    pid = pool_id_for(*sorted([eur, usd], key=lambda x: x.to_xdr()))
+    assets = sorted([eur, usd], key=lambda x: x.to_xdr())
+    _ok(mgr, a.tx([change_trust_pool_op(assets[0], assets[1])]))
+    res = _ok(mgr, a.tx([liquidity_pool_deposit_op(pid, 1000, 4000)]))
+    dres = res[0].value.value
+    assert dres.switch == \
+        X.LiquidityPoolDepositResultCode.LIQUIDITY_POOL_DEPOSIT_SUCCESS
+    return pid, assets[0], assets[1]
+
+
+def _pool_entry(mgr, pid):
+    e = mgr.root.get_entry(X.LedgerKey.liquidityPool(
+        X.LedgerKeyLiquidityPool(liquidityPoolID=pid)).to_xdr())
+    return e.data.value.body.value if e else None
+
+
+def test_pool_create_deposit(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    cp = _pool_entry(mgr, pid)
+    assert cp.reserveA == 1000 and cp.reserveB == 4000
+    assert cp.totalPoolShares == 2000          # isqrt(1000*4000)
+    assert cp.poolSharesTrustLineCount == 1
+    tl = _tl(mgr, a.account_id, X.TrustLineAsset.liquidityPoolID(pid))
+    assert tl.balance == 2000
+    # pool-share trustline costs 2 subentries (2 assets + 2 for the pool line)
+    assert _acc(mgr, a.account_id).numSubEntries == 4
+
+
+def test_pool_second_deposit_proportional(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    res = _ok(mgr, a.tx([liquidity_pool_deposit_op(pid, 500, 10_000)]))
+    cp = _pool_entry(mgr, pid)
+    # binding side is A: 500/1000 of the pool -> shares 1000, B = 2000
+    assert cp.reserveA == 1500 and cp.reserveB == 6000
+    assert cp.totalPoolShares == 3000
+
+
+def test_pool_withdraw(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    res = _ok(mgr, a.tx([liquidity_pool_withdraw_op(pid, 1000)]))
+    wres = res[0].value.value
+    assert wres.switch == \
+        X.LiquidityPoolWithdrawResultCode.LIQUIDITY_POOL_WITHDRAW_SUCCESS
+    cp = _pool_entry(mgr, pid)
+    assert cp.reserveA == 500 and cp.reserveB == 2000
+    assert cp.totalPoolShares == 1000
+
+
+def test_pool_withdraw_under_minimum(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    op_res = _fail_op(mgr, a.tx([liquidity_pool_withdraw_op(
+        pid, 1000, min_a=501)]))
+    assert op_res.value.value.switch == \
+        X.LiquidityPoolWithdrawResultCode.LIQUIDITY_POOL_WITHDRAW_UNDER_MINIMUM
+
+
+def test_path_payment_routes_through_pool(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    # no order book at all: the pool is the only venue
+    c = _new_account(mgr, root, tag=31)
+    recv_asset = aa
+    send_asset = ab
+    _ok(mgr, c.tx([change_trust_op(recv_asset)]))
+    res = _ok(mgr, b.tx([path_payment_strict_receive_op(
+        send_asset, 10**9, c.account_id, recv_asset, 100)]))
+    pres = res[0].value.value
+    assert pres.switch == \
+        X.PathPaymentStrictReceiveResultCode.PATH_PAYMENT_STRICT_RECEIVE_SUCCESS
+    assert len(pres.value.offers) == 1
+    assert pres.value.offers[0].switch == \
+        X.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+    assert _tl(mgr, c.account_id, recv_asset).balance == 100
+    cp = _pool_entry(mgr, pid)
+    # pool disbursed 100 of A, received the quoted B amount
+    assert cp.reserveA == 900
+    from stellar_core_tpu.transactions.offer_exchange import (
+        pool_swap_in_given_out)
+    assert cp.reserveB == 4000 + pool_swap_in_given_out(4000, 1000, 100)
+
+
+def test_pool_beats_worse_book_price(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    # a terrible book offer: 100 B per A; pool price ~4 B per A -> pool wins
+    _ok(mgr, a.tx([manage_sell_offer_op(aa, ab, 1000, 100, 1)]))
+    c = _new_account(mgr, root, tag=32)
+    _ok(mgr, c.tx([change_trust_op(aa)]))
+    res = _ok(mgr, b.tx([path_payment_strict_receive_op(
+        ab, 10**9, c.account_id, aa, 100)]))
+    pres = res[0].value.value
+    assert pres.value.offers[0].switch == \
+        X.ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+    # the resting book offer was untouched
+    assert _offers(mgr)[0].amount == 1000
+
+
+def test_book_beats_worse_pool_price(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    # generous book: 1 B per A; pool wants ~4 B per A -> book wins
+    _ok(mgr, a.tx([manage_sell_offer_op(aa, ab, 1000, 1, 1)]))
+    c = _new_account(mgr, root, tag=33)
+    _ok(mgr, c.tx([change_trust_op(aa)]))
+    res = _ok(mgr, b.tx([path_payment_strict_receive_op(
+        ab, 10**9, c.account_id, aa, 100)]))
+    pres = res[0].value.value
+    assert pres.value.offers[0].switch == \
+        X.ClaimAtomType.CLAIM_ATOM_TYPE_ORDER_BOOK
+    assert _pool_entry(mgr, pid).reserveA == 1000  # pool untouched
+
+
+def test_pool_share_trustline_delete(mgr, root, market, pool):
+    issuer, a, b, eur, usd = market
+    pid, aa, ab = pool
+    _ok(mgr, a.tx([liquidity_pool_withdraw_op(pid, 2000)]))
+    _ok(mgr, a.tx([change_trust_pool_op(aa, ab, limit=0)]))
+    assert _pool_entry(mgr, pid) is None
+    assert _tl(mgr, a.account_id, X.TrustLineAsset.liquidityPoolID(pid)) is None
+    assert _acc(mgr, a.account_id).numSubEntries == 2
