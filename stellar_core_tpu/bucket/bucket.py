@@ -41,12 +41,24 @@ class Bucket:
     """Immutable sorted bucket. entries EXCLUDE the meta entry; protocol
     version is carried separately and re-serialized as METAENTRY."""
 
-    __slots__ = ("entries", "protocol_version", "_hash")
+    __slots__ = ("entries", "protocol_version", "_hash", "_sort_keys")
 
     def __init__(self, entries: List[BucketEntry], protocol_version: int):
         self.entries = entries
         self.protocol_version = protocol_version
         self._hash: Optional[bytes] = None
+        self._sort_keys: Optional[List[bytes]] = None
+
+    def find(self, key_bytes: bytes) -> Optional[BucketEntry]:
+        """Binary search by LedgerKey XDR (entries are sorted by exactly
+        this); the key list is built lazily once per immutable bucket."""
+        if self._sort_keys is None:
+            self._sort_keys = [entry_sort_key(e) for e in self.entries]
+        import bisect
+        i = bisect.bisect_left(self._sort_keys, key_bytes)
+        if i < len(self._sort_keys) and self._sort_keys[i] == key_bytes:
+            return self.entries[i]
+        return None
 
     @staticmethod
     def empty() -> "Bucket":

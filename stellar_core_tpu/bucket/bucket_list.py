@@ -93,3 +93,14 @@ class BucketList:
             out.append(lvl.curr)
             out.append(lvl.snap)
         return out
+
+    def lookup_latest(self, key_bytes: bytes) -> Optional[LedgerEntry]:
+        """Newest version of a key across the list, or None if the newest
+        record is a tombstone / the key is absent (reference:
+        SearchableBucketListSnapshot::load — level 0 curr is newest)."""
+        from .bucket import _is_dead
+        for bucket in self.buckets():
+            be = bucket.find(key_bytes)
+            if be is not None:
+                return None if _is_dead(be) else be.value
+        return None

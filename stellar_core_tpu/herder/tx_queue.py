@@ -9,6 +9,7 @@ fee-per-op, trim to the ledger's op limit).
 
 from __future__ import annotations
 
+from fractions import Fraction
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from .. import xdr as X
@@ -41,8 +42,12 @@ class AddResult:
         return f"AddResult({self.code})"
 
 
-def fee_per_op(frame: TransactionFrame) -> float:
-    return frame.fee_bid / max(frame.num_operations(), 1)
+def fee_per_op(frame: TransactionFrame) -> Fraction:
+    """Exact rational fee rate.  Consensus-adjacent ordering must not go
+    through floats: the reference compares fee rates by int128
+    cross-multiplication (TxSetUtils feeRate3WayCompare); Fraction gives the
+    same exact ordering."""
+    return Fraction(frame.fee_bid, max(frame.num_operations(), 1))
 
 
 def surge_sort_key(frame: TransactionFrame):

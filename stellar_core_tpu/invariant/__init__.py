@@ -1,0 +1,16 @@
+"""Invariant framework (reference: src/invariant/)."""
+
+from .invariants import (ALL_INVARIANTS, AccountSubEntriesCountIsValid,
+                         BucketListIsConsistentWithDatabase,
+                         ConservationOfLumens, Invariant,
+                         InvariantDoesNotHold, InvariantManager,
+                         LedgerCloseContext, LedgerEntryIsValid,
+                         LiabilitiesMatchOffers, SponsorshipCountIsValid)
+
+__all__ = [
+    "ALL_INVARIANTS", "AccountSubEntriesCountIsValid",
+    "BucketListIsConsistentWithDatabase", "ConservationOfLumens",
+    "Invariant", "InvariantDoesNotHold", "InvariantManager",
+    "LedgerCloseContext", "LedgerEntryIsValid", "LiabilitiesMatchOffers",
+    "SponsorshipCountIsValid",
+]

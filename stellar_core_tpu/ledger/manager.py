@@ -47,13 +47,25 @@ class ClosedLedgerArtifacts:
     result_entry: X.TransactionHistoryResultEntry
 
 
+_DEFAULT_INVARIANTS = object()
+
+
 class LedgerManager:
-    def __init__(self, network_id: bytes):
+    def __init__(self, network_id: bytes,
+                 invariant_manager=_DEFAULT_INVARIANTS):
+        """invariant_manager: an InvariantManager, None to disable, or
+        default = all invariants enabled (reference ships them off by
+        default; this framework inverts that — fail-stop by default, opt
+        out on the hot replay path)."""
         self.network_id = network_id
         self.bucket_list = BucketList()
         self.root: Optional[LedgerTxnRoot] = None
         self.lcl_header: Optional[X.LedgerHeader] = None
         self.lcl_hash: Optional[bytes] = None
+        if invariant_manager is _DEFAULT_INVARIANTS:
+            from ..invariant import InvariantManager
+            invariant_manager = InvariantManager()
+        self.invariants = invariant_manager
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -169,24 +181,49 @@ class LedgerManager:
                 Upgrades.apply_to_checked(up, header)
         ltx.commit_header(header)
 
-        # split delta into INIT/LIVE/DEAD vs the pre-close state
+        # split delta into INIT/LIVE/DEAD vs the pre-close state; stamp
+        # lastModified at top-level commit time (reference: LedgerTxn
+        # shouldUpdateLastModified at the root commit)
         delta = ltx.delta()
+        pre_entries = {kb: self.root.get_entry(kb) for kb in delta}
         init_entries, live_entries, dead_keys = [], [], []
         for kb, entry in delta.items():
-            pre = self.root.get_entry(kb)
+            pre = pre_entries[kb]
             if entry is None:
                 if pre is not None:
                     dead_keys.append(X.LedgerKey.from_xdr(kb))
             elif pre is None:
+                entry.lastModifiedLedgerSeq = seq
                 init_entries.append(entry)
             else:
+                entry.lastModifiedLedgerSeq = seq
                 live_entries.append(entry)
+
+        # pre-bucket invariant phase: a violation here fail-stops with the
+        # manager un-torn (neither root store nor bucket list advanced)
+        inv_ctx = None
+        if self.invariants is not None:
+            from ..invariant import LedgerCloseContext
+            inv_ctx = LedgerCloseContext(
+                pre=pre_entries, post=delta,
+                pre_header=self.lcl_header, post_header=ltx.get_header(),
+                root_get=self.root.get_entry,
+                all_keys=lambda: list(self.root.all_keys()),
+                bucket_list=self.bucket_list)
+            self.invariants.check_on_ledger_close(inv_ctx,
+                                                  needs_buckets=False)
+
         self.bucket_list.add_batch(seq, header.ledgerVersion,
                                    init_entries, live_entries, dead_keys)
         header = ltx.load_header()
         header.bucketListHash = self.bucket_list.hash()
         self._update_skip_list(header)
         ltx.commit_header(header)
+
+        if inv_ctx is not None:
+            # post-bucket phase: a violation means the bucket list is
+            # corrupt; the manager must be discarded
+            self.invariants.check_on_ledger_close(inv_ctx, needs_buckets=True)
         ltx.commit()
 
         self.lcl_header = self.root.get_header()

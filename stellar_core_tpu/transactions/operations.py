@@ -719,8 +719,10 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
         src_id = self.source_account_id()
         src_e = load_account(ltx, src_id)
         src = src_e.data.value
-        # reserve for claimants paid by source (numSubEntries += n)
-        if not add_num_entries(header, src, len(b.claimants)):
+        # reserve for claimants is a sponsored reserve on the source, not a
+        # subentry (reference: CreateClaimableBalanceOpFrame — the entry is
+        # created with createEntryWithPossibleSponsorship; numSponsoring)
+        if not utils.add_num_sponsoring(header, src, len(b.claimants)):
             return self.result(C.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
         if b.asset.switch == X.AssetType.ASSET_TYPE_NATIVE:
             if not add_balance(src, -b.amount, header):
@@ -755,7 +757,10 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
         ltx.update(src_e)
         ltx.create(X.LedgerEntry(
             lastModifiedLedgerSeq=header.ledgerSeq,
-            data=X.LedgerEntryData.claimableBalance(entry)))
+            data=X.LedgerEntryData.claimableBalance(entry),
+            ext=X.LedgerEntryExt.v1(X.LedgerEntryExtensionV1(
+                sponsoringID=src_id,
+                ext=X.LedgerEntryExtensionV1Ext.v0()))))
         return self.result(C.CREATE_CLAIMABLE_BALANCE_SUCCESS, bid)
 
 
@@ -794,6 +799,23 @@ def predicate_satisfied(pred: X.ClaimPredicate, close_time: int,
     if pred.switch == PT.CLAIM_PREDICATE_BEFORE_RELATIVE_TIME:
         return close_time < created_time + pred.value
     return False
+
+
+def _release_claimable_balance_reserve(ltx, cb_entry: X.LedgerEntry,
+                                       header) -> None:
+    """Refund the sponsor's numSponsoring when a claimable balance leaves
+    the ledger (reference: removeEntryWithPossibleSponsorship)."""
+    if cb_entry.ext.switch != 1 or cb_entry.ext.value.sponsoringID is None:
+        return
+    sp_e = load_account(ltx, cb_entry.ext.value.sponsoringID)
+    if sp_e is None:
+        return  # unreachable while merge rejects IS_SPONSOR; defensive
+    released = utils.add_num_sponsoring(
+        header, sp_e.data.value, -len(cb_entry.data.value.claimants))
+    if not released:  # decrement below zero: counts were already corrupt
+        raise RuntimeError("claimable balance sponsor count underflow")
+    sp_e.lastModifiedLedgerSeq = header.ledgerSeq
+    ltx.update(sp_e)
 
 
 class ClaimClaimableBalanceOpFrame(OperationFrame):
@@ -837,6 +859,7 @@ class ClaimClaimableBalanceOpFrame(OperationFrame):
                 return self.result(C.CLAIM_CLAIMABLE_BALANCE_LINE_FULL)
             tl_e.lastModifiedLedgerSeq = header.ledgerSeq
             ltx.update(tl_e)
+        _release_claimable_balance_reserve(ltx, cb_e, header)
         ltx.erase(key)
         return self.success()
 
@@ -889,6 +912,7 @@ class ClawbackClaimableBalanceOpFrame(OperationFrame):
         flags = cb.ext.value.flags if cb.ext.switch == 1 else 0
         if not (flags & X.ClaimableBalanceFlags.CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG):
             return self.result(C.CLAWBACK_CLAIMABLE_BALANCE_NOT_CLAWBACK_ENABLED)
+        _release_claimable_balance_reserve(ltx, cb_e, ltx.get_header())
         ltx.erase(key)
         return self.success()
 

@@ -73,6 +73,37 @@ def _acc_ext_v2(acc: X.AccountEntry):
     return None
 
 
+def _ensure_acc_ext_v2(acc: X.AccountEntry) -> X.AccountEntryExtensionV2:
+    """Materialize the v1+v2 extension chain (reference: prepareAccountEntry
+    extension upgrade on first sponsorship use)."""
+    if acc.ext.switch == 0:
+        acc.ext = X.AccountEntryExt.v1(X.AccountEntryExtensionV1(
+            liabilities=X.Liabilities(buying=0, selling=0)))
+    v1 = acc.ext.value
+    if v1.ext.switch != 2:
+        v1.ext = X.AccountEntryExtensionV1Ext.v2(X.AccountEntryExtensionV2())
+    return v1.ext.value
+
+
+def add_num_sponsoring(header: X.LedgerHeader, acc: X.AccountEntry,
+                       delta: int) -> bool:
+    """Adjust numSponsoring with a reserve check on increase (reference:
+    createSponsoredEntry path — the sponsor's balance must cover the
+    enlarged minimum balance)."""
+    current = num_sponsoring(acc)
+    new_count = current + delta
+    if new_count < 0:
+        return False
+    if delta > 0:
+        need = (2 + acc.numSubEntries + new_count - num_sponsored(acc)) \
+            * header.baseReserve
+        _, selling = account_liabilities(acc)
+        if acc.balance < need + selling:
+            return False
+    _ensure_acc_ext_v2(acc).numSponsoring = new_count
+    return True
+
+
 def account_liabilities(acc: X.AccountEntry) -> Tuple[int, int]:
     """(buying, selling)."""
     v1 = _acc_ext_v1(acc)

@@ -350,10 +350,13 @@ LedgerEntryData = xdr_union("LedgerEntryData", LedgerEntryType, {
     LedgerEntryType.TTL: ("ttl", TTLEntry),
 })
 
+LedgerEntryExtensionV1Ext = xdr_union("LedgerEntryExtensionV1Ext", Int32,
+                                      {0: ("v0", None)})
+
 LedgerEntryExtensionV1 = xdr_struct("LedgerEntryExtensionV1", [
     ("sponsoringID", SponsorshipDescriptor),
-    ("ext", xdr_union("LedgerEntryExtensionV1Ext", Int32, {0: ("v0", None)})),
-])
+    ("ext", LedgerEntryExtensionV1Ext),
+], defaults={"ext": lambda: LedgerEntryExtensionV1Ext.v0()})
 
 LedgerEntryExt = xdr_union("LedgerEntryExt", Int32, {
     0: ("v0", None),

@@ -1,0 +1,158 @@
+"""Invariant framework tests.
+
+Reference test model: src/invariant/test/{ConservationOfLumensTests,
+AccountSubEntriesCountIsValidTests, LiabilitiesMatchOffersTests,
+BucketListIsConsistentWithDatabaseTests}.cpp — each invariant must catch a
+deliberately broken apply, and hold on every well-formed close (the latter
+is exercised implicitly: InvariantManager defaults on in every LedgerManager
+test fixture in this suite).
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.invariant import (InvariantDoesNotHold,
+                                        InvariantManager)
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.testutils import (TestAccount, change_trust_op,
+                                        create_account_op, make_asset,
+                                        manage_sell_offer_op, network_id,
+                                        payment_op)
+from stellar_core_tpu.transactions import operations as ops_mod
+from stellar_core_tpu.transactions.offer_ops import ManageSellOfferOpFrame
+
+NID = network_id("invariant test net")
+
+
+@pytest.fixture
+def mgr():
+    m = LedgerManager(NID)
+    m.start_new_ledger()
+    return m
+
+
+@pytest.fixture
+def root(mgr):
+    sk = mgr.root_account_secret()
+    e = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, e.data.value.seqNum)
+
+
+def test_enabled_by_default_and_pass_on_normal_close(mgr, root):
+    assert mgr.invariants is not None
+    assert len(mgr.invariants.invariants) == 6
+    from stellar_core_tpu.crypto.keys import SecretKey
+    dest = SecretKey(b"\x07" * 32)
+    mgr.close_ledger([root.tx([create_account_op(
+        X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])], 1000)
+
+
+def test_from_patterns_selects_by_regex():
+    m = InvariantManager.from_patterns(["Conservation.*"])
+    assert [i.NAME for i in m.invariants] == ["ConservationOfLumens"]
+    assert InvariantManager.from_patterns([r"(?!.*)"]).invariants == []
+    assert len(InvariantManager.from_patterns([".*"]).invariants) == 6
+
+
+def test_conservation_of_lumens_catches_minting(mgr, root, monkeypatch):
+    """A payment that credits the destination without debiting the source
+    mints lumens out of thin air — ConservationOfLumens must fail-stop."""
+    orig = ops_mod.PaymentOpFrame.do_apply
+
+    def evil(self, ltx):
+        from stellar_core_tpu.transactions.utils import (add_balance,
+                                                         load_account)
+        dest = X.muxed_to_account_id(self.body.destination)
+        e = load_account(ltx, dest)
+        assert add_balance(e.data.value, self.body.amount)
+        ltx.update(e)
+        return self.success()
+
+    monkeypatch.setattr(ops_mod.PaymentOpFrame, "do_apply", evil)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    dest = SecretKey(b"\x08" * 32)
+    mgr.close_ledger([root.tx([create_account_op(
+        X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])], 1000)
+    native = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, None)
+    with pytest.raises(InvariantDoesNotHold, match="ConservationOfLumens"):
+        mgr.close_ledger([root.tx([payment_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), native, 5)])], 1001)
+
+
+def test_subentries_count_catches_unbumped_count(mgr, root, monkeypatch):
+    """ChangeTrust that creates a trustline without bumping numSubEntries."""
+    monkeypatch.setattr(ops_mod, "add_num_entries",
+                        lambda header, acc, delta: True)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    issuer = SecretKey(b"\x09" * 32)
+    mgr.close_ledger([root.tx([create_account_op(
+        X.AccountID.ed25519(issuer.public_key.ed25519), 10**11)])], 1000)
+    eur = make_asset("EUR", X.AccountID.ed25519(issuer.public_key.ed25519))
+    with pytest.raises(InvariantDoesNotHold,
+                       match="AccountSubEntriesCountIsValid"):
+        mgr.close_ledger([root.tx([change_trust_op(eur)])], 1001)
+
+
+def test_liabilities_match_offers_catches_unacquired(mgr, root, monkeypatch):
+    """An offer resting on the book without its liabilities recorded."""
+    from stellar_core_tpu.transactions import offer_ops
+    monkeypatch.setattr(offer_ops, "acquire_or_release_offer_liabilities",
+                        lambda ltx, offer, acquire: True)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    issuer_sk = SecretKey(b"\x0a" * 32)
+    issuer_id = X.AccountID.ed25519(issuer_sk.public_key.ed25519)
+    mgr.close_ledger([root.tx([create_account_op(issuer_id, 10**11)])], 1000)
+    e = mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=issuer_id)).to_xdr())
+    issuer = TestAccount(mgr, issuer_sk, e.data.value.seqNum)
+    eur = make_asset("EUR", issuer_id)
+    native = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, None)
+    with pytest.raises(InvariantDoesNotHold, match="LiabilitiesMatchOffers"):
+        mgr.close_ledger([issuer.tx([manage_sell_offer_op(
+            eur, native, 100, 1, 1)])], 1001)
+
+
+def test_bucket_consistency_catches_dropped_entry(mgr, root, monkeypatch):
+    """add_batch that silently drops an init entry desynchronizes the bucket
+    list from the ledger state."""
+    orig = mgr.bucket_list.add_batch
+
+    def lossy(seq, ver, init, live, dead):
+        orig(seq, ver, list(init)[1:], live, dead)
+
+    monkeypatch.setattr(mgr.bucket_list, "add_batch", lossy)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    dest = SecretKey(b"\x0b" * 32)
+    with pytest.raises(InvariantDoesNotHold,
+                       match="BucketListIsConsistentWithDatabase"):
+        mgr.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])], 1000)
+
+
+def test_sponsorship_count_catches_unreleased_reserve(mgr, root, monkeypatch):
+    """Claiming a claimable balance without refunding the sponsor's
+    numSponsoring leaks the reserve."""
+    monkeypatch.setattr(ops_mod, "_release_claimable_balance_reserve",
+                        lambda ltx, cb_entry, header: None)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    native = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, None)
+    claimant_sk = SecretKey(b"\x0c" * 32)
+    claimant_id = X.AccountID.ed25519(claimant_sk.public_key.ed25519)
+    mgr.close_ledger([root.tx([create_account_op(claimant_id, 10**11)])], 1000)
+    arts = mgr.close_ledger([root.tx([X.Operation(
+        body=X.OperationBody.createClaimableBalanceOp(
+            X.CreateClaimableBalanceOp(
+                asset=native, amount=1000,
+                claimants=[X.Claimant.v0(X.ClaimantV0(
+                    destination=claimant_id,
+                    predicate=X.ClaimPredicate.unconditional()))])))])], 1001)
+    cbid = arts.result_entry.txResultSet.results[0].result.result.value[0] \
+        .value.value.value
+    e = mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=claimant_id)).to_xdr())
+    claimant = TestAccount(mgr, claimant_sk, e.data.value.seqNum)
+    with pytest.raises(InvariantDoesNotHold, match="SponsorshipCountIsValid"):
+        mgr.close_ledger([claimant.tx([X.Operation(
+            body=X.OperationBody.claimClaimableBalanceOp(
+                X.ClaimClaimableBalanceOp(balanceID=cbid)))])], 1002)

@@ -112,3 +112,28 @@ class TestLedgerInteraction:
         assert frames[0] is fb  # higher fee-per-op first
         # trim to 1 op: only the best survives
         assert q.tx_set_frames(max_ops=1) == [fb]
+
+
+class TestExactFeeRate:
+    def test_fee_per_op_is_exact_rational(self, env):
+        lm, q, a, b, root = env
+        from fractions import Fraction
+        from stellar_core_tpu.herder.tx_queue import fee_per_op, surge_sort_key
+        op = lambda: native_payment_op(
+            X.AccountID.ed25519(a.secret.public_key.ed25519), 1)
+        hi = b.tx([op()] * 2, fee=101)          # 50.5 per op
+        lo = b.tx([op()] * 4, fee=201)          # 50.25 per op
+        assert fee_per_op(hi) == Fraction(101, 2)
+        assert isinstance(fee_per_op(hi), Fraction)
+        assert sorted([lo, hi], key=surge_sort_key)[0] is hi
+
+    def test_equal_fee_rate_tiebreak_is_hash(self, env):
+        lm, q, a, b, root = env
+        from stellar_core_tpu.herder.tx_queue import fee_per_op, surge_sort_key
+        op = lambda: native_payment_op(
+            X.AccountID.ed25519(a.secret.public_key.ed25519), 1)
+        f1 = b.tx([op()], fee=100)
+        f2 = b.tx([op()] * 2, fee=200)          # exactly equal rate
+        assert fee_per_op(f1) == fee_per_op(f2)
+        first = sorted([f1, f2], key=surge_sort_key)[0]
+        assert first is min((f1, f2), key=lambda f: f.content_hash())
