@@ -1,0 +1,82 @@
+"""BucketDir: content-addressed on-disk bucket files.
+
+Reference: src/bucket/BucketManager.{h,cpp} — adoptFileAsBucket /
+getBucketByHash over `buckets/bucket-<hex>.xdr`, plus forgetUnreferenced
+garbage collection.  Files are immutable once written (content-addressed by
+SHA-256 of the serialized stream), written atomically via tmp+rename, and
+verified against their name hash on load.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Set
+
+from .bucket import Bucket
+
+_EMPTY_HEX = "0" * 64
+
+
+class BucketDir:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file_for(self, hex_hash: str) -> str:
+        return os.path.join(self.path, f"bucket-{hex_hash}.xdr")
+
+    def save(self, bucket: Bucket) -> str:
+        """Persist a bucket; returns its hex hash.  Existing files are
+        trusted (content addressing makes rewrites pointless)."""
+        hh = bucket.hash().hex()
+        if bucket.is_empty():
+            return _EMPTY_HEX
+        target = self._file_for(hh)
+        if os.path.exists(target):
+            return hh
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(bucket.serialize())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+        # fsync the directory so the rename itself survives power loss —
+        # the DB that points at this bucket commits after us
+        dfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return hh
+
+    def load(self, hex_hash: str) -> Optional[Bucket]:
+        """Load and hash-verify a bucket; None when absent.  A corrupt file
+        raises — silently returning None would let catchup treat corruption
+        as absence."""
+        if hex_hash == _EMPTY_HEX:
+            return Bucket.empty()
+        target = self._file_for(hex_hash)
+        if not os.path.exists(target):
+            return None
+        with open(target, "rb") as f:
+            bucket = Bucket.deserialize(f.read())
+        if bucket.hash().hex() != hex_hash:
+            raise RuntimeError(f"bucket file {target} fails hash check")
+        return bucket
+
+    def exists(self, hex_hash: str) -> bool:
+        return hex_hash == _EMPTY_HEX or os.path.exists(self._file_for(hex_hash))
+
+    def gc(self, referenced: Iterable[str]) -> int:
+        """Delete bucket files not in `referenced` (reference:
+        BucketManager::forgetUnreferencedBuckets).  Returns removed count."""
+        keep: Set[str] = set(referenced)
+        removed = 0
+        for name in os.listdir(self.path):
+            if not (name.startswith("bucket-") and name.endswith(".xdr")):
+                continue
+            hh = name[len("bucket-"):-len(".xdr")]
+            if hh not in keep:
+                os.unlink(os.path.join(self.path, name))
+                removed += 1
+        return removed

@@ -237,36 +237,24 @@ class CatchupManager:
         if len(hashes) != NUM_LEVELS * 2:
             raise CatchupError("HAS bucket list malformed")
         empty = "0" * 64
-        from ..ledger.ledger_txn import LedgerTxnRoot
-        root = LedgerTxnRoot(tail.header)
-        seen: set = set()
-        for i in range(NUM_LEVELS):
-            for j, attr in ((0, "curr"), (1, "snap")):
-                hh = hashes[i * 2 + j]
-                if hh == empty:
-                    bucket = Bucket.empty()
-                else:
-                    b = archive.get_bucket(hh)
-                    if b is None:
-                        raise CatchupError(f"missing bucket {hh}")
-                    bucket = b
-                setattr(mgr.bucket_list.levels[i], attr, bucket)
-                # newest-first state assumption: first record wins per key
-                for be in bucket.entries:
-                    if be.switch == X.BucketEntryType.DEADENTRY:
-                        kb = be.value.to_xdr()
-                        if kb not in seen:
-                            seen.add(kb)
-                    else:
-                        kb = X.ledger_entry_key(be.value).to_xdr()
-                        if kb not in seen:
-                            seen.add(kb)
-                            root._apply_delta({kb: be.value}, None)
-        if mgr.bucket_list.hash() != tail.header.bucketListHash:
-            raise CatchupError("assumed bucket list hash != header hash")
-        mgr.root = root
+
+        def source(idx: int) -> Bucket:
+            hh = hashes[idx]
+            if hh == empty:
+                return Bucket.empty()
+            b = archive.get_bucket(hh)
+            if b is None:
+                raise CatchupError(f"missing bucket {hh}")
+            return b
+
+        from ..ledger.manager import assume_bucket_state
+        try:
+            mgr.root = assume_bucket_state(mgr.bucket_list, tail.header,
+                                           source)
+        except RuntimeError as e:
+            raise CatchupError(str(e)) from e
         mgr.lcl_header = tail.header
         mgr.lcl_hash = tail.hash
         log.info("assumed state at ledger %d (%d entries)",
-                 checkpoint, root.entry_count())
+                 checkpoint, mgr.root.entry_count())
         return mgr

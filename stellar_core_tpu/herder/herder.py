@@ -84,6 +84,7 @@ class Herder(SCPDriver):
         self.out_of_sync_handler: Callable[[], None] = lambda: None
         self.ledger_closed_hook: Callable[[object], None] = lambda arts: None
 
+        self.db = None  # database.Database; attach_persistence()
         self._timers: Dict[Tuple[int, int], VirtualTimer] = {}
         self._trigger_timer: Optional[VirtualTimer] = None
         self._last_trigger_at: float = clock.now()
@@ -413,6 +414,7 @@ class Herder(SCPDriver):
             arts = self.lm.close_ledger(frames, sv.closeTime, tx_set=txset,
                                         stellar_value=sv)
             self.state = HerderState.TRACKING
+            self._persist_scp_state(nxt, sv, txset)
             self.ledger_closed_hook(arts)
             self.tx_queue.remove_applied(frames)
             self.tx_queue.shift()
@@ -448,6 +450,63 @@ class Herder(SCPDriver):
         self._trigger_timer = VirtualTimer(self.clock)
         self._trigger_timer.expires_from_now(
             delay, lambda: self.trigger_next_ledger(next_seq))
+
+    # ------------------------------------------------------------------
+    # SCP state persistence (reference: HerderImpl::persistSCPState /
+    # restoreSCPState via HerderPersistence + PersistentState)
+    # ------------------------------------------------------------------
+    def attach_persistence(self, db) -> None:
+        self.db = db
+
+    def _persist_scp_state(self, slot: int, sv, txset) -> None:
+        """Durably record the externalized slot's SCP messages, referenced
+        quorum sets and tx set, so a restarted node can re-serve its last
+        consensus state to peers."""
+        if self.db is None:
+            return
+        from ..database import PersistentState
+        from .pending_envelopes import statement_qset_hash
+        envs = self.scp.slots[slot].get_current_state() \
+            if slot in self.scp.slots else []
+        qsets = []
+        seen = set()
+        for env in envs:
+            qh = statement_qset_hash(env.statement)
+            if qh not in seen:
+                seen.add(qh)
+                qs = self.pending.get_qset(qh)
+                if qs is not None:
+                    qsets.append(qs)
+        self.db.save_scp_history(slot, envs, qsets)
+        self.db.save_txset(sv.txSetHash, slot, txset.to_xdr())
+        self.db.set_state(PersistentState.LAST_SCP_DATA, str(slot))
+        if slot > MAX_SLOTS_TO_REMEMBER:
+            self.db.prune_scp(slot - MAX_SLOTS_TO_REMEMBER)
+        self.db.commit()
+
+    def restore_scp_state(self) -> None:
+        """Reload the persisted slot's tx sets, quorum sets and envelopes
+        after a restart.  Envelopes re-enter through the normal intake so
+        SCP slot state is rebuilt exactly as if received from peers."""
+        if self.db is None:
+            return
+        from ..database import PersistentState
+        val = self.db.get_state(PersistentState.LAST_SCP_DATA)
+        if val is None:
+            return
+        for h, blob in self.db.load_txsets():
+            try:
+                txset = X.TransactionSet.from_xdr(blob)
+                frames = [self.lm.make_frame(e) for e in txset.txs]
+            except Exception:
+                log.warning("dropping undecodable stored txset %s", h.hex())
+                continue
+            self.pending.add_txset(h, txset, frames)
+        for qs in self.db.load_scp_quorums():
+            self.pending.add_qset(qs)
+        for env in self.db.load_scp_history(int(val)):
+            self.recv_scp_envelope(env)
+        log.info("restored SCP state for slot %s", val)
 
     # ------------------------------------------------------------------
     # SCP state sync (peer (re)connect / out-of-sync recovery)

@@ -30,10 +30,16 @@ class HistoryManager:
     configured archives as boundaries are crossed."""
 
     def __init__(self, ledger_mgr: LedgerManager, network_passphrase: str,
-                 archives: Optional[List[FileHistoryArchive]] = None):
+                 archives: Optional[List[FileHistoryArchive]] = None,
+                 database=None):
+        """With `database`, per-ledger artifacts and the publish queue are
+        durable: a node killed mid-checkpoint republishes after restart
+        (reference: CheckpointBuilder's on-disk .dirty streams + the
+        publishqueue table)."""
         self.ledger_mgr = ledger_mgr
         self.network_passphrase = network_passphrase
         self.archives = archives or []
+        self.db = database
         self._pending: List[ClosedLedgerArtifacts] = []
         self.published_checkpoints: List[int] = []
 
@@ -42,17 +48,47 @@ class HistoryManager:
         + HistoryManager::maybeQueueHistoryCheckpoint)."""
         self._pending.append(arts)
         seq = arts.header_entry.header.ledgerSeq
-        if is_checkpoint_boundary(seq):
-            self.publish_checkpoint(seq)
+        if self.db is not None:
+            self.db.save_tx_history(seq, _THE.pack(arts.tx_entry),
+                                    _THRE.pack(arts.result_entry))
+            self.db.commit()
+        self.maybe_queue_and_publish(seq)
+
+    def _artifacts_from_db(self, checkpoint_seq: int):
+        """Rebuild the checkpoint's streams from durable state (survives a
+        crash that wiped the in-memory pending list)."""
+        lo = max(2, checkpoint_seq - CHECKPOINT_FREQUENCY + 1)
+        headers, txs, results = [], [], []
+        for seq in range(lo, checkpoint_seq + 1):
+            got = self.db.load_header_by_seq(seq)
+            if got is None:
+                # publishing a checkpoint with holes would poison every
+                # node that later catches up from this archive — fail-stop
+                raise RuntimeError(
+                    f"header {seq} missing from DB while publishing "
+                    f"checkpoint {checkpoint_seq}")
+            h, header = got
+            headers.append(X.LedgerHeaderHistoryEntry(hash=h, header=header))
+        for seq, te, re_ in self.db.load_tx_history(lo, checkpoint_seq):
+            tx_entry = _THE.unpack(te)
+            result_entry = _THRE.unpack(re_)
+            if tx_entry.txSet.txs:
+                txs.append(tx_entry)
+            if result_entry.txResultSet.results:
+                results.append(result_entry)
+        return headers, txs, results
 
     def publish_checkpoint(self, checkpoint_seq: int) -> None:
         """Write ledger/transactions/results streams, bucket files and the
         HAS for this checkpoint to every archive."""
-        headers = [a.header_entry for a in self._pending]
-        txs = [a.tx_entry for a in self._pending
-               if a.tx_entry.txSet.txs]
-        results = [a.result_entry for a in self._pending
-                   if a.result_entry.txResultSet.results]
+        if self.db is not None:
+            headers, txs, results = self._artifacts_from_db(checkpoint_seq)
+        else:
+            headers = [a.header_entry for a in self._pending]
+            txs = [a.tx_entry for a in self._pending
+                   if a.tx_entry.txSet.txs]
+            results = [a.result_entry for a in self._pending
+                       if a.result_entry.txResultSet.results]
         level_hashes = [
             {"curr": lvl.curr.hash().hex(), "snap": lvl.snap.hash().hex()}
             for lvl in self.ledger_mgr.bucket_list.levels]
@@ -74,5 +110,37 @@ class HistoryManager:
             archive.put_state(has)
         self.published_checkpoints.append(checkpoint_seq)
         self._pending.clear()
+        if self.db is not None:
+            self.db.dequeue_publish(checkpoint_seq)
+            # retain two checkpoint windows of artifacts + headers (the
+            # reference's maintenance keeps a sliding window too)
+            keep_from = checkpoint_seq - 2 * CHECKPOINT_FREQUENCY
+            self.db.prune_tx_history(keep_from)
+            self.db.delete_old_headers(keep_from)
+            self.db.commit()
         log.info("published checkpoint %d (%d headers, %d tx entries)",
                  checkpoint_seq, len(headers), len(txs))
+
+    def maybe_queue_and_publish(self, seq: int) -> None:
+        """Durable two-step publish: enqueue the boundary, then publish and
+        dequeue — a crash between the two republishes at startup
+        (reference: queueCurrentHistory + publishQueuedHistory)."""
+        if self.db is None:
+            if is_checkpoint_boundary(seq):
+                self.publish_checkpoint(seq)
+            return
+        if is_checkpoint_boundary(seq):
+            self.db.queue_publish(seq, "")
+            self.db.commit()
+        self.publish_queued_history()
+
+    def publish_queued_history(self) -> int:
+        """Publish every queued checkpoint (startup recovery path).
+        Returns the number published."""
+        if self.db is None:
+            return 0
+        done = 0
+        for seq, _state in self.db.publish_queue():
+            self.publish_checkpoint(seq)
+            done += 1
+        return done

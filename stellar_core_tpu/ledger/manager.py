@@ -47,6 +47,38 @@ class ClosedLedgerArtifacts:
     result_entry: X.TransactionHistoryResultEntry
 
 
+def assume_bucket_state(bucket_list, header: X.LedgerHeader,
+                        bucket_source) -> LedgerTxnRoot:
+    """Fill `bucket_list`'s levels from `bucket_source(hex_hash) -> Bucket`
+    and derive the authoritative entry store newest-first (first record per
+    key wins; DEADENTRY shadows older versions).  Verifies the reassembled
+    list against header.bucketListHash.  Shared by restart
+    (loadLastKnownLedger) and catchup state assumption (ApplyBucketsWork +
+    BucketApplicator)."""
+    from ..bucket.bucket_list import NUM_LEVELS
+
+    seen: set = set()
+    root = LedgerTxnRoot(header)
+    for i in range(NUM_LEVELS):
+        for j, attr in ((0, "curr"), (1, "snap")):
+            bucket = bucket_source(i * 2 + j)
+            if bucket is None:
+                raise RuntimeError("missing bucket for level %d %s"
+                                   % (i, attr))
+            setattr(bucket_list.levels[i], attr, bucket)
+            for be in bucket.entries:
+                if be.switch == X.BucketEntryType.DEADENTRY:
+                    seen.add(be.value.to_xdr())
+                else:
+                    kb = X.ledger_entry_key(be.value).to_xdr()
+                    if kb not in seen:
+                        seen.add(kb)
+                        root._apply_delta({kb: be.value}, None)
+    if bucket_list.hash() != header.bucketListHash:
+        raise RuntimeError("assumed bucket list hash != header hash")
+    return root
+
+
 _DEFAULT_INVARIANTS = object()
 
 
@@ -66,6 +98,8 @@ class LedgerManager:
             from ..invariant import InvariantManager
             invariant_manager = InvariantManager()
         self.invariants = invariant_manager
+        self.db = None           # database.Database when persistence is on
+        self.bucket_dir = None   # bucket.manager.BucketDir
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -234,12 +268,101 @@ class LedgerManager:
                 f"ledger {seq} hash mismatch: got {self.lcl_hash.hex()} "
                 f"expected {expected_ledger_hash.hex()}")
 
+        if self.db is not None:
+            self._persist_lcl()
+
         header_entry = X.LedgerHeaderHistoryEntry(
             hash=self.lcl_hash, header=self.lcl_header)
         tx_entry = X.TransactionHistoryEntry(ledgerSeq=seq, txSet=tx_set)
         result_entry = X.TransactionHistoryResultEntry(
             ledgerSeq=seq, txResultSet=result_set)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)
+
+    # -- durable persistence -------------------------------------------------
+    def enable_persistence(self, database, bucket_dir) -> None:
+        """Attach a Database + BucketDir; every close (and the current LCL,
+        immediately) is then durably recorded.  Reference: the implicit
+        persistence of LedgerManagerImpl's SQL store + BucketManager."""
+        self.db = database
+        self.bucket_dir = bucket_dir
+        if self.lcl_header is not None:
+            self._persist_lcl()
+
+    def _has_json(self) -> str:
+        from ..history.archive import HistoryArchiveState
+        level_hashes = [{"curr": lvl.curr.hash().hex(),
+                         "snap": lvl.snap.hash().hex()}
+                        for lvl in self.bucket_list.levels]
+        return HistoryArchiveState(self.last_closed_ledger_seq,
+                                   self.network_id.hex(),
+                                   level_hashes).to_json()
+
+    def _persist_lcl(self) -> None:
+        """Bucket files first (content-addressed, idempotent), then the
+        header row + storestate pointers in one sqlite transaction — a crash
+        between the two leaves only orphaned bucket files, never a DB that
+        references missing buckets."""
+        from ..database import PersistentState
+        for lvl in self.bucket_list.levels:
+            self.bucket_dir.save(lvl.curr)
+            self.bucket_dir.save(lvl.snap)
+        self.db.store_header(self.lcl_hash, self.lcl_header)
+        self.db.set_state(PersistentState.LAST_CLOSED_LEDGER,
+                          self.lcl_hash.hex())
+        self.db.set_state(PersistentState.HISTORY_ARCHIVE_STATE,
+                          self._has_json())
+        self.db.set_state(PersistentState.NETWORK_PASSPHRASE,
+                          self.network_id.hex())
+        self.db.commit()
+
+    @classmethod
+    def load_last_known_ledger(cls, network_id: bytes, database, bucket_dir,
+                               invariant_manager=_DEFAULT_INVARIANTS
+                               ) -> "LedgerManager":
+        """Rebuild a manager from durable state (reference:
+        LedgerManagerImpl::loadLastKnownLedger): header from the DB, bucket
+        list from on-disk bucket files named by the stored HAS, entry store
+        re-derived newest-first from the bucket list, everything
+        hash-verified against the stored header."""
+        from ..database import PersistentState
+        from ..history.archive import HistoryArchiveState
+        from ..bucket.bucket_list import NUM_LEVELS
+
+        lcl_hex = database.get_state(PersistentState.LAST_CLOSED_LEDGER)
+        if lcl_hex is None:
+            raise RuntimeError("database has no last closed ledger")
+        stored_net = database.get_state(PersistentState.NETWORK_PASSPHRASE)
+        if stored_net is not None and stored_net != network_id.hex():
+            raise RuntimeError("database belongs to a different network")
+        header = database.load_header_by_hash(bytes.fromhex(lcl_hex))
+        if header is None:
+            raise RuntimeError("stored LCL header missing")
+        if sha256(header.to_xdr()) != bytes.fromhex(lcl_hex):
+            raise RuntimeError("stored LCL header fails hash check")
+        has_json = database.get_state(PersistentState.HISTORY_ARCHIVE_STATE)
+        if has_json is None:
+            raise RuntimeError("database has no archive state")
+        has = HistoryArchiveState.from_json(has_json)
+
+        mgr = cls(network_id, invariant_manager=invariant_manager)
+        hashes = has.bucket_hashes()
+        if len(hashes) != NUM_LEVELS * 2:
+            raise RuntimeError("stored HAS malformed")
+
+        def source(idx: int):
+            bucket = bucket_dir.load(hashes[idx])
+            if bucket is None:
+                raise RuntimeError(f"missing bucket {hashes[idx]}")
+            return bucket
+
+        mgr.root = assume_bucket_state(mgr.bucket_list, header, source)
+        mgr.lcl_header = header
+        mgr.lcl_hash = bytes.fromhex(lcl_hex)
+        mgr.db = database
+        mgr.bucket_dir = bucket_dir
+        log.info("resumed at ledger %d (%d entries)",
+                 header.ledgerSeq, mgr.root.entry_count())
+        return mgr
 
     def _update_skip_list(self, header: X.LedgerHeader) -> None:
         """Rotate the 4 skip hashes at their intervals (reference:

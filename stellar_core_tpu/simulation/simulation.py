@@ -38,12 +38,16 @@ class SimNode:
 
     def __init__(self, sim: "Simulation", secret: SecretKey, qset,
                  is_validator: bool = True,
-                 upgrades: Optional[Upgrades] = None):
+                 upgrades: Optional[Upgrades] = None,
+                 ledger_manager: Optional[LedgerManager] = None):
         self.sim = sim
         self.secret = secret
         self.node_id = secret.public_key.ed25519
-        self.lm = LedgerManager(sim.network_id)
-        self.lm.start_new_ledger()
+        if ledger_manager is not None:   # restart path: resumed from disk
+            self.lm = ledger_manager
+        else:
+            self.lm = LedgerManager(sim.network_id)
+            self.lm.start_new_ledger()
         self.herder = Herder(sim.clock, self.lm, secret, qset,
                              is_validator=is_validator, upgrades=upgrades)
         self.herder.broadcast = self._broadcast
@@ -109,8 +113,10 @@ class Simulation:
     # -- topology ----------------------------------------------------------
     def add_node(self, secret: SecretKey, qset,
                  is_validator: bool = True,
-                 upgrades: Optional[Upgrades] = None) -> SimNode:
-        node = SimNode(self, secret, qset, is_validator, upgrades)
+                 upgrades: Optional[Upgrades] = None,
+                 ledger_manager: Optional[LedgerManager] = None) -> SimNode:
+        node = SimNode(self, secret, qset, is_validator, upgrades,
+                       ledger_manager=ledger_manager)
         self.nodes.append(node)
         self.by_id[node.node_id] = node
         return node

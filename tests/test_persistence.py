@@ -1,0 +1,285 @@
+"""Durable persistence + restart tests.
+
+Reference test model: src/ledger/test/LedgerCloseMetaStreamTests /
+LedgerManagerTests (loadLastKnownLedger), src/database/test/ and
+src/history/test (publish queue persistence): a node killed at any point
+must restart from its DB + bucket files and continue producing the same
+hash chain.
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.bucket.manager import BucketDir
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.database import Database, PersistentState
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.testutils import (TestAccount, change_trust_op,
+                                        create_account_op, make_asset,
+                                        manage_sell_offer_op, network_id,
+                                        payment_op)
+
+NID = network_id("persistence test net")
+
+
+def _root_of(mgr):
+    sk = mgr.root_account_secret()
+    e = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, e.data.value.seqNum)
+
+
+def _run_some_ledgers(mgr, root, n_extra=3):
+    issuer_sk = SecretKey(b"\x21" * 32)
+    issuer_id = X.AccountID.ed25519(issuer_sk.public_key.ed25519)
+    mgr.close_ledger([root.tx([create_account_op(issuer_id, 10**12)])], 1000)
+    e = mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=issuer_id)).to_xdr())
+    issuer = TestAccount(mgr, issuer_sk, e.data.value.seqNum)
+    eur = make_asset("EUR", issuer_id)
+    native = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, None)
+    mgr.close_ledger([root.tx([change_trust_op(eur)])], 1001)
+    mgr.close_ledger([issuer.tx([payment_op(root.account_id, eur, 5000)])],
+                     1002)
+    mgr.close_ledger([root.tx([manage_sell_offer_op(eur, native, 100, 2, 1)])],
+                     1003)
+    for i in range(n_extra):
+        mgr.close_ledger([issuer.tx([payment_op(root.account_id, eur, 10)])],
+                         1004 + i)
+    return issuer
+
+
+def test_restart_resumes_exact_state_and_hash_chain(tmp_path):
+    db_path = str(tmp_path / "node.db")
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(Database(db_path), bdir)
+    root = _root_of(mgr)
+    issuer = _run_some_ledgers(mgr, root)
+    lcl_hash, lcl_seq = mgr.lcl_hash, mgr.last_closed_ledger_seq
+    n_entries = mgr.root.entry_count()
+    mgr.db.close()
+    del mgr  # "kill -9": nothing but disk survives
+
+    db = Database(db_path)
+    mgr2 = LedgerManager.load_last_known_ledger(NID, db, bdir)
+    assert mgr2.lcl_hash == lcl_hash
+    assert mgr2.last_closed_ledger_seq == lcl_seq
+    assert mgr2.root.entry_count() == n_entries
+
+    # the resumed node and an uninterrupted twin must produce identical
+    # hashes for the same subsequent traffic
+    twin = LedgerManager(NID)
+    twin.start_new_ledger()
+    twin_root = _root_of(twin)
+    _run_some_ledgers(twin, twin_root)
+    assert twin.lcl_hash == mgr2.lcl_hash
+
+    for m, r in ((mgr2, _root_of(mgr2)), (twin, _root_of(twin))):
+        dest = SecretKey(b"\x22" * 32)
+        m.close_ledger([r.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])], 2000)
+    assert mgr2.lcl_hash == twin.lcl_hash
+    assert mgr2.last_closed_ledger_seq == lcl_seq + 1
+
+
+def test_restart_mid_stream_headers_queryable(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    root = _root_of(mgr)
+    _run_some_ledgers(mgr, root, n_extra=0)
+    got = db.load_header_by_seq(3)
+    assert got is not None
+    h, header = got
+    assert header.ledgerSeq == 3
+    from stellar_core_tpu.crypto.sha import sha256
+    assert sha256(header.to_xdr()) == h
+    assert db.max_header_seq() == mgr.last_closed_ledger_seq
+
+
+def test_load_refuses_wrong_network(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    with pytest.raises(RuntimeError, match="different network"):
+        LedgerManager.load_last_known_ledger(
+            network_id("some other net"), db, bdir)
+
+
+def test_load_detects_corrupt_bucket_file(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    root = _root_of(mgr)
+    _run_some_ledgers(mgr, root, n_extra=0)
+    victims = [n for n in os.listdir(bdir.path) if n.endswith(".xdr")]
+    assert victims
+    path = os.path.join(bdir.path, victims[0])
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(RuntimeError, match="hash check|missing bucket"):
+        LedgerManager.load_last_known_ledger(NID, db, bdir)
+
+
+def test_load_detects_missing_bucket(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    root = _root_of(mgr)
+    _run_some_ledgers(mgr, root, n_extra=0)
+    for n in os.listdir(bdir.path):
+        if n.endswith(".xdr"):
+            os.unlink(os.path.join(bdir.path, n))
+            break
+    with pytest.raises(RuntimeError, match="missing bucket"):
+        LedgerManager.load_last_known_ledger(NID, db, bdir)
+
+
+def test_bucket_dir_gc_keeps_referenced(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    root = _root_of(mgr)
+    _run_some_ledgers(mgr, root)
+    referenced = {lvl.curr.hash().hex() for lvl in mgr.bucket_list.levels} \
+        | {lvl.snap.hash().hex() for lvl in mgr.bucket_list.levels}
+    removed = bdir.gc(referenced)
+    assert removed > 0  # superseded level-0 currs from earlier closes
+    # everything needed for restart still present
+    mgr.db.close()
+    mgr2 = LedgerManager.load_last_known_ledger(NID, Database(db.path), bdir)
+    assert mgr2.lcl_hash == mgr.lcl_hash
+
+
+def test_scp_history_and_publish_queue_roundtrip(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    qset = X.SCPQuorumSet(threshold=1, validators=[], innerSets=[])
+    env = X.SCPEnvelope(
+        statement=X.SCPStatement(
+            nodeID=X.AccountID.ed25519(b"\x01" * 32), slotIndex=7,
+            pledges=X.SCPStatementPledges.nominate(X.SCPNomination(
+                quorumSetHash=b"\x02" * 32, votes=[], accepted=[]))),
+        signature=b"\x03" * 64)
+    db.save_scp_history(7, [env], [qset])
+    db.queue_publish(63, '{"fake": "has"}')
+    db.commit()
+    db.close()
+
+    db2 = Database(db.path)
+    envs = db2.load_scp_history(7)
+    assert len(envs) == 1 and envs[0].to_xdr() == env.to_xdr()
+    assert [q.to_xdr() for q in db2.load_scp_quorums()] == [qset.to_xdr()]
+    assert db2.publish_queue() == [(63, '{"fake": "has"}')]
+    db2.dequeue_publish(63)
+    assert db2.publish_queue() == []
+
+
+def test_persistent_state_kv(tmp_path):
+    db = Database(str(tmp_path / "node.db"))
+    assert db.get_state("nope") is None
+    db.set_state(PersistentState.NETWORK_PASSPHRASE, "abc")
+    db.set_state(PersistentState.NETWORK_PASSPHRASE, "def")
+    db.commit()
+    assert db.get_state(PersistentState.NETWORK_PASSPHRASE) == "def"
+
+
+def test_node_restart_rejoins_and_continues_consensus(tmp_path):
+    """kill -9 a running single-validator node; restart from DB + bucket
+    files; it resumes from its LCL, restores SCP state, and keeps closing
+    ledgers on the same hash chain (reference: loadLastKnownLedger +
+    HerderImpl::restoreSCPState on startup)."""
+    from stellar_core_tpu.simulation import Simulation, qset_of
+
+    sk = SecretKey(b"\x31" * 32)
+    q = qset_of([sk.public_key.ed25519], 1)
+    db_path = str(tmp_path / "node.db")
+    bdir = BucketDir(str(tmp_path / "buckets"))
+
+    sim = Simulation(b"restart net")
+    node = sim.add_node(sk, q)
+    node.lm.enable_persistence(Database(db_path), bdir)
+    node.herder.attach_persistence(node.lm.db)
+    sim.start_all_nodes()
+    assert sim.crank_until_ledger(4, timeout=120)
+    lcl_seq, lcl_hash = node.lcl, node.lcl_hash
+    node.lm.db.close()
+    del node, sim  # kill -9
+
+    sim2 = Simulation(b"restart net")
+    db = Database(db_path)
+    lm = LedgerManager.load_last_known_ledger(sim2.network_id, db, bdir)
+    assert lm.last_closed_ledger_seq >= lcl_seq
+    node2 = sim2.add_node(sk, q, ledger_manager=lm)
+    node2.herder.attach_persistence(db)
+    node2.herder.restore_scp_state()
+    # restored SCP state serves the last slot's envelopes to peers
+    assert node2.herder.get_scp_state(0)
+    sim2.start_all_nodes()
+    resumed_from = node2.lcl
+    assert sim2.crank_until_ledger(resumed_from + 3, timeout=120)
+    # the chain continued from the persisted LCL, no fork
+    got = db.load_header_by_seq(resumed_from + 1)
+    assert got is not None
+    assert got[1].previousLedgerHash == lcl_hash or resumed_from > lcl_seq
+
+
+def test_crash_mid_checkpoint_republishes_after_restart(tmp_path):
+    """Close past ledgers into a checkpoint window, crash before the
+    boundary, restart, keep closing: the published checkpoint must contain
+    ALL ledgers (including pre-crash ones) and a fresh node must be able to
+    catch up from the archive to the exact LCL hash."""
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
+                                                  FileHistoryArchive)
+    from stellar_core_tpu.history.manager import HistoryManager
+
+    db_path = str(tmp_path / "node.db")
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    archive = FileHistoryArchive(str(tmp_path / "archive"))
+
+    mgr = LedgerManager(NID, invariant_manager=None)
+    mgr.start_new_ledger()
+    db = Database(db_path)
+    mgr.enable_persistence(db, bdir)
+    hm = HistoryManager(mgr, NID.hex(), [archive], database=db)
+    root = _root_of(mgr)
+    dest = SecretKey(b"\x23" * 32)
+    dest_id = X.AccountID.ed25519(dest.public_key.ed25519)
+    hm.ledger_closed(mgr.close_ledger(
+        [root.tx([create_account_op(dest_id, 10**12)])], 1000))
+    native = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, None)
+    while mgr.last_closed_ledger_seq < CHECKPOINT_FREQUENCY - 5:
+        hm.ledger_closed(mgr.close_ledger(
+            [root.tx([payment_op(dest_id, native, 1000)])], 1001))
+    db.close()
+    del mgr, hm  # crash before the checkpoint boundary
+
+    db = Database(db_path)
+    mgr2 = LedgerManager.load_last_known_ledger(
+        NID, db, bdir, invariant_manager=None)
+    hm2 = HistoryManager(mgr2, NID.hex(), [archive], database=db)
+    root2 = _root_of(mgr2)
+    while not archive.get_state():
+        hm2.ledger_closed(mgr2.close_ledger(
+            [root2.tx([payment_op(dest_id, native, 1000)])], 1002))
+    assert archive.get_state().current_ledger == CHECKPOINT_FREQUENCY - 1
+
+    cm = CatchupManager(NID, NID.hex())
+    fresh = cm.catchup_complete(archive)
+    assert fresh.lcl_hash == (
+        db.load_header_by_seq(CHECKPOINT_FREQUENCY - 1)[0])
