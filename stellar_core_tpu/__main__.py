@@ -1,0 +1,5 @@
+import sys
+
+from .main.commandline import main
+
+sys.exit(main())

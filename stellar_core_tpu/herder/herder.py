@@ -85,6 +85,8 @@ class Herder(SCPDriver):
         self.ledger_closed_hook: Callable[[object], None] = lambda arts: None
 
         self.db = None  # database.Database; attach_persistence()
+        # reference: Config::ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING
+        self.ledger_timespan = EXP_LEDGER_TIMESPAN_SECONDS
         self._timers: Dict[Tuple[int, int], VirtualTimer] = {}
         self._trigger_timer: Optional[VirtualTimer] = None
         self._last_trigger_at: float = clock.now()
@@ -445,7 +447,7 @@ class Herder(SCPDriver):
             return
         if self._trigger_timer is not None:
             self._trigger_timer.cancel()
-        due = self._last_trigger_at + EXP_LEDGER_TIMESPAN_SECONDS
+        due = self._last_trigger_at + self.ledger_timespan
         delay = max(0.0, due - self.clock.now())
         self._trigger_timer = VirtualTimer(self.clock)
         self._trigger_timer.expires_from_now(

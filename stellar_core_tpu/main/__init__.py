@@ -1,0 +1,5 @@
+"""Application layer (reference: src/main/)."""
+
+from .config import Config, HistoryArchiveConfig
+
+__all__ = ["Config", "HistoryArchiveConfig"]

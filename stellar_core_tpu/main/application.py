@@ -1,0 +1,227 @@
+"""Application: the composition root that turns a Config into a live node.
+
+Reference: src/main/ApplicationImpl.{h,cpp} — owns the VirtualClock and
+every subsystem (Database, BucketManager, LedgerManager, Herder,
+OverlayManager, HistoryManager, CatchupManager, CommandHandler), starts
+them in dependency order, and runs the crank loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .. import xdr as X
+from ..bucket.manager import BucketDir
+from ..catchup.catchup import CatchupManager
+from ..database import Database, PersistentState
+from ..herder.herder import Herder
+from ..history.archive import FileHistoryArchive
+from ..history.manager import HistoryManager
+from ..invariant import InvariantManager
+from ..ledger.manager import LedgerManager
+from ..overlay.overlay_manager import OverlayManager
+from ..overlay.tcp import TCPTransport
+from ..util import logging as slog
+from ..util.clock import ClockMode, VirtualClock
+from .config import Config
+
+log = slog.get("Main")
+
+VERSION = "stellar-core-tpu 2.0.0"
+
+
+class Application:
+    def __init__(self, config: Config,
+                 clock: Optional[VirtualClock] = None,
+                 listen: bool = True):
+        self.config = config
+        self.clock = clock or VirtualClock(ClockMode.REAL_TIME)
+        self.network_id = config.network_id()
+        self.node_secret = config.node_secret()
+        slog.set_level(config.LOG_LEVEL)
+
+        # database + buckets ------------------------------------------------
+        self.database: Optional[Database] = None
+        self.bucket_dir: Optional[BucketDir] = None
+        if config.DATABASE:
+            os.makedirs(os.path.dirname(config.DATABASE) or ".",
+                        exist_ok=True)
+            self.database = Database(config.DATABASE)
+            bdir = config.BUCKET_DIR_PATH or os.path.join(
+                os.path.dirname(config.DATABASE) or ".", "buckets")
+            self.bucket_dir = BucketDir(bdir)
+
+        invariants = (InvariantManager.from_patterns(config.INVARIANT_CHECKS)
+                      if config.INVARIANT_CHECKS else None)
+
+        # ledger ------------------------------------------------------------
+        if self.database is not None and self.database.get_state(
+                PersistentState.LAST_CLOSED_LEDGER) is not None:
+            self.lm = LedgerManager.load_last_known_ledger(
+                self.network_id, self.database, self.bucket_dir,
+                invariant_manager=invariants)
+        else:
+            self.lm = LedgerManager(self.network_id,
+                                    invariant_manager=invariants)
+            self.lm.start_new_ledger()
+            if self.database is not None:
+                self.lm.enable_persistence(self.database, self.bucket_dir)
+
+        # herder + overlay --------------------------------------------------
+        self.herder = Herder(self.clock, self.lm, self.node_secret,
+                             config.quorum_set(),
+                             is_validator=config.NODE_IS_VALIDATOR)
+        if self.database is not None:
+            self.herder.attach_persistence(self.database)
+        if config.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING:
+            self.herder.ledger_timespan = 1.0
+        self.overlay = OverlayManager(self.clock, self.herder,
+                                      self.network_id, self.node_secret,
+                                      listening_port=config.PEER_PORT)
+        self.transport: Optional[TCPTransport] = None
+        if listen:
+            self.transport = TCPTransport(
+                self.overlay, listen_port=config.PEER_PORT)
+
+        # history + catchup -------------------------------------------------
+        archives: List[FileHistoryArchive] = []
+        for spec in config.HISTORY:
+            archives.append(FileHistoryArchive(
+                spec.put_path or spec.get_path))
+        self.history = HistoryManager(self.lm, config.NETWORK_PASSPHRASE,
+                                      archives, database=self.database)
+        self.herder.ledger_closed_hook = self._on_ledger_closed
+        self.catchup = CatchupManager(
+            self.network_id, config.NETWORK_PASSPHRASE,
+            accel=config.ACCEL == "tpu",
+            accel_chunk=config.ACCEL_CHUNK_SIZE)
+
+        # http admin --------------------------------------------------------
+        self.http = None
+        if config.HTTP_PORT:
+            from .http_admin import CommandHandler
+            self.http = CommandHandler(self, config.HTTP_PORT)
+
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def _on_ledger_closed(self, arts) -> None:
+        self.history.ledger_closed(arts)
+        self.overlay.clear_below(
+            max(0, self.lm.last_closed_ledger_seq - 100))
+
+    def start(self) -> None:
+        """Reference: ApplicationImpl::start — restore state, join
+        consensus, dial peers."""
+        self.herder.restore_scp_state()
+        if self.http is not None:
+            self.http.start()
+        if self.config.RUN_STANDALONE or self.config.FORCE_SCP:
+            self.herder.bootstrap()
+        else:
+            self.herder.start()
+        self._dial_known_peers()
+        self._start_reconnect_timer()
+        log.info("%s up: node=%s lcl=%d port=%d", VERSION,
+                 self.node_secret.public_key.to_strkey()[:12],
+                 self.lm.last_closed_ledger_seq,
+                 self.overlay.listening_port)
+
+    RECONNECT_INTERVAL = 2.0
+
+    def _dial_known_peers(self) -> None:
+        if self.transport is None:
+            return
+        for addr in self.config.KNOWN_PEERS:
+            host, _, port = addr.partition(":")
+            self.transport.connect(host, int(port or 11625))
+
+    def _start_reconnect_timer(self) -> None:
+        """Redial KNOWN_PEERS while under-connected (reference:
+        OverlayManagerImpl::triggerPeerResolution on a timer).  Duplicate
+        connections are resolved deterministically by the overlay's
+        keep-smaller-dialer rule, so over-dialing is harmless."""
+        from ..util.clock import VirtualTimer
+        self._reconnect_timer = VirtualTimer(self.clock)
+
+        def tick() -> None:
+            if self.overlay.num_authenticated() < len(
+                    self.config.KNOWN_PEERS):
+                self._dial_known_peers()
+            self._reconnect_timer.expires_from_now(
+                self.RECONNECT_INTERVAL, tick)
+
+        self._reconnect_timer.expires_from_now(self.RECONNECT_INTERVAL, tick)
+
+    def run(self) -> None:
+        """The main crank loop (reference: ApplicationImpl::run /
+        VirtualClock::crank in a loop until shutdown)."""
+        import time
+        while not self._stopped:
+            if self.clock.crank() == 0:
+                time.sleep(0.005)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.http is not None:
+            self.http.stop()
+        if self.transport is not None:
+            self.transport.close()
+        if self.database is not None:
+            self.database.close()
+
+    # -- introspection (CommandHandler backend) ------------------------------
+    def info(self) -> dict:
+        return {
+            "build": VERSION,
+            "network": self.config.NETWORK_PASSPHRASE,
+            "node": self.node_secret.public_key.to_strkey(),
+            "state": self.herder.get_state_human(),
+            "ledger": {
+                "num": self.lm.last_closed_ledger_seq,
+                "hash": self.lm.lcl_hash.hex(),
+                "version": self.lm.lcl_header.ledgerVersion,
+                "baseFee": self.lm.lcl_header.baseFee,
+                "baseReserve": self.lm.lcl_header.baseReserve,
+            },
+            "peers": {
+                "authenticated_count": self.overlay.num_authenticated(),
+                "pending_count": len(self.overlay.pending_peers),
+            },
+            "protocol_version": self.lm.lcl_header.ledgerVersion,
+            "accel": self.config.ACCEL,
+        }
+
+    def metrics(self) -> dict:
+        return {
+            "overlay": dict(self.overlay.stats),
+            "herder": {
+                "state": self.herder.get_state_human(),
+                "tx_queue_size": self.herder.tx_queue.size,
+            },
+            "ledger": {
+                "num": self.lm.last_closed_ledger_seq,
+                "entries": self.lm.root.entry_count(),
+            },
+        }
+
+    def submit_tx(self, envelope_xdr: bytes) -> dict:
+        """POST /tx backend (reference: CommandHandler::tx)."""
+        try:
+            env = X.TransactionEnvelope.from_xdr(envelope_xdr)
+            frame = self.lm.make_frame(env)
+        except Exception as e:
+            return {"status": "ERROR", "detail": f"malformed: {e}"}
+        res = self.herder.recv_transaction(frame)
+        out = {"status": res.code.upper()}
+        if res.result is not None:
+            out["result_xdr"] = res.result.to_xdr().hex()
+        return out
+
+    def quorum_info(self) -> dict:
+        qmap = self.herder.quorum_map()
+        return {
+            "node_count": len(qmap),
+            "nodes": {k.hex()[:16]: (v is not None) for k, v in qmap.items()},
+        }

@@ -1,0 +1,193 @@
+"""Command line: run / new-db / catchup / publish /
+check-quorum-intersection / sec-to-pub / version.
+
+Reference: src/main/CommandLine.{h,cpp} — the stellar-core subcommand
+surface, minus the ones whose subsystems don't exist here yet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .config import Config
+
+
+def _load_config(args) -> Config:
+    return Config.from_toml(args.conf)
+
+
+def cmd_version(args) -> int:
+    from .application import VERSION
+    print(VERSION)
+    return 0
+
+
+def cmd_sec_to_pub(args) -> int:
+    from ..crypto.keys import SecretKey
+    seed = sys.stdin.readline().strip() if args.seed == "-" else args.seed
+    print(SecretKey.from_strkey_seed(seed).public_key.to_strkey())
+    return 0
+
+
+def cmd_gen_seed(args) -> int:
+    from ..crypto.keys import SecretKey
+    sk = SecretKey.random()
+    print(json.dumps({"secret": sk.to_strkey_seed(),
+                      "public": sk.public_key.to_strkey()}))
+    return 0
+
+
+def cmd_new_db(args) -> int:
+    """Initialize a fresh database at the config's DATABASE path
+    (reference: `stellar-core new-db`)."""
+    cfg = _load_config(args)
+    if not cfg.DATABASE:
+        print("config has no DATABASE path", file=sys.stderr)
+        return 1
+    import os
+    for path in (cfg.DATABASE, cfg.DATABASE + "-wal", cfg.DATABASE + "-shm"):
+        if os.path.exists(path):
+            os.unlink(path)
+    from .application import Application
+    app = Application(cfg, listen=False)
+    print(f"new database at {cfg.DATABASE}, genesis ledger "
+          f"{app.lm.last_closed_ledger_seq} hash {app.lm.lcl_hash.hex()}")
+    app.stop()
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run the node (reference: `stellar-core run`)."""
+    cfg = _load_config(args)
+    from .application import Application
+    app = Application(cfg)
+    import signal
+
+    def shutdown(signum, frame):
+        app.stop()
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    app.start()
+    app.run()
+    return 0
+
+
+def cmd_catchup(args) -> int:
+    """Catch up from a history archive (reference: `stellar-core catchup`)."""
+    cfg = _load_config(args)
+    from ..history.archive import FileHistoryArchive
+    from .application import Application
+
+    archive_path = args.archive
+    if not archive_path:
+        if not cfg.HISTORY:
+            print("no archive configured or given", file=sys.stderr)
+            return 1
+        archive_path = cfg.HISTORY[0].get_path or cfg.HISTORY[0].put_path
+    archive = FileHistoryArchive(archive_path)
+    from ..catchup.catchup import CatchupManager
+    cm = CatchupManager(cfg.network_id(), cfg.NETWORK_PASSPHRASE,
+                        accel=cfg.ACCEL == "tpu",
+                        accel_chunk=cfg.ACCEL_CHUNK_SIZE)
+    if args.mode == "minimal":
+        lm = cm.catchup_minimal(archive)
+    else:
+        lm = cm.catchup_complete(archive, to_ledger=args.to)
+    print(f"caught up to ledger {lm.last_closed_ledger_seq} "
+          f"hash {lm.lcl_hash.hex()}")
+    if cfg.DATABASE:
+        from ..bucket.manager import BucketDir
+        from ..database import Database
+        import os
+        os.makedirs(os.path.dirname(cfg.DATABASE) or ".", exist_ok=True)
+        db = Database(cfg.DATABASE)
+        bdir = BucketDir(cfg.BUCKET_DIR_PATH or os.path.join(
+            os.path.dirname(cfg.DATABASE) or ".", "buckets"))
+        lm.enable_persistence(db, bdir)
+        db.close()
+        print(f"state persisted to {cfg.DATABASE}")
+    return 0
+
+
+def cmd_publish(args) -> int:
+    """Force-publish the current checkpoint window to the configured
+    archives (reference: `stellar-core publish`)."""
+    cfg = _load_config(args)
+    from .application import Application
+    app = Application(cfg, listen=False)
+    n = app.history.publish_queued_history()
+    print(f"published {n} queued checkpoint(s)")
+    app.stop()
+    return 0
+
+
+def cmd_check_quorum_intersection(args) -> int:
+    """Check quorum intersection of a quorum-map JSON file (reference:
+    `stellar-core check-quorum-intersection`)."""
+    from ..herder.quorum_intersection import check_intersection
+    from ..crypto.keys import PublicKey
+    from .. import xdr as X
+
+    with open(args.json_path) as f:
+        raw = json.load(f)
+    qmap = {}
+    for node, spec in raw.items():
+        nid = PublicKey.from_strkey(node).ed25519
+        qmap[nid] = X.SCPQuorumSet(
+            threshold=spec["threshold"],
+            validators=[X.NodeID.ed25519(PublicKey.from_strkey(v).ed25519)
+                        for v in spec["validators"]],
+            innerSets=[])
+    res = check_intersection(qmap)
+    print("Network enjoys quorum intersection"
+          if res.intersects
+          else "Network DOES NOT enjoy quorum intersection")
+    return 0 if res.intersects else 2
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="stellar-core-tpu",
+        description="TPU-native stellar-core node")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("run", help="run the node")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_run)
+
+    s = sub.add_parser("new-db", help="initialize a fresh database")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_new_db)
+
+    s = sub.add_parser("catchup", help="catch up from a history archive")
+    s.add_argument("--conf", required=True)
+    s.add_argument("--archive", default="")
+    s.add_argument("--to", type=int, default=None)
+    s.add_argument("--mode", choices=["complete", "minimal"],
+                   default="complete")
+    s.set_defaults(fn=cmd_catchup)
+
+    s = sub.add_parser("publish", help="publish queued checkpoints")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_publish)
+
+    s = sub.add_parser("check-quorum-intersection",
+                       help="check a quorum map JSON for intersection")
+    s.add_argument("json_path")
+    s.set_defaults(fn=cmd_check_quorum_intersection)
+
+    s = sub.add_parser("sec-to-pub", help="seed strkey -> public strkey")
+    s.add_argument("seed", help="S... seed, or - to read from stdin")
+    s.set_defaults(fn=cmd_sec_to_pub)
+
+    s = sub.add_parser("gen-seed", help="generate a random node seed")
+    s.set_defaults(fn=cmd_gen_seed)
+
+    s = sub.add_parser("version", help="print version")
+    s.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

@@ -1,0 +1,108 @@
+"""Node configuration: TOML file -> Config object.
+
+Reference: src/main/Config.{h,cpp} — the stellar-core.cfg surface.  The
+key names mirror the reference's where the concept exists here
+(NETWORK_PASSPHRASE, NODE_SEED, NODE_IS_VALIDATOR, QUORUM_SET, KNOWN_PEERS,
+PEER_PORT, HTTP_PORT, RUN_STANDALONE, DATABASE, BUCKET_DIR_PATH,
+INVARIANT_CHECKS, HISTORY).  TPU-specific additions: ACCEL ("tpu"/"none")
+and ACCEL_CHUNK_SIZE, the `--accel` surface BASELINE.json benchmarks flip.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from .. import xdr as X
+
+
+@dataclass
+class HistoryArchiveConfig:
+    name: str
+    get_path: str = ""        # local directory (subprocess templates later)
+    put_path: str = ""
+
+
+@dataclass
+class Config:
+    NETWORK_PASSPHRASE: str = "Standalone TPU Network ; 2026"
+    NODE_SEED: Optional[str] = None          # strkey S...
+    NODE_IS_VALIDATOR: bool = True
+    RUN_STANDALONE: bool = False
+    FORCE_SCP: bool = False
+
+    QUORUM_SET_VALIDATORS: List[str] = field(default_factory=list)  # G...
+    QUORUM_SET_THRESHOLD: int = 0            # 0 = simple majority
+
+    PEER_PORT: int = 11625
+    HTTP_PORT: int = 0                       # 0 = no admin endpoint
+    KNOWN_PEERS: List[str] = field(default_factory=list)  # "host:port"
+    TARGET_PEER_CONNECTIONS: int = 8
+
+    DATABASE: str = ""                       # sqlite path; "" = in-memory
+    BUCKET_DIR_PATH: str = ""
+    INVARIANT_CHECKS: List[str] = field(default_factory=list)
+    HISTORY: List[HistoryArchiveConfig] = field(default_factory=list)
+
+    ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
+
+    ACCEL: str = "none"                      # "tpu" routes batch crypto
+    ACCEL_CHUNK_SIZE: int = 8192
+    LOG_LEVEL: str = "INFO"
+
+    # -- derived -------------------------------------------------------------
+    def network_id(self) -> bytes:
+        return sha256(self.NETWORK_PASSPHRASE.encode())
+
+    def node_secret(self) -> SecretKey:
+        if self.NODE_SEED:
+            return SecretKey.from_strkey_seed(self.NODE_SEED)
+        # deterministic-from-passphrase dev key, like the reference's
+        # standalone default
+        return SecretKey(sha256(b"node seed " + self.network_id()))
+
+    def quorum_set(self) -> X.SCPQuorumSet:
+        from ..crypto.keys import PublicKey
+        validators = [PublicKey.from_strkey(v).ed25519
+                      for v in self.QUORUM_SET_VALIDATORS]
+        if not validators:
+            validators = [self.node_secret().public_key.ed25519]
+        threshold = self.QUORUM_SET_THRESHOLD or (len(validators) // 2 + 1)
+        return X.SCPQuorumSet(
+            threshold=threshold,
+            validators=[X.NodeID.ed25519(v) for v in validators],
+            innerSets=[])
+
+    @staticmethod
+    def from_toml(path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return Config.from_dict(raw)
+
+    @staticmethod
+    def from_dict(raw: dict) -> "Config":
+        cfg = Config()
+        simple = {
+            "NETWORK_PASSPHRASE", "NODE_SEED", "NODE_IS_VALIDATOR",
+            "RUN_STANDALONE", "FORCE_SCP", "PEER_PORT", "HTTP_PORT",
+            "KNOWN_PEERS", "TARGET_PEER_CONNECTIONS", "DATABASE",
+            "BUCKET_DIR_PATH", "INVARIANT_CHECKS", "ACCEL",
+            "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
+            "ACCEL_CHUNK_SIZE", "LOG_LEVEL",
+        }
+        for key, val in raw.items():
+            if key in simple:
+                setattr(cfg, key, val)
+            elif key == "QUORUM_SET":
+                cfg.QUORUM_SET_VALIDATORS = list(val.get("VALIDATORS", []))
+                cfg.QUORUM_SET_THRESHOLD = int(val.get("THRESHOLD", 0))
+            elif key == "HISTORY":
+                for name, spec in val.items():
+                    cfg.HISTORY.append(HistoryArchiveConfig(
+                        name=name, get_path=spec.get("get", ""),
+                        put_path=spec.get("put", "")))
+            # unknown keys are tolerated (reference warns; we ignore)
+        return cfg

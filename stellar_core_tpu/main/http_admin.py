@@ -1,0 +1,126 @@
+"""HTTP admin endpoint: /info /metrics /quorum /peers /tx /scp.
+
+Reference: src/main/CommandHandler.{h,cpp} over lib/httpthreaded — the
+admin server runs on its own threads and marshals work onto the main
+thread.  Here a ThreadingHTTPServer serves reads directly (GIL-atomic
+snapshots of plain dicts) and marshals /tx submission onto the clock's
+action queue, waiting for the main crank loop to process it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..util import logging as slog
+
+log = slog.get("CommandHandler")
+
+
+class CommandHandler:
+    def __init__(self, app, port: int, host: str = "127.0.0.1"):
+        self.app = app
+        self.host = host
+        self.port = port
+        self.server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        handler = self._make_handler()
+        self.server = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="http-admin", daemon=True)
+        self._thread.start()
+        log.info("admin endpoint on http://%s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+            self.server = None
+
+    # ------------------------------------------------------------------
+    def _submit_tx_on_main(self, blob: bytes) -> dict:
+        """Marshal tx submission onto the clock loop and wait (reference:
+        CommandHandler routes through the app's main thread)."""
+        done = threading.Event()
+        result: dict = {}
+
+        def work() -> None:
+            result.update(self.app.submit_tx(blob))
+            done.set()
+
+        self.app.clock.post_action(work, name="http-tx")
+        if not done.wait(timeout=10.0):
+            return {"status": "ERROR", "detail": "timed out"}
+        return result
+
+    def _make_handler(self):
+        handler_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            @staticmethod
+            def _snap(fn):
+                """Read main-thread state with retry: dict iteration can
+                race a concurrent mutation (RuntimeError) — retry instead
+                of surfacing a 500."""
+                for _ in range(5):
+                    try:
+                        return fn()
+                    except RuntimeError:
+                        continue
+                return fn()
+
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj, indent=1).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                app = handler_self.app
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/info":
+                        self._reply({"info": self._snap(app.info)})
+                    elif url.path == "/metrics":
+                        self._reply({"metrics": self._snap(app.metrics)})
+                    elif url.path == "/quorum":
+                        self._reply(self._snap(app.quorum_info))
+                    elif url.path == "/peers":
+                        self._reply({"authenticated": self._snap(
+                            lambda: [p.hex() for p in
+                                     app.overlay.authenticated_peers])})
+                    elif url.path == "/scp":
+                        self._reply({
+                            "state": app.herder.get_state_human(),
+                            "tracking": app.herder
+                            .tracking_consensus_ledger_index()})
+                    elif url.path == "/tx":
+                        qs = parse_qs(url.query)
+                        blob = qs.get("blob", [""])[0]
+                        try:
+                            raw = bytes.fromhex(blob)
+                        except ValueError:
+                            self._reply({"status": "ERROR",
+                                         "detail": "blob must be hex"}, 400)
+                            return
+                        self._reply(handler_self._submit_tx_on_main(raw))
+                    else:
+                        self._reply({"error": "unknown endpoint",
+                                     "endpoints": ["/info", "/metrics",
+                                                   "/quorum", "/peers",
+                                                   "/scp", "/tx"]}, 404)
+                except Exception as e:  # admin surface must never crash
+                    self._reply({"error": str(e)}, 500)
+
+        return Handler

@@ -1,0 +1,141 @@
+"""Floodgate + pull-mode tx adverts.
+
+Reference: src/overlay/Floodgate.{h,cpp} — per-message flood records keyed
+by hash, remembering which peers already have it; clearBelow GC by ledger
+seq.  src/overlay/TxAdverts.{h,cpp} — pull-mode tx flooding: hashes are
+advertised (FLOOD_ADVERT), interested peers demand (FLOOD_DEMAND), only
+then the full TRANSACTION flows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from .. import xdr as X
+
+ADVERT_FLUSH_BATCH = 50
+
+
+class FloodRecord:
+    __slots__ = ("ledger_seq", "peers_told")
+
+    def __init__(self, ledger_seq: int):
+        self.ledger_seq = ledger_seq
+        # actual peer objects (NOT id()s: a freed peer's id can be reused
+        # by a new allocation, silently aliasing flood state); records are
+        # GC'd by clear_below so the references are bounded
+        self.peers_told: Set[object] = set()
+
+
+class Floodgate:
+    def __init__(self) -> None:
+        self._records: Dict[bytes, FloodRecord] = {}
+
+    def add_record(self, msg_hash: bytes, ledger_seq: int,
+                   from_peer=None) -> bool:
+        """Record a message sighting; True when it is NEW (process it and
+        re-flood), False when already seen (reference:
+        Floodgate::addRecord).  The source peer is remembered either way so
+        broadcast never echoes a message back."""
+        rec = self._records.get(msg_hash)
+        if rec is None:
+            rec = self._records[msg_hash] = FloodRecord(ledger_seq)
+            if from_peer is not None:
+                rec.peers_told.add(from_peer)
+            return True
+        if from_peer is not None:
+            rec.peers_told.add(from_peer)
+        return False
+
+    def seen(self, msg_hash: bytes) -> bool:
+        return msg_hash in self._records
+
+    def note_told(self, msg_hash: bytes, peer) -> None:
+        rec = self._records.get(msg_hash)
+        if rec is not None:
+            rec.peers_told.add(peer)
+
+    def peers_told(self, msg_hash: bytes) -> Set[object]:
+        rec = self._records.get(msg_hash)
+        return rec.peers_told if rec is not None else set()
+
+    def clear_below(self, ledger_seq: int) -> None:
+        for h in [h for h, r in self._records.items()
+                  if r.ledger_seq < ledger_seq]:
+            del self._records[h]
+
+
+class TxAdverts:
+    """Per-peer advert/demand queues (pull-mode flooding)."""
+
+    def __init__(self, send_advert: Callable, send_demand: Callable):
+        self._send_advert = send_advert    # (peer, [hashes])
+        self._send_demand = send_demand
+        self._outgoing: Dict[int, List[bytes]] = {}   # id(peer) -> hashes
+        self._peers: Dict[int, object] = {}
+
+    def queue_advert(self, peer, tx_hash: bytes) -> None:
+        pid = id(peer)
+        self._peers[pid] = peer
+        q = self._outgoing.setdefault(pid, [])
+        q.append(tx_hash)
+        if len(q) >= ADVERT_FLUSH_BATCH:
+            self.flush_peer(peer)
+
+    def flush_peer(self, peer) -> None:
+        q = self._outgoing.pop(id(peer), None)
+        self._peers.pop(id(peer), None)
+        if q:
+            self._send_advert(peer, q[:X.TX_ADVERT_VECTOR_MAX_SIZE])
+
+    def flush_all(self) -> None:
+        for pid in list(self._outgoing):
+            peer = self._peers.get(pid)
+            if peer is not None:
+                self.flush_peer(peer)
+
+    def forget_peer(self, peer) -> None:
+        self._outgoing.pop(id(peer), None)
+        self._peers.pop(id(peer), None)
+
+
+class ItemFetcher:
+    """Hash-addressed fetch of tx sets / quorum sets from peers.
+
+    Reference: src/overlay/ItemFetcher.{h,cpp} + Tracker — one tracker per
+    wanted hash, asking one peer at a time, advancing on DONT_HAVE or peer
+    drop, re-asking as new peers authenticate."""
+
+    def __init__(self, ask: Callable):
+        self._ask = ask               # (peer, item_type, hash)
+        self._tracking: Dict[bytes, dict] = {}
+
+    def fetch(self, item_type: str, h: bytes, peers: List) -> None:
+        if h in self._tracking:
+            return
+        self._tracking[h] = {"type": item_type, "asked": set()}
+        self._try_next(h, peers)
+
+    def _try_next(self, h: bytes, peers: List) -> None:
+        tr = self._tracking.get(h)
+        if tr is None:
+            return
+        for peer in peers:
+            if peer not in tr["asked"]:
+                tr["asked"].add(peer)
+                self._ask(peer, tr["type"], h)
+                return
+        # nobody left to ask; tracker stays until stop_fetch or new peers
+
+    def dont_have(self, h: bytes, from_peer, peers: List) -> None:
+        self._try_next(h, peers)
+
+    def peer_available(self, peer, peers: List) -> None:
+        for h in list(self._tracking):
+            self._try_next(h, peers)
+
+    def stop_fetch(self, h: bytes) -> None:
+        self._tracking.pop(h, None)
+
+    def wanted(self) -> List[bytes]:
+        return list(self._tracking)

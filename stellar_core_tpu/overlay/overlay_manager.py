@@ -1,0 +1,256 @@
+"""OverlayManager: peer lifecycle + flooding + herder integration.
+
+Reference: src/overlay/OverlayManagerImpl.{h,cpp} — peer registry with
+pending/authenticated split, broadcastMessage through the Floodgate,
+recvFloodedMsg dedup, tx advert queues, item fetch wiring into the herder
+(PendingEnvelopes), GET_SCP_STATE serving, connectTo/acceptAuthenticated.
+
+Transport-agnostic: peers are Peer subclasses (LoopbackPeer for
+deterministic tests, TCPPeer for real sockets — tcp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .. import xdr as X
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..util import logging as slog
+from .flood import Floodgate, ItemFetcher, TxAdverts
+from .peer import Peer
+from .peer_auth import PeerAuth
+
+log = slog.get("Overlay")
+
+
+class OverlayManager:
+    def __init__(self, clock, herder, network_id: bytes,
+                 node_secret: SecretKey, listening_port: int = 0,
+                 auth_seed: Optional[bytes] = None):
+        self.clock = clock
+        self.herder = herder
+        self.network_id = network_id
+        self.node_id = node_secret.public_key.ed25519
+        self.listening_port = listening_port
+        self.peer_auth = PeerAuth(node_secret, network_id,
+                                  now_fn=clock.system_now,
+                                  auth_seed=auth_seed)
+        self.pending_peers: List[Peer] = []
+        self.authenticated_peers: Dict[bytes, Peer] = {}  # peer_id -> Peer
+        self.floodgate = Floodgate()
+        self.adverts = TxAdverts(self._send_advert, self._send_demand)
+        self.fetcher = ItemFetcher(self._ask_for_item)
+        self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0}
+
+        # herder wiring (same seams the in-process simulation uses)
+        herder.broadcast = self.broadcast_scp_envelope
+        herder.tx_flood = self.flood_transaction
+        herder.pending.fetch_qset = lambda h: self.fetcher.fetch(
+            "qset", h, self._auth_peer_list())
+        herder.pending.fetch_txset = lambda h: self.fetcher.fetch(
+            "txset", h, self._auth_peer_list())
+        self._advert_timer = None
+        self._start_advert_timer()
+
+    ADVERT_FLUSH_INTERVAL = 0.5
+
+    def _start_advert_timer(self) -> None:
+        """Partially-filled advert batches flush on a cadence (reference:
+        TxAdverts::startAdvertTimer ~100ms)."""
+        from ..util.clock import VirtualTimer
+        self._advert_timer = VirtualTimer(self.clock)
+
+        def tick() -> None:
+            self.adverts.flush_all()
+            self._advert_timer.expires_from_now(
+                self.ADVERT_FLUSH_INTERVAL, tick)
+
+        self._advert_timer.expires_from_now(self.ADVERT_FLUSH_INTERVAL, tick)
+
+    # -- peer registry ------------------------------------------------------
+    def _register_peer(self, peer: Peer) -> None:
+        self.pending_peers.append(peer)
+
+    def _peer_authenticated(self, peer: Peer) -> None:
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+        old = self.authenticated_peers.get(peer.peer_id)
+        if old is not None and old is not peer:
+            # simultaneous cross-connections: both sides must pick the SAME
+            # survivor or each drops the other's keeper and the pair
+            # disconnects entirely.  Symmetric rule: keep the connection
+            # dialed by the lexicographically smaller node id.
+            keep_new = peer.we_called_remote == (self.node_id < peer.peer_id)
+            if not keep_new:
+                peer.drop("duplicate connection (kept existing)")
+                return
+            old.drop("superseded by new connection")
+        self.authenticated_peers[peer.peer_id] = peer
+        log.info("peer %s authenticated (%s)", peer.peer_id.hex()[:8],
+                 "outbound" if peer.we_called_remote else "inbound")
+        # bring the peer up to date on consensus (reference:
+        # Peer::recvAuth -> sendSCPState... via Herder)
+        for env in self.herder.get_scp_state(0):
+            peer.send_message(X.StellarMessage.envelope(env))
+        self.fetcher.peer_available(peer, self._auth_peer_list())
+
+    def _peer_dropped(self, peer: Peer) -> None:
+        self.stats["dropped_peers"] += 1
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+        if peer.peer_id is not None and \
+                self.authenticated_peers.get(peer.peer_id) is peer:
+            del self.authenticated_peers[peer.peer_id]
+        self.adverts.forget_peer(peer)
+
+    def _auth_peer_list(self) -> List[Peer]:
+        return list(self.authenticated_peers.values())
+
+    def num_authenticated(self) -> int:
+        return len(self.authenticated_peers)
+
+    # -- outbound flooding --------------------------------------------------
+    def broadcast_scp_envelope(self, env) -> None:
+        msg = X.StellarMessage.envelope(env)
+        h = sha256(msg.to_xdr())
+        self.floodgate.add_record(h, env.statement.slotIndex)
+        self._broadcast(msg, h)
+
+    def flood_transaction(self, frame) -> None:
+        """Pull-mode: advertise the hash; peers demand what they miss."""
+        h = frame.content_hash()
+        self.floodgate.add_record(
+            h, self.herder.tracking_consensus_ledger_index())
+        for peer in self._auth_peer_list():
+            if peer not in self.floodgate.peers_told(h):
+                self.adverts.queue_advert(peer, h)
+
+    def _broadcast(self, msg: X.StellarMessage, msg_hash: bytes) -> None:
+        told = self.floodgate.peers_told(msg_hash)
+        for peer in self._auth_peer_list():
+            if peer not in told:
+                peer.send_message(msg)
+                self.floodgate.note_told(msg_hash, peer)
+                self.stats["flooded"] += 1
+
+    def _send_advert(self, peer: Peer, hashes: List[bytes]) -> None:
+        peer.send_message(X.StellarMessage.floodAdvert(
+            X.FloodAdvert(txHashes=hashes)))
+
+    def _send_demand(self, peer: Peer, hashes: List[bytes]) -> None:
+        peer.send_message(X.StellarMessage.floodDemand(
+            X.FloodDemand(txHashes=hashes)))
+
+    def _ask_for_item(self, peer: Peer, item_type: str, h: bytes) -> None:
+        if item_type == "txset":
+            peer.send_message(X.StellarMessage.txSetHash(h))
+        else:
+            peer.send_message(X.StellarMessage.qSetHash(h))
+
+    def flush_adverts(self) -> None:
+        self.adverts.flush_all()
+
+    def clear_below(self, ledger_seq: int) -> None:
+        self.floodgate.clear_below(ledger_seq)
+
+    # -- inbound dispatch ---------------------------------------------------
+    def ledger_version(self) -> int:
+        return self.herder.lm.lcl_header.ledgerVersion
+
+    def _message_received(self, peer: Peer, msg: X.StellarMessage) -> None:
+        t = msg.switch
+        MT = X.MessageType
+        if t in (MT.SEND_MORE, MT.SEND_MORE_EXTENDED):
+            return  # handled in Peer flow control
+        if t == MT.SCP_MESSAGE:
+            self._recv_scp(peer, msg)
+        elif t == MT.TRANSACTION:
+            self._recv_transaction(peer, msg)
+        elif t == MT.FLOOD_ADVERT:
+            self._recv_advert(peer, msg.value.txHashes)
+        elif t == MT.FLOOD_DEMAND:
+            self._recv_demand(peer, msg.value.txHashes)
+        elif t == MT.GET_TX_SET:
+            self._serve_txset(peer, msg.value)
+        elif t == MT.TX_SET:
+            txset = msg.value
+            h = sha256(txset.to_xdr())
+            self.fetcher.stop_fetch(h)
+            self.herder.recv_tx_set(h, txset)
+        elif t == MT.DONT_HAVE:
+            self.fetcher.dont_have(msg.value.reqHash, peer,
+                                   self._auth_peer_list())
+        elif t == MT.GET_SCP_QUORUMSET:
+            self._serve_qset(peer, msg.value)
+        elif t == MT.SCP_QUORUMSET:
+            qs = msg.value
+            from ..scp.quorum import qset_hash
+            self.fetcher.stop_fetch(qset_hash(qs))
+            self.herder.recv_qset(qs)
+        elif t == MT.GET_SCP_STATE:
+            for env in self.herder.get_scp_state(msg.value):
+                peer.send_message(X.StellarMessage.envelope(env))
+        elif t == MT.GET_PEERS:
+            peer.send_message(X.StellarMessage.peers([]))
+        elif t == MT.PEERS:
+            pass  # address-book persistence arrives with PeerManager
+        else:
+            log.warning("unhandled message type %s", t)
+
+    def _recv_scp(self, peer: Peer, msg: X.StellarMessage) -> None:
+        env = msg.value
+        h = sha256(msg.to_xdr())
+        if not self.floodgate.add_record(h, env.statement.slotIndex, peer):
+            self.stats["deduped"] += 1
+            return
+        status = self.herder.recv_scp_envelope(env)
+        if status != "discarded":
+            self._broadcast(msg, h)
+
+    def _recv_transaction(self, peer: Peer, msg: X.StellarMessage) -> None:
+        try:
+            frame = self.herder.lm.make_frame(msg.value)
+        except Exception:
+            peer.drop("undecodable transaction")
+            return
+        h = frame.content_hash()
+        if not self.floodgate.add_record(
+                h, self.herder.tracking_consensus_ledger_index(), peer):
+            self.stats["deduped"] += 1
+            return
+        res = self.herder.recv_transaction(frame)
+        if getattr(res, "code", None) == "pending":
+            # re-advertise to everyone who hasn't seen it
+            for p in self._auth_peer_list():
+                if p not in self.floodgate.peers_told(h):
+                    self.adverts.queue_advert(p, h)
+
+    def _recv_advert(self, peer: Peer, hashes: List[bytes]) -> None:
+        demand = [h for h in hashes if not self.floodgate.seen(h)]
+        if demand:
+            self._send_demand(peer, demand[:X.TX_DEMAND_VECTOR_MAX_SIZE])
+
+    def _recv_demand(self, peer: Peer, hashes: List[bytes]) -> None:
+        for h in hashes:
+            frame = self.herder.tx_queue.by_hash.get(h)
+            if frame is not None:
+                peer.send_message(X.StellarMessage.transaction(
+                    frame.envelope))
+                self.floodgate.note_told(h, peer)
+
+    def _serve_txset(self, peer: Peer, h: bytes) -> None:
+        got = self.herder.pending.get_txset(h)
+        if got is not None:
+            peer.send_message(X.StellarMessage.txSet(got[0]))
+        else:
+            peer.send_message(X.StellarMessage.dontHave(X.DontHave(
+                type=X.MessageType.GET_TX_SET, reqHash=h)))
+
+    def _serve_qset(self, peer: Peer, h: bytes) -> None:
+        qs = self.herder.pending.get_qset(h)
+        if qs is not None:
+            peer.send_message(X.StellarMessage.qSet(qs))
+        else:
+            peer.send_message(X.StellarMessage.dontHave(X.DontHave(
+                type=X.MessageType.GET_SCP_QUORUMSET, reqHash=h)))

@@ -1,0 +1,367 @@
+"""Peer: handshake + authenticated messaging + flow control state machine.
+
+Reference: src/overlay/Peer.{h,cpp} (recvHello/recvAuth/recvAuthenticated
+dispatch, DropReason semantics), src/overlay/FlowControl.{h,cpp}
+(capacity-granted flood sending via SEND_MORE_EXTENDED), transport left to
+subclasses (TCPPeer / LoopbackPeer, like the reference).
+
+Wire format: RFC 5531 record marking — every frame is a 4-byte big-endian
+header with the high bit set (single-fragment) and the payload length in
+the low 31 bits, followed by an AuthenticatedMessage XDR.  HELLO and
+ERROR_MSG travel with sequence 0 and a zero MAC (no keys yet); everything
+else is HMAC'd with per-direction keys and strictly increasing sequences.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from .. import xdr as X
+from ..util import logging as slog
+from .peer_auth import PeerAuth, mac_message, mac_ok
+
+log = slog.get("Overlay")
+
+OVERLAY_PROTOCOL_VERSION = 38
+OVERLAY_PROTOCOL_MIN_VERSION = 35
+VERSION_STR = "stellar-core-tpu 2.0"
+
+# flow control (reference: FlowControl::start — these are the capacities a
+# node grants its peer when the connection authenticates)
+PEER_FLOOD_READING_CAPACITY = 200
+FLOW_CONTROL_SEND_MORE_BATCH = 40
+PEER_FLOOD_READING_CAPACITY_BYTES = 300_000
+FLOW_CONTROL_BYTES_BATCH = 100_000
+
+_ZERO_MAC = b"\x00" * 32
+
+_FLOOD_TYPES = frozenset((
+    X.MessageType.TRANSACTION, X.MessageType.SCP_MESSAGE,
+    X.MessageType.FLOOD_ADVERT, X.MessageType.FLOOD_DEMAND))
+
+
+def frame_encode(payload: bytes) -> bytes:
+    assert len(payload) < (1 << 31)
+    return (0x80000000 | len(payload)).to_bytes(4, "big") + payload
+
+
+class FrameDecoder:
+    """Incremental record-mark deframer for a byte stream."""
+
+    MAX_FRAME = 32 * 1024 * 1024
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf += data
+        out = []
+        while True:
+            if len(self._buf) < 4:
+                break
+            header = int.from_bytes(self._buf[:4], "big")
+            if not header & 0x80000000:
+                raise ValueError("fragmented records not supported")
+            length = header & 0x7FFFFFFF
+            if length > self.MAX_FRAME:
+                raise ValueError("oversized frame")
+            if len(self._buf) < 4 + length:
+                break
+            out.append(bytes(self._buf[4:4 + length]))
+            del self._buf[:4 + length]
+        return out
+
+
+class Peer:
+    # connection states (reference: Peer::State)
+    CONNECTING = "connecting"
+    CONNECTED = "connected"      # transport up, HELLO exchange in flight
+    GOT_HELLO = "got-hello"
+    GOT_AUTH = "authenticated"
+    CLOSING = "closing"
+
+    def __init__(self, overlay, we_called_remote: bool):
+        self.overlay = overlay
+        self.auth: PeerAuth = overlay.peer_auth
+        self.we_called_remote = we_called_remote
+        self.state = Peer.CONNECTING
+        self.peer_id: Optional[bytes] = None       # remote ed25519
+        self.remote_listening_port: int = 0
+        self.local_nonce = os.urandom(32)
+        self._decoder = FrameDecoder()
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._send_key: Optional[bytes] = None
+        self._recv_key: Optional[bytes] = None
+        self.drop_reason: Optional[str] = None
+        # flow control
+        self._outbound_capacity = 0
+        self._outbound_capacity_bytes = 0
+        self._flood_queue: List[X.StellarMessage] = []
+        self._processed_since_grant = 0
+        self._processed_bytes_since_grant = 0
+
+    # -- transport interface (subclass-provided) ----------------------------
+    def _write_bytes(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _close_transport(self) -> None:
+        raise NotImplementedError
+
+    # -- lifecycle ----------------------------------------------------------
+    def connect_handler(self) -> None:
+        """Transport established.  The dialer speaks first (reference:
+        TCPPeer::connectHandler -> sendHello)."""
+        self.state = Peer.CONNECTED
+        if self.we_called_remote:
+            self.send_hello()
+
+    def drop(self, reason: str) -> None:
+        if self.state == Peer.CLOSING:
+            return
+        self.drop_reason = reason
+        self.state = Peer.CLOSING
+        log.info("dropping peer %s: %s",
+                 self.peer_id.hex()[:8] if self.peer_id else "?", reason)
+        self._close_transport()
+        self.overlay._peer_dropped(self)
+
+    def is_authenticated(self) -> bool:
+        return self.state == Peer.GOT_AUTH
+
+    # -- sending ------------------------------------------------------------
+    def send_hello(self) -> None:
+        lcl = self.overlay.ledger_version()
+        msg = X.StellarMessage.hello(X.Hello(
+            ledgerVersion=lcl,
+            overlayVersion=OVERLAY_PROTOCOL_VERSION,
+            overlayMinVersion=OVERLAY_PROTOCOL_MIN_VERSION,
+            networkID=self.overlay.network_id,
+            versionStr=VERSION_STR,
+            listeningPort=self.overlay.listening_port,
+            peerID=X.NodeID.ed25519(self.overlay.node_id),
+            cert=self.auth.get_cert(),
+            nonce=self.local_nonce))
+        self._send_unauthenticated(msg)
+
+    def send_error(self, code, text: str) -> None:
+        self._send_unauthenticated(X.StellarMessage.error(
+            X.Error(code=code, msg=text)))
+
+    def _send_unauthenticated(self, msg: X.StellarMessage) -> None:
+        am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+            sequence=0, message=msg, mac=X.HmacSha256Mac(mac=_ZERO_MAC)))
+        self._write_bytes(frame_encode(am.to_xdr()))
+
+    def send_message(self, msg: X.StellarMessage) -> None:
+        """Authenticated send; flood messages respect granted capacity and
+        queue when the peer hasn't given us room (reference:
+        FlowControl::maybeSendMessage).  The XDR body is encoded exactly
+        once and threaded through queueing, size accounting and the MAC."""
+        if self.state == Peer.CLOSING:
+            return
+        body = msg.to_xdr()
+        if msg.switch in _FLOOD_TYPES:
+            if self._outbound_capacity <= 0 \
+                    or self._outbound_capacity_bytes < len(body):
+                self._flood_queue.append((msg, body))
+                return
+            self._outbound_capacity -= 1
+            self._outbound_capacity_bytes -= len(body)
+        self._send_authenticated(msg, body)
+
+    def _send_authenticated(self, msg: X.StellarMessage,
+                            body: Optional[bytes] = None) -> None:
+        if self._send_key is None:
+            self.drop("send before auth keys")
+            return
+        if body is None:
+            body = msg.to_xdr()
+        mac = mac_message(self._send_key, self._send_seq, body)
+        am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+            sequence=self._send_seq, message=msg,
+            mac=X.HmacSha256Mac(mac=mac)))
+        self._send_seq += 1
+        self._write_bytes(frame_encode(am.to_xdr()))
+
+    def _flush_flood_queue(self) -> None:
+        while self._flood_queue and self._outbound_capacity > 0:
+            msg, body = self._flood_queue[0]
+            if self._outbound_capacity_bytes < len(body):
+                break
+            self._flood_queue.pop(0)
+            self._outbound_capacity -= 1
+            self._outbound_capacity_bytes -= len(body)
+            self._send_authenticated(msg, body)
+
+    @property
+    def flood_queue_len(self) -> int:
+        return len(self._flood_queue)
+
+    # -- receiving ----------------------------------------------------------
+    def data_received(self, data: bytes) -> None:
+        try:
+            frames = self._decoder.feed(data)
+        except ValueError as e:
+            self.drop(f"bad framing: {e}")
+            return
+        for frame in frames:
+            if self.state == Peer.CLOSING:
+                return
+            self._frame_received(frame)
+
+    def _frame_received(self, frame: bytes) -> None:
+        try:
+            am = X.AuthenticatedMessage.from_xdr(frame)
+        except Exception:
+            self.drop("undecodable message")
+            return
+        v0 = am.value
+        msg = v0.message
+        if msg.switch == X.MessageType.HELLO:
+            if v0.sequence != 0 or v0.mac.mac != _ZERO_MAC:
+                self.drop("HELLO must be unauthenticated")
+                return
+            self._recv_hello(msg.value)
+            return
+        if msg.switch == X.MessageType.ERROR_MSG:
+            err = msg.value
+            self.drop(f"peer error: {err.code.name} "
+                      f"{err.msg.decode(errors='replace')}")
+            return
+        # everything else requires the MAC chain
+        if self._recv_key is None:
+            self.drop("authenticated message before HELLO exchange")
+            return
+        body = msg.to_xdr()
+        if v0.sequence != self._recv_seq \
+                or not mac_ok(self._recv_key, v0.sequence, body, v0.mac.mac):
+            self.drop("bad MAC or sequence")
+            return
+        self._recv_seq += 1
+        if msg.switch == X.MessageType.AUTH:
+            self._recv_auth()
+            return
+        if not self.is_authenticated():
+            self.drop("message before AUTH")
+            return
+        self._account_flood_processing(msg, len(body))
+        self.overlay._message_received(self, msg)
+
+    def _recv_hello(self, hello) -> None:
+        if self.state not in (Peer.CONNECTED, Peer.CONNECTING):
+            self.drop("HELLO out of order")
+            return
+        if hello.networkID != self.overlay.network_id:
+            self.send_error(X.ErrorCode.ERR_CONF, "wrong network")
+            self.drop("wrong network id")
+            return
+        if hello.overlayVersion < OVERLAY_PROTOCOL_MIN_VERSION:
+            self.send_error(X.ErrorCode.ERR_CONF, "overlay version too old")
+            self.drop("overlay version")
+            return
+        peer_id = hello.peerID.value
+        if peer_id == self.overlay.node_id:
+            self.drop("connected to self")
+            return
+        if not self.auth.verify_remote_cert(hello.cert, peer_id):
+            self.send_error(X.ErrorCode.ERR_AUTH, "bad auth cert")
+            self.drop("bad auth cert")
+            return
+        self.peer_id = peer_id
+        self.remote_listening_port = hello.listeningPort
+        self._send_key, self._recv_key = self.auth.shared_keys(
+            hello.cert.pubkey.key, self.local_nonce, hello.nonce,
+            self.we_called_remote)
+        self.state = Peer.GOT_HELLO
+        if not self.we_called_remote:
+            self.send_hello()
+        else:
+            self._send_authenticated(X.StellarMessage.auth(X.Auth(flags=0)))
+
+    def _recv_auth(self) -> None:
+        if self.state != Peer.GOT_HELLO:
+            self.drop("AUTH out of order")
+            return
+        if not self.we_called_remote:
+            # acceptor completes the handshake with its own AUTH
+            self._send_authenticated(X.StellarMessage.auth(X.Auth(flags=0)))
+        self.state = Peer.GOT_AUTH
+        self._grant_capacity(initial=True)
+        self.overlay._peer_authenticated(self)
+
+    # -- flow control -------------------------------------------------------
+    def _grant_capacity(self, initial: bool = False) -> None:
+        if initial:
+            self.send_message(X.StellarMessage.sendMoreExtendedMessage(
+                X.SendMoreExtended(
+                    numMessages=PEER_FLOOD_READING_CAPACITY,
+                    numBytes=PEER_FLOOD_READING_CAPACITY_BYTES)))
+
+    def _account_flood_processing(self, msg: X.StellarMessage,
+                                  size: int) -> None:
+        """Receiver side: periodically hand the sender fresh capacity.
+        Grants trigger on EITHER the message-count or the byte threshold
+        (reference: FlowControl::maybeSendNextBatch does both) — otherwise
+        a few large messages could exhaust the sender's byte allowance
+        before the message counter ever reaches the batch size, stalling
+        the connection permanently."""
+        if msg.switch == X.MessageType.SEND_MORE:
+            self._outbound_capacity += msg.value.numMessages
+            self._outbound_capacity_bytes += FLOW_CONTROL_BYTES_BATCH
+            self._flush_flood_queue()
+            return
+        if msg.switch == X.MessageType.SEND_MORE_EXTENDED:
+            self._outbound_capacity += msg.value.numMessages
+            self._outbound_capacity_bytes += msg.value.numBytes
+            self._flush_flood_queue()
+            return
+        if msg.switch in _FLOOD_TYPES:
+            self._processed_since_grant += 1
+            self._processed_bytes_since_grant += size
+            if (self._processed_since_grant >= FLOW_CONTROL_SEND_MORE_BATCH
+                    or self._processed_bytes_since_grant
+                    >= FLOW_CONTROL_BYTES_BATCH):
+                n = self._processed_since_grant
+                nb = self._processed_bytes_since_grant
+                self._processed_since_grant = 0
+                self._processed_bytes_since_grant = 0
+                self.send_message(X.StellarMessage.sendMoreExtendedMessage(
+                    X.SendMoreExtended(numMessages=n, numBytes=nb)))
+
+
+class LoopbackPeer(Peer):
+    """In-process transport for deterministic tests (reference:
+    src/overlay/test/LoopbackPeer) — bytes are delivered to the partner via
+    clock-posted actions, so delivery interleaves with timers."""
+
+    def __init__(self, overlay, we_called_remote: bool):
+        super().__init__(overlay, we_called_remote)
+        self.partner: Optional["LoopbackPeer"] = None
+        self.drop_outbound = False   # test hook: simulate a black hole
+
+    def _write_bytes(self, data: bytes) -> None:
+        if self.partner is None or self.drop_outbound:
+            return
+        partner = self.partner
+        self.overlay.clock.post_action(
+            lambda: partner.data_received(data), name="loopback-delivery")
+
+    def _close_transport(self) -> None:
+        if self.partner is not None and self.partner.state != Peer.CLOSING:
+            partner, self.partner = self.partner, None
+            partner.partner = None
+            partner.drop("partner closed")
+
+
+def make_loopback_pair(overlay_a, overlay_b):
+    """Wire two overlays with a loopback connection; a dials b."""
+    pa = LoopbackPeer(overlay_a, we_called_remote=True)
+    pb = LoopbackPeer(overlay_b, we_called_remote=False)
+    pa.partner, pb.partner = pb, pa
+    overlay_a._register_peer(pa)
+    overlay_b._register_peer(pb)
+    pb.connect_handler()
+    pa.connect_handler()
+    return pa, pb

@@ -33,6 +33,16 @@ class VirtualClock:
         self._seq = itertools.count()
         self.scheduler = Scheduler()
         self._stopped = False
+        # IO pumps: polled at the top of every crank (the asio-socket
+        # integration point; reference: VirtualClock owns the io_context)
+        self._io_pumps: List[Callable[[], int]] = []
+
+    def add_io_pump(self, pump: Callable[[], int]) -> None:
+        self._io_pumps.append(pump)
+
+    def remove_io_pump(self, pump: Callable[[], int]) -> None:
+        if pump in self._io_pumps:
+            self._io_pumps.remove(pump)
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -63,6 +73,8 @@ class VirtualClock:
         if self._stopped:
             return 0
         progressed = 0
+        for pump in list(self._io_pumps):
+            progressed += pump()
         progressed += self.scheduler.run_one_batch()
         now = self.now()
         while self._heap and self._heap[0][0] <= now:

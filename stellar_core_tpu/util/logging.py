@@ -10,7 +10,8 @@ from typing import Dict
 
 PARTITIONS = (
     "Fs", "SCP", "Bucket", "Overlay", "History", "Ledger", "Herder", "Tx",
-    "Database", "Process", "Work", "Invariant", "Perf",
+    "Database", "Process", "Work", "Invariant", "Perf", "Main",
+    "CommandHandler",
 )
 
 _loggers: Dict[str, logging.Logger] = {}

@@ -12,3 +12,4 @@ from .ledger_entries import *  # noqa: F401,F403
 from .transaction import *     # noqa: F401,F403
 from .scp import *             # noqa: F401,F403
 from .ledger import *          # noqa: F401,F403
+from .overlay import *       # noqa: F401,F403

@@ -1,0 +1,153 @@
+"""Stellar-overlay.x equivalents (reference: src/protocol-curr/xdr/
+Stellar-overlay.x) — the P2P wire protocol: HELLO/AUTH handshake types,
+flood adverts/demands, item fetch, flow control and the authenticated
+message envelope."""
+
+from .codec import (Int32, Opaque, Uint32, Uint64, VarArray, XdrString,
+                    xdr_enum, xdr_struct, xdr_union)
+from .types import Hash, NodeID, Signature, Uint256
+
+ErrorCode = xdr_enum("ErrorCode", {
+    "ERR_MISC": 0,
+    "ERR_DATA": 1,
+    "ERR_CONF": 2,
+    "ERR_AUTH": 3,
+    "ERR_LOAD": 4,
+})
+
+Error = xdr_struct("Error", [
+    ("code", ErrorCode),
+    ("msg", XdrString(100)),
+])
+
+Curve25519Public = xdr_struct("Curve25519Public", [
+    ("key", Opaque(32)),
+])
+
+HmacSha256Mac = xdr_struct("HmacSha256Mac", [
+    ("mac", Opaque(32)),
+])
+
+AuthCert = xdr_struct("AuthCert", [
+    ("pubkey", Curve25519Public),
+    ("expiration", Uint64),
+    ("sig", Signature),
+])
+
+Hello = xdr_struct("Hello", [
+    ("ledgerVersion", Uint32),
+    ("overlayVersion", Uint32),
+    ("overlayMinVersion", Uint32),
+    ("networkID", Hash),
+    ("versionStr", XdrString(100)),
+    ("listeningPort", Int32),
+    ("peerID", NodeID),
+    ("cert", AuthCert),
+    ("nonce", Uint256),
+])
+
+# AUTH_MSG_FLAG_FLOW_CONTROL_BYTES_REQUESTED = 200 in the reference; we
+# always speak flow control so the flag is informational
+Auth = xdr_struct("Auth", [
+    ("flags", Int32),
+], defaults={"flags": 0})
+
+IPAddrType = xdr_enum("IPAddrType", {"IPv4": 0, "IPv6": 1})
+
+PeerAddressIp = xdr_union("PeerAddressIp", IPAddrType, {
+    IPAddrType.IPv4: ("ipv4", Opaque(4)),
+    IPAddrType.IPv6: ("ipv6", Opaque(16)),
+})
+
+PeerAddress = xdr_struct("PeerAddress", [
+    ("ip", PeerAddressIp),
+    ("port", Uint32),
+    ("numFailures", Uint32),
+], defaults={"numFailures": 0})
+
+MessageType = xdr_enum("MessageType", {
+    "ERROR_MSG": 0,
+    "AUTH": 2,
+    "DONT_HAVE": 3,
+    "GET_PEERS": 4,
+    "PEERS": 5,
+    "GET_TX_SET": 6,
+    "TX_SET": 7,
+    "TRANSACTION": 8,
+    "GET_SCP_QUORUMSET": 9,
+    "SCP_QUORUMSET": 10,
+    "SCP_MESSAGE": 11,
+    "GET_SCP_STATE": 12,
+    "HELLO": 13,
+    "SEND_MORE": 16,
+    "FLOOD_ADVERT": 18,
+    "FLOOD_DEMAND": 19,
+    "SEND_MORE_EXTENDED": 20,
+})
+
+DontHave = xdr_struct("DontHave", [
+    ("type", MessageType),
+    ("reqHash", Uint256),
+])
+
+SendMore = xdr_struct("SendMore", [
+    ("numMessages", Uint32),
+])
+
+SendMoreExtended = xdr_struct("SendMoreExtended", [
+    ("numMessages", Uint32),
+    ("numBytes", Uint32),
+])
+
+TX_ADVERT_VECTOR_MAX_SIZE = 1000
+TX_DEMAND_VECTOR_MAX_SIZE = 1000
+
+FloodAdvert = xdr_struct("FloodAdvert", [
+    ("txHashes", VarArray(Hash, TX_ADVERT_VECTOR_MAX_SIZE)),
+])
+
+FloodDemand = xdr_struct("FloodDemand", [
+    ("txHashes", VarArray(Hash, TX_DEMAND_VECTOR_MAX_SIZE)),
+])
+
+
+def _build_stellar_message():
+    # deferred imports dodge a cycle: transaction.py imports nothing from
+    # here, but xdr/__init__ imports both
+    from .scp import SCPEnvelope, SCPQuorumSet
+    from .transaction import TransactionEnvelope
+    from .ledger import TransactionSet
+
+    return xdr_union("StellarMessage", MessageType, {
+        MessageType.ERROR_MSG: ("error", Error),
+        MessageType.HELLO: ("hello", Hello),
+        MessageType.AUTH: ("auth", Auth),
+        MessageType.DONT_HAVE: ("dontHave", DontHave),
+        MessageType.GET_PEERS: ("getPeers", None),
+        MessageType.PEERS: ("peers", VarArray(PeerAddress, 100)),
+        MessageType.GET_TX_SET: ("txSetHash", Uint256),
+        MessageType.TX_SET: ("txSet", TransactionSet),
+        MessageType.TRANSACTION: ("transaction", TransactionEnvelope),
+        MessageType.GET_SCP_QUORUMSET: ("qSetHash", Uint256),
+        MessageType.SCP_QUORUMSET: ("qSet", SCPQuorumSet),
+        MessageType.SCP_MESSAGE: ("envelope", SCPEnvelope),
+        MessageType.GET_SCP_STATE: ("getSCPLedgerSeq", Uint32),
+        MessageType.SEND_MORE: ("sendMoreMessage", SendMore),
+        MessageType.SEND_MORE_EXTENDED: ("sendMoreExtendedMessage",
+                                         SendMoreExtended),
+        MessageType.FLOOD_ADVERT: ("floodAdvert", FloodAdvert),
+        MessageType.FLOOD_DEMAND: ("floodDemand", FloodDemand),
+    })
+
+
+StellarMessage = _build_stellar_message()
+
+AuthenticatedMessageV0 = xdr_struct("AuthenticatedMessageV0", [
+    ("sequence", Uint64),
+    ("message", StellarMessage),
+    ("mac", HmacSha256Mac),
+])
+
+AuthenticatedMessage = xdr_union("AuthenticatedMessage", Uint32, {
+    0: ("v0", AuthenticatedMessageV0),
+})

@@ -1,0 +1,331 @@
+"""Overlay P2P tests: framing, auth handshake, flooding, item fetch, flow
+control — over loopback (deterministic, virtual time) and real TCP sockets.
+
+Reference test model: src/overlay/test/{OverlayManagerTests, PeerTests,
+FloodTests, ItemFetcherTests, FlowControlTests}.cpp + LoopbackPeer.
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.herder.herder import Herder
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.overlay import (FrameDecoder, OverlayManager, Peer,
+                                      PeerAuth, TCPTransport, frame_encode,
+                                      make_loopback_pair)
+from stellar_core_tpu.simulation.simulation import qset_of
+from stellar_core_tpu.testutils import TestAccount, create_account_op, \
+    network_id
+from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+NID = network_id("overlay test net")
+
+
+# ---------------------------------------------------------------------------
+# framing
+
+class TestFraming:
+    def test_roundtrip_and_partial_feeds(self):
+        d = FrameDecoder()
+        f1 = frame_encode(b"hello")
+        f2 = frame_encode(b"world!" * 100)
+        stream = f1 + f2
+        got = []
+        for i in range(0, len(stream), 7):   # drip-feed 7 bytes at a time
+            got.extend(d.feed(stream[i:i + 7]))
+        assert got == [b"hello", b"world!" * 100]
+
+    def test_rejects_fragmented_record(self):
+        d = FrameDecoder()
+        with pytest.raises(ValueError, match="fragmented"):
+            d.feed((5).to_bytes(4, "big") + b"xxxxx")  # high bit clear
+
+    def test_rejects_oversized(self):
+        d = FrameDecoder()
+        with pytest.raises(ValueError, match="oversized"):
+            d.feed((0x80000000 | (64 * 1024 * 1024)).to_bytes(4, "big"))
+
+
+# ---------------------------------------------------------------------------
+# auth primitives
+
+class TestPeerAuth:
+    def _auth(self, seed, now=lambda: 1000):
+        return PeerAuth(SecretKey(seed), NID, now, auth_seed=seed)
+
+    def test_cert_mints_and_verifies(self):
+        a = self._auth(b"\x01" * 32)
+        b = self._auth(b"\x02" * 32)
+        cert = a.get_cert()
+        assert b.verify_remote_cert(cert,
+                                    a.node_secret.public_key.ed25519)
+
+    def test_cert_wrong_identity_rejected(self):
+        a = self._auth(b"\x01" * 32)
+        b = self._auth(b"\x02" * 32)
+        cert = a.get_cert()
+        assert not b.verify_remote_cert(
+            cert, b.node_secret.public_key.ed25519)
+
+    def test_expired_cert_rejected(self):
+        a = self._auth(b"\x01" * 32, now=lambda: 1000)
+        cert = a.get_cert()
+        late = self._auth(b"\x02" * 32, now=lambda: 10**9)
+        assert not late.verify_remote_cert(
+            cert, a.node_secret.public_key.ed25519)
+
+    def test_shared_keys_symmetric_and_direction_distinct(self):
+        a = self._auth(b"\x01" * 32)
+        b = self._auth(b"\x02" * 32)
+        na, nb = b"\x0a" * 32, b"\x0b" * 32
+        a_send, a_recv = a.shared_keys(b.auth_public, na, nb, True)
+        b_send, b_recv = b.shared_keys(a.auth_public, nb, na, False)
+        assert a_send == b_recv and a_recv == b_send
+        assert a_send != a_recv
+
+
+# ---------------------------------------------------------------------------
+# full-node helpers
+
+def _make_node(clock, secret, qset, seed):
+    lm = LedgerManager(NID)
+    lm.start_new_ledger()
+    herder = Herder(clock, lm, secret, qset)
+    overlay = OverlayManager(clock, herder, NID, secret, auth_seed=seed)
+    return herder, overlay
+
+
+def _crank(clock, n=50):
+    for _ in range(n):
+        clock.crank()
+
+
+class TestLoopbackHandshake:
+    def setup_method(self):
+        self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        self.sk_a, self.sk_b = SecretKey(b"\x0a" * 32), SecretKey(b"\x0b" * 32)
+        q = qset_of([self.sk_a.public_key.ed25519,
+                     self.sk_b.public_key.ed25519], 2)
+        self.ha, self.oa = _make_node(self.clock, self.sk_a, q, b"a" * 32)
+        self.hb, self.ob = _make_node(self.clock, self.sk_b, q, b"b" * 32)
+
+    def test_handshake_authenticates_both_sides(self):
+        pa, pb = make_loopback_pair(self.oa, self.ob)
+        _crank(self.clock)
+        assert pa.is_authenticated() and pb.is_authenticated()
+        assert pa.peer_id == self.sk_b.public_key.ed25519
+        assert pb.peer_id == self.sk_a.public_key.ed25519
+        assert self.oa.num_authenticated() == 1
+        assert self.ob.num_authenticated() == 1
+
+    def test_bad_cert_rejected(self):
+        # B presents a cert signed by the wrong identity
+        evil = PeerAuth(SecretKey(b"\x66" * 32), NID,
+                        self.clock.system_now, auth_seed=b"evil" * 8)
+        self.ob.peer_auth.node_secret = SecretKey(b"\x66" * 32)
+        pa, pb = make_loopback_pair(self.oa, self.ob)
+        _crank(self.clock)
+        assert not pa.is_authenticated()
+        assert pa.drop_reason is not None or pb.drop_reason is not None
+
+    def test_wrong_network_dropped(self):
+        self.ob.network_id = network_id("some other network")
+        self.ob.peer_auth.network_id = self.ob.network_id
+        pa, pb = make_loopback_pair(self.oa, self.ob)
+        _crank(self.clock)
+        assert not pa.is_authenticated() and not pb.is_authenticated()
+
+    def test_tampered_mac_drops_peer(self):
+        pa, pb = make_loopback_pair(self.oa, self.ob)
+        _crank(self.clock)
+        assert pa.is_authenticated()
+        # hand-craft a message with a garbage MAC
+        msg = X.StellarMessage.getPeers()
+        am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+            sequence=pb._recv_seq, message=msg,
+            mac=X.HmacSha256Mac(mac=b"\xff" * 32)))
+        pb.data_received(frame_encode(am.to_xdr()))
+        assert pb.drop_reason == "bad MAC or sequence"
+
+    def test_replayed_sequence_drops_peer(self):
+        pa, pb = make_loopback_pair(self.oa, self.ob)
+        _crank(self.clock)
+        from stellar_core_tpu.overlay.peer_auth import mac_message
+        msg = X.StellarMessage.getPeers()
+        body = msg.to_xdr()
+        seq = 0  # already consumed by AUTH
+        am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+            sequence=seq, message=msg,
+            mac=X.HmacSha256Mac(mac=mac_message(pa._send_key, seq, body))))
+        pb.data_received(frame_encode(am.to_xdr()))
+        assert pb.drop_reason == "bad MAC or sequence"
+
+
+class TestLoopbackConsensus:
+    def test_two_validators_reach_externalize_over_overlay(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x0a" * 32), SecretKey(b"\x0b" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"a" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"b" * 32)
+        make_loopback_pair(oa, ob)
+        _crank(clock)
+        ha.bootstrap()
+        hb.bootstrap()
+        ok = clock.crank_until(
+            lambda: ha.lm.last_closed_ledger_seq >= 3
+            and hb.lm.last_closed_ledger_seq >= 3, timeout=120)
+        assert ok, (ha.lm.last_closed_ledger_seq,
+                    hb.lm.last_closed_ledger_seq)
+        assert ha.lm.lcl_hash == hb.lm.lcl_hash
+
+    def test_transaction_floods_and_externalizes_everywhere(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x0a" * 32), SecretKey(b"\x0b" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"a" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"b" * 32)
+        make_loopback_pair(oa, ob)
+        _crank(clock)
+        ha.bootstrap()
+        hb.bootstrap()
+        clock.crank_until(lambda: ha.lm.last_closed_ledger_seq >= 2,
+                          timeout=60)
+        # submit to A only; pull-mode flood must carry it to B's queue and
+        # consensus must apply it on both
+        root_sk = ha.lm.root_account_secret()
+        e = ha.lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(
+                root_sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(ha.lm, root_sk, e.data.value.seqNum)
+        dest = SecretKey(b"\x77" * 32)
+        frame = root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])
+        assert ha.recv_transaction(frame).code == "pending"
+        ha.tx_flood(frame)
+        oa.flush_adverts()
+        dest_key = X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(
+                dest.public_key.ed25519))).to_xdr()
+        ok = clock.crank_until(
+            lambda: hb.lm.root.get_entry(dest_key) is not None
+            and ha.lm.root.get_entry(dest_key) is not None, timeout=120)
+        assert ok
+        assert ha.lm.lcl_hash is not None
+
+    def test_late_joiner_fetches_missing_txset(self):
+        """C joins after consensus traffic exists; its pending envelopes
+        must fetch tx sets / qsets via the overlay item fetcher."""
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sks = [SecretKey(bytes([0x0a + i]) * 32) for i in range(3)]
+        ids = [s.public_key.ed25519 for s in sks]
+        q = qset_of(ids, 2)
+        nodes = [_make_node(clock, s, q, bytes([0x61 + i]) * 32)
+                 for i, s in enumerate(sks)]
+        (ha, oa), (hb, ob), (hc, oc) = nodes
+        make_loopback_pair(oa, ob)
+        _crank(clock)
+        ha.bootstrap()
+        hb.bootstrap()
+        clock.crank_until(lambda: ha.lm.last_closed_ledger_seq >= 2,
+                          timeout=60)
+        # now connect C to both; it must sync via SCP state + item fetch
+        make_loopback_pair(oc, oa)
+        make_loopback_pair(oc, ob)
+        _crank(clock)
+        hc.start()
+        ok = clock.crank_until(
+            lambda: hc.lm.last_closed_ledger_seq
+            >= ha.lm.last_closed_ledger_seq - 1, timeout=180)
+        assert ok, (hc.lm.last_closed_ledger_seq,
+                    ha.lm.last_closed_ledger_seq)
+
+
+class TestFlowControl:
+    def test_flood_queue_respects_capacity_and_drains_on_send_more(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x0a" * 32), SecretKey(b"\x0b" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"a" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"b" * 32)
+        pa, pb = make_loopback_pair(oa, ob)
+        _crank(clock)
+        assert pa.is_authenticated()
+        assert pa._outbound_capacity > 0
+        # exhaust A's grant without letting B process (black-hole outbound)
+        pa._outbound_capacity = 2
+        pa._outbound_capacity_bytes = 10**9
+        pa.drop_outbound = True
+        env = X.SCPEnvelope(
+            statement=X.SCPStatement(
+                nodeID=X.AccountID.ed25519(sk_a.public_key.ed25519),
+                slotIndex=99,
+                pledges=X.SCPStatementPledges.nominate(X.SCPNomination(
+                    quorumSetHash=b"\x02" * 32, votes=[], accepted=[]))),
+            signature=b"\x03" * 64)
+        for _ in range(5):
+            pa.send_message(X.StellarMessage.envelope(env))
+        assert pa.flood_queue_len == 3      # 2 sent, 3 queued
+        # a SEND_MORE grant from B drains the queue
+        pa.drop_outbound = False
+        from stellar_core_tpu.overlay.peer_auth import mac_message
+        grant = X.StellarMessage.sendMoreMessage(X.SendMore(numMessages=10))
+        body = grant.to_xdr()
+        am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+            sequence=pa._recv_seq, message=grant,
+            mac=X.HmacSha256Mac(
+                mac=mac_message(pa._recv_key, pa._recv_seq, body))))
+        pa.data_received(frame_encode(am.to_xdr()))
+        assert pa.flood_queue_len == 0
+
+
+# ---------------------------------------------------------------------------
+# real TCP sockets
+
+class TestOverTCP:
+    def test_three_node_network_closes_ledgers_over_tcp(self, monkeypatch):
+        """The VERDICT 'done' bar: real processes' worth of nodes (in one
+        process, real sockets) reach externalize over TCP."""
+        from stellar_core_tpu.herder import herder as herder_mod
+        monkeypatch.setattr(herder_mod, "EXP_LEDGER_TIMESPAN_SECONDS", 0.3)
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        sks = [SecretKey(bytes([0x0a + i]) * 32) for i in range(3)]
+        ids = [s.public_key.ed25519 for s in sks]
+        q = qset_of(ids, 2)
+        nodes = []
+        transports = []
+        closed = [{} for _ in range(3)]
+        for i, s in enumerate(sks):
+            h, o = _make_node(clock, s, q, bytes([0x41 + i]) * 32)
+            h.ledger_closed_hook = (
+                lambda arts, d=closed[i]: d.__setitem__(
+                    arts.header_entry.header.ledgerSeq,
+                    arts.header_entry.hash))
+            t = TCPTransport(o, listen_port=0)
+            nodes.append((h, o))
+            transports.append(t)
+        try:
+            # full mesh dialing
+            for i in range(3):
+                for j in range(i + 1, 3):
+                    transports[i].connect("127.0.0.1",
+                                          nodes[j][1].listening_port)
+            ok = clock.crank_until(
+                lambda: all(o.num_authenticated() >= 2 for _, o in nodes),
+                timeout=10)
+            assert ok, [o.num_authenticated() for _, o in nodes]
+            for h, _ in nodes:
+                h.bootstrap()
+            ok = clock.crank_until(
+                lambda: all(h.lm.last_closed_ledger_seq >= 3
+                            for h, _ in nodes), timeout=30)
+            assert ok, [h.lm.last_closed_ledger_seq for h, _ in nodes]
+            # no fork: every commonly-closed ledger hash agrees
+            for seq in (2, 3):
+                hashes = {d[seq] for d in closed if seq in d}
+                assert len(hashes) == 1, f"fork at ledger {seq}"
+        finally:
+            for t in transports:
+                t.close()
