@@ -201,9 +201,13 @@ class Ed25519BatchVerifier:
     """
 
     def __init__(self, chunk_size: int = 8192, table_slots: int = 192,
-                 hot_threshold: int = 4):
+                 hot_threshold: int = 4, tail_floor: int = 256):
         self.chunk_size = chunk_size
         self.hot_threshold = hot_threshold
+        # minimum pad width for tail batches: raising it to chunk_size
+        # bounds jit compiles to ONE shape per path (catchup replay wants
+        # this: compiles amortize over hundreds of checkpoints)
+        self.tail_floor = min(tail_floor, chunk_size)
         # pk -> (cx, cy, ct) limbs of -A, or None if the key fails decoding /
         # canonicality / small-order checks.  Catchup replay re-verifies the
         # same accounts' keys constantly; decompression (two field exps in
@@ -313,7 +317,8 @@ class Ed25519BatchVerifier:
             compiled shapes stays bounded."""
             if count >= cs:
                 return cs
-            return min(cs, max(256, 1 << (count - 1).bit_length()))
+            return min(cs, max(self.tail_floor,
+                               1 << (count - 1).bit_length()))
 
         # -- table path (hot keys): raw bytes + slot ids, no doublings ---
         if hot_idx:
@@ -390,11 +395,15 @@ class Ed25519BatchVerifier:
         return out & ok
 
 
-_verifiers: dict = {}  # chunk_size -> verifier (keeps pk caches + jit warm)
+_verifiers: dict = {}  # (chunk, floor) -> verifier (pk caches + jit warm)
 
 
-def verify_batch(pks, sigs, msgs, chunk_size: int = 512) -> np.ndarray:
-    v = _verifiers.get(chunk_size)
+def verify_batch(pks, sigs, msgs, chunk_size: int = 512,
+                 tail_floor: int = 256,
+                 hot_threshold: int = 4) -> np.ndarray:
+    key = (chunk_size, tail_floor, hot_threshold)
+    v = _verifiers.get(key)
     if v is None:
-        v = _verifiers[chunk_size] = Ed25519BatchVerifier(chunk_size)
+        v = _verifiers[key] = Ed25519BatchVerifier(
+            chunk_size, tail_floor=tail_floor, hot_threshold=hot_threshold)
     return v.verify(pks, sigs, msgs)

@@ -1,0 +1,7 @@
+"""Work units for history archive I/O and catchup (reference: src/historywork/)."""
+
+from .works import (ApplyCheckpointWork, CatchupWork,
+                    GetAndVerifyCheckpointWork)
+
+__all__ = ["ApplyCheckpointWork", "CatchupWork",
+           "GetAndVerifyCheckpointWork"]

@@ -1,0 +1,239 @@
+"""Historywork: Work units for archive I/O and catchup.
+
+Reference: src/historywork/{GetAndUnzipRemoteFileWork, BatchDownloadWork,
+VerifyLedgerChainWork}.cpp and src/catchup/{CatchupWork,
+DownloadApplyTxsWork, ApplyCheckpointWork}.cpp — catchup as a DAG of
+retryable work units, with checkpoint k+1's download/verify overlapping checkpoint k's
+apply (double-buffering, SURVEY.md §5.8).  The TPU pre-verify dispatch
+itself runs as the first crank of each checkpoint's apply work — i.e.
+sequentially after the previous apply — because its signer-set pairing
+reads the pre-checkpoint ledger state.
+
+The archive reads are synchronous file IO here (no subprocess curl), but
+the unit boundaries, retry semantics and pipelining match the reference's
+shape: a failed download/verify retries with backoff without restarting
+the whole catchup; apply is strictly sequential and cooperative (a few
+ledgers per crank) so downloads interleave on the same clock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .. import xdr as X
+from ..catchup.catchup import (CatchupError, preverify_checkpoint_signatures,
+                               verify_ledger_chain)
+from ..crypto.sha import sha256
+from ..history.archive import (CATEGORY_LEDGER, CATEGORY_TRANSACTIONS,
+                               CHECKPOINT_FREQUENCY, FileHistoryArchive,
+                               category_path, checkpoint_containing)
+from ..transactions.frame import TransactionFrame
+from ..util import logging as slog
+from ..util.clock import VirtualClock
+from ..work.work import (RETRY_A_FEW, RETRY_NEVER, BasicWork, State, Work)
+
+log = slog.get("History")
+
+_LHHE = X.LedgerHeaderHistoryEntry._xdr_adapter()
+_THE = X.TransactionHistoryEntry._xdr_adapter()
+
+
+class GetAndVerifyCheckpointWork(BasicWork):
+    """Download one checkpoint's ledger + transactions files and verify the
+    header hash chain.  Retries with backoff on missing/corrupt data
+    (reference: BatchDownloadWork unit + VerifyLedgerChainWork merged per
+    checkpoint)."""
+
+    def __init__(self, clock: VirtualClock, archive: FileHistoryArchive,
+                 checkpoint: int):
+        super().__init__(clock, f"get-verify-{checkpoint:08x}",
+                         max_retries=RETRY_A_FEW)
+        self.archive = archive
+        self.checkpoint = checkpoint
+        self.headers: List[X.LedgerHeaderHistoryEntry] = []
+        self.txs: Dict[int, X.TransactionHistoryEntry] = {}
+
+    def on_reset(self) -> None:
+        self.headers = []
+        self.txs = {}
+
+    def on_run(self) -> State:
+        recs = self.archive.get_xdr_file(
+            category_path(CATEGORY_LEDGER, self.checkpoint))
+        if recs is None:
+            log.warning("%s: ledger file missing", self.name)
+            return State.FAILURE
+        try:
+            headers = [_LHHE.unpack(r) for r in recs]
+            verify_ledger_chain(headers)
+            txs: Dict[int, X.TransactionHistoryEntry] = {}
+            for r in self.archive.get_xdr_file(
+                    category_path(CATEGORY_TRANSACTIONS,
+                                  self.checkpoint)) or []:
+                e = _THE.unpack(r)
+                txs[e.ledgerSeq] = e
+        except (X.XdrError, CatchupError) as e:
+            log.warning("%s: %s", self.name, e)
+            return State.FAILURE
+        self.headers = headers
+        self.txs = txs
+        return State.SUCCESS
+
+
+class ApplyCheckpointWork(BasicWork):
+    """Apply one downloaded checkpoint's ledgers, a few per crank
+    (cooperative — downloads for later checkpoints interleave).  Runs the
+    TPU signature pre-verification for the whole checkpoint before the
+    first apply (reference: ApplyCheckpointWork; the accel dispatch is the
+    TPU seam)."""
+
+    LEDGERS_PER_CRANK = 8
+
+    def __init__(self, clock: VirtualClock, mgr,
+                 download: GetAndVerifyCheckpointWork, target: int,
+                 network_id: bytes, accel: bool = False,
+                 accel_chunk: int = 8192, stats: Optional[dict] = None):
+        super().__init__(clock, f"apply-{download.checkpoint:08x}",
+                         max_retries=RETRY_NEVER)
+        self.mgr = mgr
+        self.download = download
+        self.target = target
+        self.network_id = network_id
+        self.accel = accel
+        self.accel_chunk = accel_chunk
+        self.stats = stats if stats is not None else {}
+        self._idx = 0
+        self._preverified = False
+        self.error_detail = None
+
+    def _fail(self, detail: str) -> State:
+        self.error_detail = detail
+        log.error("%s: %s", self.name, detail)
+        return State.FAILURE
+
+    def on_run(self) -> State:
+        mgr = self.mgr
+        headers = self.download.headers
+        if self.accel and not self._preverified:
+            self._preverified = True
+            st = preverify_checkpoint_signatures(
+                self.network_id, list(self.download.txs.values()),
+                self.accel_chunk, ledger_state=mgr.root)
+            self.stats["sigs_total"] = \
+                self.stats.get("sigs_total", 0) + st["total"]
+            self.stats["sigs_shipped"] = \
+                self.stats.get("sigs_shipped", 0) + st["shipped"]
+            return State.RUNNING
+        applied = 0
+        while self._idx < len(headers) and applied < self.LEDGERS_PER_CRANK:
+            entry = headers[self._idx]
+            seq = entry.header.ledgerSeq
+            if seq <= mgr.last_closed_ledger_seq:
+                self._idx += 1
+                continue
+            if seq > self.target:
+                return State.SUCCESS
+            if seq != mgr.last_closed_ledger_seq + 1:
+                return self._fail(f"gap in headers at {seq}")
+            the = self.download.txs.get(seq)
+            tx_set = the.txSet if the is not None else X.TransactionSet(
+                previousLedgerHash=mgr.lcl_hash, txs=[])
+            if sha256(tx_set.to_xdr()) != entry.header.scpValue.txSetHash:
+                return self._fail(f"tx set hash mismatch at ledger {seq}")
+            frames = [TransactionFrame.make_from_wire(self.network_id, env)
+                      for env in tx_set.txs]
+            try:
+                mgr.close_ledger(frames, entry.header.scpValue.closeTime,
+                                 tx_set=tx_set,
+                                 expected_ledger_hash=entry.hash,
+                                 stellar_value=entry.header.scpValue)
+            except Exception as e:
+                return self._fail(f"apply failed at ledger {seq}: {e}")
+            self._idx += 1
+            applied += 1
+        if self._idx >= len(headers) \
+                or mgr.last_closed_ledger_seq >= self.target:
+            return State.SUCCESS
+        return State.RUNNING
+
+
+class CatchupWork(Work):
+    """Pipelined complete-replay catchup: downloads run `lookahead`
+    checkpoints ahead of the sequential apply cursor (reference:
+    CatchupWork + DownloadApplyTxsWork's download-ahead of one checkpoint
+    while the previous applies)."""
+
+    def __init__(self, clock: VirtualClock, mgr, archive: FileHistoryArchive,
+                 target: int, network_id: bytes, accel: bool = False,
+                 accel_chunk: int = 8192, lookahead: int = 2,
+                 stats: Optional[dict] = None):
+        super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
+        self.mgr = mgr
+        self.archive = archive
+        self.target = target
+        self.network_id = network_id
+        self.accel = accel
+        self.accel_chunk = accel_chunk
+        self.lookahead = max(1, lookahead)
+        self.stats = stats if stats is not None else {}
+        self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
+        self._apply: Optional[ApplyCheckpointWork] = None
+        self._apply_checkpoint = 0
+        self._prev_tail: Optional[X.LedgerHeaderHistoryEntry] = None
+        self.error_detail = None
+
+    def on_reset(self) -> None:
+        super().on_reset()
+        self._downloads = {}
+        self._apply = None
+        self._apply_checkpoint = checkpoint_containing(2)
+        self._prev_tail = None
+
+    def on_run(self) -> State:
+        if self.mgr.last_closed_ledger_seq >= self.target:
+            return State.SUCCESS
+        # keep the download window full (never past the target checkpoint)
+        cp = self._apply_checkpoint
+        last_cp = checkpoint_containing(self.target)
+        for k in range(self.lookahead):
+            c = cp + k * CHECKPOINT_FREQUENCY
+            if c > last_cp:
+                break
+            if c not in self._downloads:
+                w = GetAndVerifyCheckpointWork(self.clock, self.archive, c)
+                self._downloads[c] = w
+                self.add_work(w)
+        dl = self._downloads.get(cp)
+        if dl is None or not dl.done:
+            return State.WAITING
+        if dl.failed:
+            self.error_detail = f"checkpoint {cp} download unrecoverable"
+            log.error("catchup: %s", self.error_detail)
+            return State.FAILURE
+        # cross-checkpoint chain continuity
+        if self._apply is None:
+            if self._prev_tail is not None and dl.headers and \
+                    dl.headers[0].header.previousLedgerHash \
+                    != self._prev_tail.hash:
+                self.error_detail = f"chain broken across checkpoint {cp}"
+                log.error("catchup: %s", self.error_detail)
+                return State.FAILURE
+            self._apply = ApplyCheckpointWork(
+                self.clock, self.mgr, dl, self.target, self.network_id,
+                self.accel, self.accel_chunk, self.stats)
+            self.add_work(self._apply)
+            return State.WAITING
+        if not self._apply.done:
+            return State.WAITING
+        if self._apply.failed:
+            self.error_detail = self._apply.error_detail \
+                or f"apply of checkpoint {cp} failed"
+            return State.FAILURE
+        if dl.headers:
+            self._prev_tail = dl.headers[-1]
+        del self._downloads[cp]
+        self._apply = None
+        self._apply_checkpoint = cp + CHECKPOINT_FREQUENCY
+        if self.mgr.last_closed_ledger_seq >= self.target:
+            return State.SUCCESS
+        return State.RUNNING

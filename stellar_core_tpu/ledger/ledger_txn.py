@@ -141,11 +141,11 @@ class LedgerTxn(AbstractLedgerTxnParent):
 
     # -- entry operations ----------------------------------------------------
     def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
-        """Copy-out load (deep, via XDR round-trip — struct .copy() is
-        shallow); mutate the copy then put() it back."""
+        """Copy-out load (deep — struct .copy() is shallow); mutate the
+        copy then put() it back."""
         self._assert_open_no_child()
         e = self.get_entry(key.to_xdr())
-        return LedgerEntry.from_xdr(e.to_xdr()) if e is not None else None
+        return e.deep_copy() if e is not None else None
 
     def exists(self, key: LedgerKey) -> bool:
         self._assert_open_no_child()

@@ -39,8 +39,12 @@ def build_tx(network_id: bytes, source: SecretKey, seq_num: int,
              ops: Sequence[X.Operation], fee: Optional[int] = None,
              memo: Optional[X.Memo] = None,
              time_bounds: Optional[X.TimeBounds] = None,
-             extra_signers: Sequence[SecretKey] = ()) -> TransactionFrame:
-    """Build + sign a v1 envelope (reference: TxTests — transactionFromOps)."""
+             extra_signers: Sequence[SecretKey] = (),
+             signers: Optional[Sequence[SecretKey]] = None
+             ) -> TransactionFrame:
+    """Build + sign a v1 envelope (reference: TxTests — transactionFromOps).
+    `signers` overrides the signing set entirely (e.g. a multisig tx signed
+    only by an added signer, not the master key)."""
     tx = X.Transaction(
         sourceAccount=X.MuxedAccount.ed25519(source.public_key.ed25519),
         fee=fee if fee is not None else 100 * len(ops),
@@ -53,7 +57,9 @@ def build_tx(network_id: bytes, source: SecretKey, seq_num: int,
         X.TransactionV1Envelope(tx=tx, signatures=[]))
     frame = TransactionFrame(network_id, env)
     payload_hash = frame.content_hash()
-    for signer in (source, *extra_signers):
+    signing_set = (tuple(signers) if signers is not None
+                   else (source, *extra_signers))
+    for signer in signing_set:
         env.value.signatures.append(X.DecoratedSignature(
             hint=signer.public_key.hint(),
             signature=signer.sign(payload_hash)))

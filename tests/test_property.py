@@ -1,0 +1,127 @@
+"""Property-based / fuzz tests (hypothesis).
+
+Reference test model: src/test/FuzzerImpl (decoder fuzz: arbitrary bytes
+must never crash, only reject) and the reference's rounding-direction
+guarantees in OfferExchange (ExchangeTests property assertions).
+"""
+
+from fractions import Fraction
+
+from hypothesis import given, settings, strategies as st
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import PublicKey, SecretKey
+from stellar_core_tpu.transactions.offer_exchange import (
+    ROUND_NORMAL, ROUND_PATH_STRICT_SEND, adjust_offer, exchange_v10)
+
+INT64_MAX = 2**63 - 1
+
+
+# ---------------------------------------------------------------------------
+# decoder fuzz: arbitrary bytes never crash, only XdrError
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(max_size=512))
+def test_xdr_decoders_never_crash(data):
+    for cls in (X.TransactionEnvelope, X.LedgerEntry, X.LedgerKey,
+                X.SCPEnvelope, X.StellarMessage, X.LedgerHeader,
+                X.AuthenticatedMessage):
+        try:
+            cls.from_xdr(data)
+        except X.XdrError:
+            pass  # rejection is the only acceptable failure
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(min_size=32, max_size=32), st.integers(0, 6))
+def test_xdr_bitflip_roundtrip_stability(seed, flip_byte):
+    """Encode a valid envelope, flip a byte, decode: either rejects or
+    yields a value that re-encodes deterministically (no crash, no
+    round-trip instability)."""
+    sk = SecretKey(seed)
+    env = X.TransactionEnvelope.v1(X.TransactionV1Envelope(
+        tx=X.Transaction(
+            sourceAccount=X.MuxedAccount.ed25519(sk.public_key.ed25519),
+            fee=100, seqNum=7, cond=X.Preconditions.none(),
+            memo=X.Memo.none(), operations=[]),
+        signatures=[]))
+    raw = bytearray(env.to_xdr())
+    raw[flip_byte % len(raw)] ^= 0xFF
+    try:
+        decoded = X.TransactionEnvelope.from_xdr(bytes(raw))
+    except X.XdrError:
+        return
+    assert X.TransactionEnvelope.from_xdr(decoded.to_xdr()) == decoded
+
+
+# ---------------------------------------------------------------------------
+# strkey properties
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(min_size=32, max_size=32))
+def test_strkey_roundtrip(raw):
+    s = PublicKey(raw).to_strkey()
+    assert PublicKey.from_strkey(s).ed25519 == raw
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(min_size=32, max_size=32), st.integers(0, 55))
+def test_strkey_single_char_corruption_rejected(raw, pos):
+    s = PublicKey(raw).to_strkey()
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ234567"
+    c = s[pos % len(s)]
+    repl = alphabet[(alphabet.index(c) + 1) % 32] if c in alphabet else "A"
+    corrupted = s[:pos % len(s)] + repl + s[pos % len(s) + 1:]
+    if corrupted == s:
+        return
+    try:
+        got = PublicKey.from_strkey(corrupted)
+        # CRC16 catches all single-symbol corruptions of the payload
+        assert False, f"corrupted strkey accepted: {corrupted}"
+    except ValueError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# exchangeV10 rounding-direction properties (consensus-critical)
+
+amounts = st.integers(0, 10**15)
+prices = st.integers(1, 10**7)
+
+
+@settings(max_examples=500, deadline=None)
+@given(amounts, amounts, amounts, amounts, prices, prices)
+def test_exchange_v10_invariants(mws, mwr, mss, msr, pn, pd):
+    p = X.Price(n=pn, d=pd)
+    r = exchange_v10(p, mws, mwr, mss, msr, ROUND_NORMAL)
+    # caps respected
+    assert 0 <= r.num_wheat_received <= min(mws, mwr)
+    assert 0 <= r.num_sheep_send <= mss
+    # rounding always favors the resting offer: realized price >= offer
+    # price (taker never underpays), unless the exchange was cancelled
+    if r.num_wheat_received > 0:
+        assert Fraction(r.num_sheep_send, r.num_wheat_received) \
+            >= Fraction(pn, pd)
+    # no taking sheep for zero wheat
+    if r.num_wheat_received == 0:
+        assert r.num_sheep_send == 0
+
+
+@settings(max_examples=300, deadline=None)
+@given(amounts, amounts, prices, prices)
+def test_exchange_strict_send_sends_exactly(mws, mss, pn, pd):
+    p = X.Price(n=pn, d=pd)
+    r = exchange_v10(p, mws, INT64_MAX, mss, INT64_MAX,
+                     ROUND_PATH_STRICT_SEND)
+    if r.wheat_stays and r.num_wheat_received > 0:
+        assert r.num_sheep_send == mss
+
+
+@settings(max_examples=300, deadline=None)
+@given(amounts, amounts, prices, prices)
+def test_adjust_offer_idempotent(amount, cap, pn, pd):
+    """adjustOffer(adjustOffer(x)) == adjustOffer(x) (the reference relies
+    on this: adjusted offers rest on the book unmodified)."""
+    p = X.Price(n=pn, d=pd)
+    once = adjust_offer(p, amount, cap)
+    assert adjust_offer(p, once, cap) == once
