@@ -24,6 +24,7 @@ from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP, EnvelopeState
 from ..util import logging as slog
 from ..util.clock import VirtualClock, VirtualTimer
+from ..util.metrics import registry as _registry
 from .pending_envelopes import (ENVELOPE_STATUS_DISCARDED,
                                 ENVELOPE_STATUS_FETCHING,
                                 ENVELOPE_STATUS_PROCESSED,
@@ -127,6 +128,7 @@ class Herder(SCPDriver):
             return ENVELOPE_STATUS_DISCARDED
         if not self.verify_envelope(env):
             return ENVELOPE_STATUS_DISCARDED
+        _registry().meter("scp.envelope.receive").mark()
         status = self.pending.recv_envelope(env)
         if status == ENVELOPE_STATUS_READY:
             self._process_scp_queue()
@@ -416,6 +418,7 @@ class Herder(SCPDriver):
             arts = self.lm.close_ledger(frames, sv.closeTime, tx_set=txset,
                                         stellar_value=sv)
             self.state = HerderState.TRACKING
+            _registry().meter("herder.ledger.externalize").mark()
             self._persist_scp_state(nxt, sv, txset)
             self.ledger_closed_hook(arts)
             self.tx_queue.remove_applied(frames)

@@ -324,11 +324,85 @@ class QuorumIntersectionChecker:
         return out
 
 
+def _try_symmetric_org_contraction(qmap: Dict[NodeIDb, object]
+                                   ) -> Optional[QuorumIntersectionResult]:
+    """Tier-1-shaped fast path: when EVERY node shares one identical qset
+    of the form `t of k disjoint flat inner sets (orgs) covering exactly
+    the node set`, the validator-level question contracts to the org level
+    (the symmetric-cluster contraction from the FBAS analysis literature;
+    the real pubnet tier-1 has exactly this shape).
+
+    Soundness: all nodes share qset Q, so U is a quorum iff the orgs
+    satisfied by U (>= thr_o members present) satisfy Q's outer threshold.
+    - If the org-level projection (k flat nodes, threshold t) enjoys
+      intersection, any two quorums share an org o; with 2*thr_o > n_o two
+      thr_o-subsets of o must overlap, so the quorums intersect.
+    - If the org-level projection splits, taking thr_o members per org on
+      each side yields two disjoint validator-level quorums.
+    Requires 2*thr_o > n_o for every org; returns None (fall back to full
+    enumeration) when any condition fails."""
+    values = list(qmap.values())
+    if not values or any(q is None for q in values):
+        return None  # nodes with unknown qsets: full checker handles them
+    first = values[0]
+    first_xdr = first.to_xdr()
+    if any(q.to_xdr() != first_xdr for q in values[1:]):
+        return None
+    if first.validators or not first.innerSets:
+        return None
+    orgs: List[Tuple[int, List[NodeIDb]]] = []
+    seen: Set[NodeIDb] = set()
+    for inner in first.innerSets:
+        if inner.innerSets or not inner.validators:
+            return None
+        members = [v.value for v in inner.validators]
+        if len(set(members)) != len(members):
+            return None  # duplicate members within an org
+        if any(m in seen or m not in qmap for m in members):
+            return None
+        seen.update(members)
+        if not 0 < inner.threshold <= len(members):
+            return None  # unsatisfiable / degenerate org
+        if 2 * inner.threshold <= len(members):
+            return None  # two minimal org picks may not overlap
+        orgs.append((inner.threshold, members))
+    if seen != set(qmap):
+        return None
+
+    # the projection is always flat `t of k orgs` here (guaranteed by the
+    # shape checks above), so org-level intersection has a closed form:
+    # two org quorums of size >= t overlap iff 2t > k
+    k = len(orgs)
+    t = first.threshold
+    if not 1 <= t <= k:
+        return None
+    if 2 * t > k:
+        return QuorumIntersectionResult(
+            True, node_count=len(qmap), main_scc_size=len(qmap))
+    # split witness: thr_o members from each of the first t orgs vs the
+    # last t orgs (disjoint because 2t <= k)
+    side_a: List[NodeIDb] = []
+    side_b: List[NodeIDb] = []
+    for thr, members in orgs[:t]:
+        side_a.extend(members[:thr])
+    for thr, members in orgs[k - t:]:
+        side_b.extend(members[:thr])
+    return QuorumIntersectionResult(
+        False, split=(side_a, side_b), node_count=len(qmap),
+        main_scc_size=len(qmap))
+
+
 def check_intersection(qmap: Dict[NodeIDb, object],
                        interrupt: Optional[Callable[[], bool]] = None
                        ) -> QuorumIntersectionResult:
     """Convenience one-shot API (reference: QuorumIntersectionChecker::
-    create(...)->networkEnumerateAndCheckMinQuorums())."""
+    create(...)->networkEnumerateAndCheckMinQuorums()).  Applies the
+    symmetric-org contraction when the topology allows (pubnet tier-1
+    shape: the exact enumeration is exponential in orgs; the contraction
+    answers at org granularity), falling back to full branch-and-bound."""
+    contracted = _try_symmetric_org_contraction(qmap)
+    if contracted is not None:
+        return contracted
     return QuorumIntersectionChecker(qmap, interrupt).check()
 
 

@@ -15,6 +15,7 @@ Close pipeline per ledger (same phases as the reference):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -100,6 +101,8 @@ class LedgerManager:
         self.invariants = invariant_manager
         self.db = None           # database.Database when persistence is on
         self.bucket_dir = None   # bucket.manager.BucketDir
+        # observability (reference: METADATA_OUTPUT_STREAM + medida timers)
+        self.meta_stream = None  # callable(LedgerCloseMeta) or file-like
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -166,6 +169,9 @@ class LedgerManager:
         upgrades, applied after the tx phase — reference:
         LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
         assert self.root is not None, "start_new_ledger/load first"
+        from ..util.metrics import registry
+        _close_timer = registry().timer("ledger.ledger.close")
+        _t0 = time.perf_counter()
         if tx_set is None:
             tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
         else:
@@ -276,7 +282,31 @@ class LedgerManager:
         tx_entry = X.TransactionHistoryEntry(ledgerSeq=seq, txSet=tx_set)
         result_entry = X.TransactionHistoryResultEntry(
             ledgerSeq=seq, txResultSet=result_set)
+
+        _close_timer.update(time.perf_counter() - _t0)
+        registry().meter("ledger.transaction.apply").mark(len(ordered))
+        if self.meta_stream is not None:
+            self._emit_close_meta(header_entry, tx_set, result_pairs)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)
+
+    def _emit_close_meta(self, header_entry, tx_set, result_pairs) -> None:
+        """Emit LedgerCloseMeta v0 (reference: METADATA_OUTPUT_STREAM —
+        one length-prefixed XDR frame per close)."""
+        meta = X.LedgerCloseMeta.v0(X.LedgerCloseMetaV0(
+            ledgerHeader=header_entry,
+            txSet=tx_set,
+            txProcessing=[X.TransactionResultMeta(
+                result=p, feeProcessing=b"", txApplyProcessing=b"")
+                for p in result_pairs],
+            upgradesProcessing=[],
+            scpInfo=[]))
+        out = self.meta_stream
+        if callable(out):
+            out(meta)
+        else:
+            raw = meta.to_xdr()
+            out.write(len(raw).to_bytes(4, "big") + raw)
+            out.flush()
 
     # -- durable persistence -------------------------------------------------
     def enable_persistence(self, database, bucket_dir) -> None:

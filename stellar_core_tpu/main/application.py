@@ -91,6 +91,8 @@ class Application:
                 spec.put_path or spec.get_path))
         self.history = HistoryManager(self.lm, config.NETWORK_PASSPHRASE,
                                       archives, database=self.database)
+        if config.METADATA_OUTPUT_STREAM:
+            self.lm.meta_stream = open(config.METADATA_OUTPUT_STREAM, "ab")
         self.herder.ledger_closed_hook = self._on_ledger_closed
         self.catchup = CatchupManager(
             self.network_id, config.NETWORK_PASSPHRASE,
@@ -164,6 +166,10 @@ class Application:
 
     def stop(self) -> None:
         self._stopped = True
+        if self.lm.meta_stream is not None \
+                and not callable(self.lm.meta_stream):
+            self.lm.meta_stream.close()
+            self.lm.meta_stream = None
         if self.http is not None:
             self.http.stop()
         if self.transport is not None:
@@ -194,7 +200,9 @@ class Application:
         }
 
     def metrics(self) -> dict:
+        from ..util.metrics import registry
         return {
+            "registry": registry().snapshot(),
             "overlay": dict(self.overlay.stats),
             "herder": {
                 "state": self.herder.get_state_human(),

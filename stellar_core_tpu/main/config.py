@@ -48,6 +48,7 @@ class Config:
     HISTORY: List[HistoryArchiveConfig] = field(default_factory=list)
 
     ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
+    METADATA_OUTPUT_STREAM: str = ""         # path for LedgerCloseMeta frames
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
@@ -91,6 +92,7 @@ class Config:
             "KNOWN_PEERS", "TARGET_PEER_CONNECTIONS", "DATABASE",
             "BUCKET_DIR_PATH", "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
+            "METADATA_OUTPUT_STREAM",
             "ACCEL_CHUNK_SIZE", "LOG_LEVEL",
         }
         for key, val in raw.items():

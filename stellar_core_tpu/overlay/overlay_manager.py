@@ -17,6 +17,7 @@ from .. import xdr as X
 from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..util import logging as slog
+from ..util.metrics import registry as _registry
 from .flood import Floodgate, ItemFetcher, TxAdverts
 from .peer import Peer
 from .peer_auth import PeerAuth
@@ -96,6 +97,7 @@ class OverlayManager:
         self.fetcher.peer_available(peer, self._auth_peer_list())
 
     def _peer_dropped(self, peer: Peer) -> None:
+        _registry().counter("overlay.peer.drop").inc()
         self.stats["dropped_peers"] += 1
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
@@ -133,6 +135,7 @@ class OverlayManager:
                 peer.send_message(msg)
                 self.floodgate.note_told(msg_hash, peer)
                 self.stats["flooded"] += 1
+                _registry().meter("overlay.message.flood").mark()
 
     def _send_advert(self, peer: Peer, hashes: List[bytes]) -> None:
         peer.send_message(X.StellarMessage.floodAdvert(

@@ -1,0 +1,139 @@
+"""Metrics registry: counters, meters and timers, medida-style.
+
+Reference: lib/libmedida as used throughout the reference
+(`app.getMetrics().NewTimer({"ledger", "ledger", "close"})`, CommandHandler
+/metrics endpoint).  Names are dotted strings ("ledger.ledger.close");
+`registry().snapshot()` is the /metrics JSON surface.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "count": self.value}
+
+
+class Meter:
+    """Event rate: count + events/sec over the process lifetime and a
+    recent window (medida meters' 1m rate approximated by a sliding
+    window)."""
+    __slots__ = ("count", "_t0", "_win_start", "_win_count", "_last_rate")
+
+    WINDOW = 60.0
+
+    def __init__(self) -> None:
+        self.count = 0
+        self._t0 = time.monotonic()
+        self._win_start = self._t0
+        self._win_count = 0
+        self._last_rate = 0.0
+
+    def mark(self, n: int = 1) -> None:
+        self.count += n
+        self._win_count += n
+        now = time.monotonic()
+        if now - self._win_start >= self.WINDOW:
+            self._last_rate = self._win_count / (now - self._win_start)
+            self._win_start = now
+            self._win_count = 0
+
+    def snapshot(self) -> dict:
+        lifetime = time.monotonic() - self._t0
+        return {"type": "meter", "count": self.count,
+                "mean_rate": round(self.count / lifetime, 3)
+                if lifetime > 0 else 0.0,
+                "recent_rate": round(self._last_rate, 3)}
+
+
+class Timer:
+    __slots__ = ("count", "total", "max", "min")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def update(self, dt: float) -> None:
+        self.count += 1
+        self.total += dt
+        if dt > self.max:
+            self.max = dt
+        if dt < self.min:
+            self.min = dt
+
+    def time(self):
+        return _TimerCtx(self)
+
+    def snapshot(self) -> dict:
+        return {"type": "timer", "count": self.count,
+                "mean_s": round(self.total / self.count, 6)
+                if self.count else 0.0,
+                "max_s": round(self.max, 6),
+                "min_s": round(self.min, 6) if self.count else 0.0}
+
+
+class _TimerCtx:
+    __slots__ = ("_timer", "_t0")
+
+    def __init__(self, t: Timer):
+        self._timer = t
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.update(time.perf_counter() - self._t0)
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls()
+        assert isinstance(m, cls), f"{name} already a {type(m).__name__}"
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, dict]:
+        return {k: m.snapshot() for k, m in sorted(self._metrics.items())
+                if prefix is None or k.startswith(prefix)}
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (reference: medida::MetricsRegistry owned
+    by the Application; module-global here because LedgerManager and friends
+    are constructible without an Application)."""
+    return _registry
+
+
+def reset_registry() -> None:
+    global _registry
+    _registry = MetricsRegistry()

@@ -1,0 +1,50 @@
+"""Perf tracing: slow-execution logging + JAX profiler hook.
+
+Reference: src/util/LogSlowExecution.{h,cpp} (warn when a scope exceeds a
+threshold) and the Perf log partition.  Timing data itself lands in the
+util.metrics registry (one timer surface); this module adds the
+slow-threshold warning and the device profiler wrapper.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from . import logging as slog
+
+log = slog.get("Perf")
+
+DEFAULT_SLOW_THRESHOLD = 1.0  # seconds (reference: LogSlowExecution 1s)
+
+
+@contextlib.contextmanager
+def scoped_timer(name: str,
+                 slow_threshold: Optional[float] = DEFAULT_SLOW_THRESHOLD):
+    """Time a scope into the metrics registry's timer of the same name
+    (ONE timer surface — util.metrics) and warn when the scope ran slow
+    (reference: LogSlowExecution dtor + medida Timer::Update)."""
+    from .metrics import registry
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        registry().timer(name).update(dt)
+        if slow_threshold is not None and dt > slow_threshold:
+            log.warning("'%s' took %.3fs (threshold %.3fs)",
+                        name, dt, slow_threshold)
+
+
+@contextlib.contextmanager
+def jax_profile(log_dir: str):
+    """Device-level profiler trace around a scope (the TPU analog of the
+    reference's perf instrumentation); no-op if JAX is unavailable."""
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

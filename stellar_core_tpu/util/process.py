@@ -1,0 +1,125 @@
+"""ProcessManager: async subprocess execution on the clock loop.
+
+Reference: src/process/ProcessManager{,Impl}.{h,cpp} — runCommand returning
+a ProcessExitEvent whose completion posts back onto the main loop; bounded
+concurrency (MAX_CONCURRENT_SUBPROCESSES); kill-on-shutdown.  The reference
+uses it for history get/put command templates (curl, gzip, aws cp); here
+the same surface drives external archive commands.
+
+Implementation: subprocess.Popen polled from a clock IO pump — no threads,
+completion callbacks fire inside crank like every other event.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+from typing import Callable, Deque, List, Optional
+from collections import deque
+
+from . import logging as slog
+from .clock import VirtualClock
+
+log = slog.get("Process")
+
+MAX_CONCURRENT_SUBPROCESSES = 8
+
+
+class ProcessExitEvent:
+    """Handle for one running (or queued) command."""
+
+    def __init__(self, cmdline: str,
+                 on_exit: Callable[[int], None]):
+        self.cmdline = cmdline
+        self.on_exit = on_exit
+        self.proc: Optional[subprocess.Popen] = None
+        self.exit_code: Optional[int] = None
+        self.cancelled = False
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.exit_code is None
+
+    @property
+    def done(self) -> bool:
+        return self.exit_code is not None
+
+
+class ProcessManager:
+    def __init__(self, clock: VirtualClock,
+                 max_concurrent: int = MAX_CONCURRENT_SUBPROCESSES):
+        self.clock = clock
+        self.max_concurrent = max_concurrent
+        self._running: List[ProcessExitEvent] = []
+        self._pending: Deque[ProcessExitEvent] = deque()
+        self._shutdown = False
+        clock.add_io_pump(self._pump)
+
+    def run_command(self, cmdline: str,
+                    on_exit: Callable[[int], None]) -> ProcessExitEvent:
+        """Queue a shell-less command; on_exit(code) fires on the clock loop
+        (reference: ProcessManagerImpl::runProcess)."""
+        ev = ProcessExitEvent(cmdline, on_exit)
+        self._pending.append(ev)
+        self._maybe_start()
+        return ev
+
+    def cancel(self, ev: ProcessExitEvent) -> None:
+        ev.cancelled = True
+        if ev in self._pending:
+            self._pending.remove(ev)
+            ev.exit_code = -1
+            return
+        if ev.proc is not None and ev.exit_code is None:
+            ev.proc.kill()
+
+    def _maybe_start(self) -> None:
+        while (not self._shutdown and self._pending
+               and len(self._running) < self.max_concurrent):
+            ev = self._pending.popleft()
+            try:
+                ev.proc = subprocess.Popen(
+                    shlex.split(ev.cmdline),
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
+            except OSError as e:
+                log.warning("spawn failed: %s (%s)", ev.cmdline, e)
+                ev.exit_code = 127
+                self.clock.post_action(lambda ev=ev: ev.on_exit(127),
+                                       name="process-exit")
+                continue
+            self._running.append(ev)
+
+    def _pump(self) -> int:
+        progressed = 0
+        for ev in list(self._running):
+            code = ev.proc.poll()
+            if code is None:
+                continue
+            ev.exit_code = code
+            self._running.remove(ev)
+            progressed += 1
+            if not ev.cancelled:
+                self.clock.post_action(lambda ev=ev, c=code: ev.on_exit(c),
+                                       name="process-exit")
+        if progressed:
+            self._maybe_start()
+        return progressed
+
+    def shutdown(self) -> None:
+        """Kill everything (reference: ProcessManagerImpl::shutdown)."""
+        self._shutdown = True
+        self.clock.remove_io_pump(self._pump)
+        for ev in self._pending:
+            ev.exit_code = -1
+        self._pending.clear()
+        for ev in self._running:
+            if ev.proc is not None and ev.exit_code is None:
+                ev.proc.kill()
+                ev.proc.wait()
+                ev.exit_code = ev.proc.returncode
+        self._running.clear()
+
+    @property
+    def num_running(self) -> int:
+        return len(self._running)

@@ -178,3 +178,60 @@ class TestFlatten:
         deep = qset(1, inner=[qset(1, inner=[qset(1, [a])])])
         with pytest.raises(ValueError):
             flatten_qmap({a: deep, b: deep})
+
+
+class TestSymmetricOrgContraction:
+    """Tier-1-shaped maps contract to the org level (the exact enumerator
+    is exponential in orgs; pubnet's real shape must answer in ms)."""
+
+    def _tier1(self, n_orgs, per_org=3, outer=None, inner_thr=2):
+        from stellar_core_tpu import xdr as X
+        ids = [bytes([o + 1]) * 31 + bytes([v])
+               for o in range(n_orgs) for v in range(per_org)]
+        inner = [X.SCPQuorumSet(
+            threshold=inner_thr,
+            validators=[X.NodeID.ed25519(ids[o * per_org + v])
+                        for v in range(per_org)],
+            innerSets=[]) for o in range(n_orgs)]
+        q = X.SCPQuorumSet(
+            threshold=outer if outer else (2 * n_orgs + 2) // 3,
+            validators=[], innerSets=inner)
+        return {n: q for n in ids}, ids
+
+    def test_tier1_scale_intersects_fast(self):
+        import time
+        for n in (9, 24):
+            qmap, _ = self._tier1(n)
+            t0 = time.perf_counter()
+            res = check_intersection(qmap)
+            assert res.intersects
+            assert time.perf_counter() - t0 < 1.0
+
+    def test_tier1_split_witness_is_real(self):
+        qmap, _ = self._tier1(9, outer=3)
+        res = check_intersection(qmap)
+        assert not res.intersects
+        a, b = res.split
+        assert not (set(a) & set(b))
+        # each side really is a quorum: contains >= 2 members of >= 3 orgs
+        for side in (a, b):
+            orgs_hit = {}
+            for n in side:
+                orgs_hit.setdefault(n[0], set()).add(n)
+            assert sum(1 for v in orgs_hit.values() if len(v) >= 2) >= 3
+
+    def test_weak_inner_threshold_falls_back_to_enumeration(self):
+        # 1-of-3 orgs: two quorums sharing an org can pick disjoint
+        # members, so contraction must NOT claim intersection
+        qmap, _ = self._tier1(4, inner_thr=1, outer=3)
+        res = check_intersection(qmap)
+        assert not res.intersects
+
+    def test_agrees_with_enumeration_at_small_scale(self):
+        for n_orgs, outer, expect in ((3, 2, True), (4, 2, False),
+                                      (4, 3, True), (5, 3, True)):
+            qmap, _ = self._tier1(n_orgs, outer=outer)
+            fast = check_intersection(qmap)
+            slow = QuorumIntersectionChecker(qmap).check()
+            assert fast.intersects == slow.intersects == expect, \
+                (n_orgs, outer)

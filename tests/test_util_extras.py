@@ -1,0 +1,164 @@
+"""ProcessManager / perf tracing / Fs / metrics / LedgerCloseMeta tests.
+
+Reference test model: src/process/test/ProcessTests.cpp,
+src/util/test (Fs, TmpDir), medida usage tests, LedgerCloseMetaStream
+tests.
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.util import fs, metrics, perf
+from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+from stellar_core_tpu.util.process import ProcessManager
+
+
+class TestProcessManager:
+    def test_run_command_exit_codes_on_clock_loop(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        results = []
+        pm.run_command("true", lambda code: results.append(("true", code)))
+        pm.run_command("false", lambda code: results.append(("false", code)))
+        ok = clock.crank_until(lambda: len(results) == 2, timeout=10)
+        assert ok and dict(results) == {"true": 0, "false": 1}
+        pm.shutdown()
+
+    def test_spawn_failure_reports_127(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        results = []
+        pm.run_command("/definitely/not/a/binary",
+                       lambda code: results.append(code))
+        assert clock.crank_until(lambda: results == [127], timeout=5)
+        pm.shutdown()
+
+    def test_concurrency_bound_and_queueing(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock, max_concurrent=2)
+        results = []
+        for i in range(5):
+            pm.run_command("sleep 0.05", lambda code: results.append(code))
+        assert pm.num_running <= 2
+        assert clock.crank_until(lambda: len(results) == 5, timeout=15)
+        assert results == [0] * 5
+        pm.shutdown()
+
+    def test_shutdown_kills_running(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        ev = pm.run_command("sleep 30", lambda code: None)
+        assert clock.crank_until(lambda: ev.running, timeout=5)
+        pm.shutdown()
+        assert ev.done and ev.exit_code != 0
+
+
+class TestPerf:
+    def test_scoped_timer_feeds_metrics_registry(self):
+        metrics.reset_registry()
+        with perf.scoped_timer("unit-test-scope", slow_threshold=None):
+            pass
+        with perf.scoped_timer("unit-test-scope", slow_threshold=None):
+            pass
+        snap = metrics.registry().snapshot()["unit-test-scope"]
+        assert snap["count"] == 2 and snap["max_s"] >= 0
+
+    def test_slow_scope_warns(self, caplog):
+        import logging as pylog
+        with caplog.at_level(pylog.WARNING, logger="stellar.Perf"):
+            with perf.scoped_timer("slow-scope", slow_threshold=0.0):
+                pass
+        assert any("slow-scope" in r.message for r in caplog.records)
+
+
+class TestFs:
+    def test_durable_write_and_tmpdir(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        fs.durable_write(p, b"hello")
+        assert open(p, "rb").read() == b"hello"
+        fs.durable_write(p, b"world")          # overwrite is atomic
+        assert open(p, "rb").read() == b"world"
+        with fs.TmpDir(str(tmp_path)) as td:
+            scratch = td.path
+            open(os.path.join(scratch, "x"), "w").write("1")
+        assert not os.path.isdir(scratch)
+
+    def test_lockfile_excludes_second_locker(self, tmp_path):
+        p = str(tmp_path / "db.lock")
+        fd = fs.lock_file(p)
+        with pytest.raises(RuntimeError, match="locked"):
+            fs.lock_file(p)
+        fs.unlock_file(fd)
+        fd2 = fs.lock_file(p)
+        fs.unlock_file(fd2)
+
+
+class TestMetrics:
+    def test_counter_meter_timer(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("a.b.c").inc(3)
+        reg.meter("scp.envelope.receive").mark(5)
+        with reg.timer("ledger.close").time():
+            pass
+        snap = reg.snapshot()
+        assert snap["a.b.c"]["count"] == 3
+        assert snap["scp.envelope.receive"]["count"] == 5
+        assert snap["ledger.close"]["count"] == 1
+        pref = reg.snapshot(prefix="scp.")
+        assert list(pref) == ["scp.envelope.receive"]
+        assert pref["scp.envelope.receive"]["count"] == 5
+
+    def test_ledger_close_feeds_registry(self):
+        from stellar_core_tpu.ledger.manager import LedgerManager
+        from stellar_core_tpu.testutils import (TestAccount,
+                                                create_account_op,
+                                                network_id)
+        from stellar_core_tpu.crypto.keys import SecretKey
+        metrics.reset_registry()
+        m = LedgerManager(network_id("metrics net"))
+        m.start_new_ledger()
+        sk = m.root_account_secret()
+        e = m.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(m, sk, e.data.value.seqNum)
+        m.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(SecretKey(b"\x42" * 32).public_key.ed25519),
+            10**10)])], 1000)
+        snap = metrics.registry().snapshot()
+        assert snap["ledger.ledger.close"]["count"] == 1
+        assert snap["ledger.transaction.apply"]["count"] == 1
+
+
+class TestLedgerCloseMeta:
+    def test_meta_stream_emits_frames(self, tmp_path):
+        from stellar_core_tpu.ledger.manager import LedgerManager
+        from stellar_core_tpu.testutils import (TestAccount,
+                                                create_account_op,
+                                                network_id)
+        from stellar_core_tpu.crypto.keys import SecretKey
+        m = LedgerManager(network_id("meta net"))
+        m.start_new_ledger()
+        path = str(tmp_path / "meta.xdr")
+        m.meta_stream = open(path, "ab")
+        sk = m.root_account_secret()
+        e = m.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(m, sk, e.data.value.seqNum)
+        arts = m.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(SecretKey(b"\x43" * 32).public_key.ed25519),
+            10**10)])], 1000)
+        m.close_ledger([], 1001)
+        m.meta_stream.close()
+        raw = open(path, "rb").read()
+        metas = []
+        off = 0
+        while off < len(raw):
+            n = int.from_bytes(raw[off:off + 4], "big")
+            metas.append(X.LedgerCloseMeta.from_xdr(raw[off + 4:off + 4 + n]))
+            off += 4 + n
+        assert len(metas) == 2
+        assert metas[0].value.ledgerHeader.hash == arts.header_entry.hash
+        assert len(metas[0].value.txProcessing) == 1
+        assert len(metas[1].value.txProcessing) == 0
