@@ -218,3 +218,19 @@ def liquidity_pool_withdraw_op(pool_id: bytes, amount: int, min_a: int = 0,
                            X.LiquidityPoolWithdrawOp(
                                liquidityPoolID=pool_id, amount=amount,
                                minAmountA=min_a, minAmountB=min_b)))
+
+
+# --- protocol version sweep (reference: src/test/TxTests — for_all_versions)
+
+SUPPORTED_PROTOCOL_RANGE = range(10, 24)   # earliest gated .. current
+
+
+def for_all_versions(network_id: bytes, body, versions=None) -> None:
+    """Run `body(mgr, version)` against a fresh genesis ledger at every
+    protocol level (reference: for_all_versions in TxTests — apply-time
+    behavior must be checked under each gated protocol)."""
+    from .ledger.manager import LedgerManager
+    for version in (versions or SUPPORTED_PROTOCOL_RANGE):
+        mgr = LedgerManager(network_id)
+        mgr.start_new_ledger(protocol_version=version)
+        body(mgr, version)

@@ -2,8 +2,10 @@
 
 Reference: src/transactions/TransactionFrame.{h,cpp},
 FeeBumpTransactionFrame.{h,cpp}, TransactionFrameBase::makeTransactionFromWire.
-Protocol level: current (23) classic semantics, single protocol path (the
-reference's for_all_versions gates are collapsed; divergences noted inline).
+Protocol level: current (23) classic semantics with version gates at the
+reference's introduction boundaries (muxed accounts + fee bumps v13,
+CAP-21 preconditions v19; per-op gates via MIN_PROTOCOL_VERSION in
+operations.py) — exercised by the for_all_versions test sweep.
 
 Apply pipeline (mirrors §3.2 of SURVEY.md):
   process_fee_seq_num()  — charge fee, consume seqNum (before any op runs)
@@ -132,13 +134,21 @@ class TransactionFrame:
             return C.txMISSING_OPERATION
         if self.num_operations() > X.MAX_OPS_PER_TX:
             return C.txMALFORMED
+        header = ltx.get_header()
+        # version gates run BEFORE validity windows (reference:
+        # commonValidPreSeqNum's txNOT_SUPPORTED checks come first)
+        cond = self._cond()
+        if cond is not None and cond.switch == X.PreconditionType.PRECOND_V2 \
+                and header.ledgerVersion < 19:
+            return C.txNOT_SUPPORTED  # CAP-21 preconditions are v19+
+        if header.ledgerVersion < 13 and self._has_muxed_account():
+            return C.txNOT_SUPPORTED  # M-strkeys (CAP-27) are v13+
         tb = self.time_bounds()
         if tb is not None:
             if tb.minTime and close_time < tb.minTime:
                 return C.txTOO_EARLY
             if tb.maxTime and close_time > tb.maxTime:
                 return C.txTOO_LATE
-        header = ltx.get_header()
         if self.fee_bid < self.min_fee(header):
             return C.txINSUFFICIENT_FEE
         if self.seq_num < 0 or self.seq_num > MAX_SEQ_NUM:
@@ -181,8 +191,32 @@ class TransactionFrame:
         return _tx_result(self.fee_charged(ltx.get_header()),
                           X.TransactionResultCode.txSUCCESS, None)
 
+    def _cond(self):
+        return None if self.is_v0 else self.tx.cond
+
+    def _has_muxed_account(self) -> bool:
+        """Any med25519 MuxedAccount in the envelope (reference:
+        hasMuxedAccount over tx source, op sources and op muxed
+        destinations)."""
+        MUX = X.CryptoKeyType.KEY_TYPE_MUXED_ED25519
+
+        def muxed(acct) -> bool:
+            return acct is not None and acct.switch == MUX
+
+        if muxed(self.tx.sourceAccount):
+            return True
+        for op in self.tx.operations:
+            if muxed(op.sourceAccount):
+                return True
+            b = op.body.value
+            for attr in ("destination", "from_"):
+                v = getattr(b, attr, None)
+                if v is not None and hasattr(v, "switch") and muxed(v):
+                    return True
+        return False
+
     def _check_extra_signers(self, checker: SignatureChecker) -> bool:
-        cond = None if self.is_v0 else self.tx.cond
+        cond = self._cond()
         if cond is not None and cond.switch == X.PreconditionType.PRECOND_V2:
             for sk in cond.value.extraSigners:
                 if not checker.check_signature(
@@ -381,6 +415,9 @@ class FeeBumpTransactionFrame(TransactionFrame):
         C = X.TransactionResultCode
         header = ltx.get_header()
         fee = self.fee_charged(header)
+        if header.ledgerVersion < 13:
+            # fee bumps arrived in protocol 13 (CAP-15)
+            return _tx_result(fee, C.txNOT_SUPPORTED)
         if self.fee_bid < self.min_fee(header):
             return _tx_result(fee, C.txINSUFFICIENT_FEE)
         acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
@@ -402,6 +439,8 @@ class FeeBumpTransactionFrame(TransactionFrame):
         C = X.TransactionResultCode
         header = ltx.get_header()
         fee = self.fee_charged(header)
+        if header.ledgerVersion < 13:
+            return _tx_result(fee, C.txNOT_SUPPORTED)  # CAP-15 is v13+
         checker = SignatureChecker(header.ledgerVersion, self.content_hash(),
                                    self.signatures)
         acc_e = load_account(ltx, self.source_account_id())

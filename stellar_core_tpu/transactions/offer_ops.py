@@ -250,6 +250,7 @@ class ManageBuyOfferOpFrame(_ManageOfferBase):
     inverted and amount = ceil(buyAmount * price.n / price.d) selling
     units; crossing caps wheat received at buyAmount so the buyer never
     over-buys."""
+    MIN_PROTOCOL_VERSION = 11
     OP_TYPE = OT.MANAGE_BUY_OFFER
     RESULT_CLS = X.ManageBuyOfferResult
 
@@ -530,6 +531,7 @@ class PathPaymentStrictReceiveOpFrame(_PathPaymentBase):
 class PathPaymentStrictSendOpFrame(_PathPaymentBase):
     """Reference: src/transactions/PathPaymentStrictSendOpFrame.cpp —
     fixed sendAmount, bounded destMin, path walked source-first."""
+    MIN_PROTOCOL_VERSION = 12
     OP_TYPE = OT.PATH_PAYMENT_STRICT_SEND
     RESULT_CLS = X.PathPaymentStrictSendResult
 
@@ -589,6 +591,7 @@ def _pool_trustline(ltx, account_id, pool_id):
 
 class LiquidityPoolDepositOpFrame(OperationFrame):
     """Reference: src/transactions/LiquidityPoolDepositOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 18
     OP_TYPE = OT.LIQUIDITY_POOL_DEPOSIT
     RESULT_CLS = X.LiquidityPoolDepositResult
 
@@ -685,6 +688,7 @@ class LiquidityPoolDepositOpFrame(OperationFrame):
 
 class LiquidityPoolWithdrawOpFrame(OperationFrame):
     """Reference: src/transactions/LiquidityPoolWithdrawOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 18
     OP_TYPE = OT.LIQUIDITY_POOL_WITHDRAW
     RESULT_CLS = X.LiquidityPoolWithdrawResult
 

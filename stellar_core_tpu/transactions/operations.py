@@ -46,6 +46,9 @@ def _inner(op_type: OT, result_union_cls, code, value=None) -> X.OperationResult
 class OperationFrame:
     OP_TYPE: OT = None
     RESULT_CLS = None
+    # ledgerVersion that introduced this operation (reference: each op
+    # frame's isVersionSupported / isOpSupported gate); 0 = always
+    MIN_PROTOCOL_VERSION = 0
 
     def __init__(self, tx_frame, index: int, op: X.Operation):
         self.tx = tx_frame
@@ -76,6 +79,8 @@ class OperationFrame:
     # -- protocol -----------------------------------------------------------
     def check_valid(self, checker: SignatureChecker,
                     ltx: LedgerTxn) -> X.OperationResult:
+        if ltx.get_header().ledgerVersion < self.MIN_PROTOCOL_VERSION:
+            return X.OperationResult(ORC.opNOT_SUPPORTED)
         bad = self.check_signatures(checker, ltx)
         if bad is not None:
             return bad
@@ -266,6 +271,7 @@ class ManageDataOpFrame(OperationFrame):
 
 class BumpSequenceOpFrame(OperationFrame):
     """Reference: src/transactions/BumpSequenceOpFrame.cpp.  LOW threshold."""
+    MIN_PROTOCOL_VERSION = 10
     OP_TYPE = OT.BUMP_SEQUENCE
     RESULT_CLS = X.BumpSequenceResult
     C = X.BumpSequenceResultCode
@@ -687,6 +693,7 @@ class InflationOpFrame(OperationFrame):
 
 class CreateClaimableBalanceOpFrame(OperationFrame):
     """Reference: src/transactions/CreateClaimableBalanceOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.CREATE_CLAIMABLE_BALANCE
     RESULT_CLS = X.CreateClaimableBalanceResult
     C = X.CreateClaimableBalanceResultCode
@@ -820,6 +827,7 @@ def _release_claimable_balance_reserve(ltx, cb_entry: X.LedgerEntry,
 
 class ClaimClaimableBalanceOpFrame(OperationFrame):
     """Reference: src/transactions/ClaimClaimableBalanceOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.CLAIM_CLAIMABLE_BALANCE
     RESULT_CLS = X.ClaimClaimableBalanceResult
     C = X.ClaimClaimableBalanceResultCode
@@ -866,6 +874,7 @@ class ClaimClaimableBalanceOpFrame(OperationFrame):
 
 class ClawbackOpFrame(OperationFrame):
     """Reference: src/transactions/ClawbackOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 17
     OP_TYPE = OT.CLAWBACK
     RESULT_CLS = X.ClawbackResult
     C = X.ClawbackResultCode
@@ -896,6 +905,7 @@ class ClawbackOpFrame(OperationFrame):
 
 class ClawbackClaimableBalanceOpFrame(OperationFrame):
     """Reference: src/transactions/ClawbackClaimableBalanceOpFrame.cpp."""
+    MIN_PROTOCOL_VERSION = 17
     OP_TYPE = OT.CLAWBACK_CLAIMABLE_BALANCE
     RESULT_CLS = X.ClawbackClaimableBalanceResult
     C = X.ClawbackClaimableBalanceResultCode
@@ -919,6 +929,7 @@ class ClawbackClaimableBalanceOpFrame(OperationFrame):
 
 class SetTrustLineFlagsOpFrame(OperationFrame):
     """Reference: src/transactions/SetTrustLineFlagsOpFrame.cpp. LOW."""
+    MIN_PROTOCOL_VERSION = 17
     OP_TYPE = OT.SET_TRUST_LINE_FLAGS
     RESULT_CLS = X.SetTrustLineFlagsResult
     C = X.SetTrustLineFlagsResultCode
@@ -980,6 +991,7 @@ class BeginSponsoringFutureReservesOpFrame(OperationFrame):
     Round-1 scope: tracked in the apply context so Begin/End pair validates,
     but per-entry sponsorship bookkeeping is not yet wired into entry
     creation (documented gap)."""
+    MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.BEGIN_SPONSORING_FUTURE_RESERVES
     RESULT_CLS = X.BeginSponsoringFutureReservesResult
     C = X.BeginSponsoringFutureReservesResultCode
@@ -1007,6 +1019,7 @@ class BeginSponsoringFutureReservesOpFrame(OperationFrame):
 
 
 class EndSponsoringFutureReservesOpFrame(OperationFrame):
+    MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.END_SPONSORING_FUTURE_RESERVES
     RESULT_CLS = X.EndSponsoringFutureReservesResult
     C = X.EndSponsoringFutureReservesResultCode
@@ -1024,6 +1037,7 @@ class EndSponsoringFutureReservesOpFrame(OperationFrame):
 class RevokeSponsorshipOpFrame(OperationFrame):
     """Round-1 scope: structure + DOES_NOT_EXIST/NOT_SPONSOR paths; full
     reserve-transfer logic arrives with sponsorship bookkeeping."""
+    MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.REVOKE_SPONSORSHIP
     RESULT_CLS = X.RevokeSponsorshipResult
     C = X.RevokeSponsorshipResultCode

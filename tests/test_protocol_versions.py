@@ -1,0 +1,183 @@
+"""Protocol-version gating tests (reference: for_all_versions in TxTests;
+each op frame's isVersionSupported)."""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.testutils import (SUPPORTED_PROTOCOL_RANGE,
+                                        TestAccount, build_tx,
+                                        create_account_op, for_all_versions,
+                                        make_asset, manage_buy_offer_op,
+                                        native_payment_op, network_id)
+
+NID = network_id("protocol version test net")
+
+
+def _root(mgr):
+    sk = mgr.root_account_secret()
+    e = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, e.data.value.seqNum)
+
+
+def _result_of(arts, frame):
+    for pair in arts.result_entry.txResultSet.results:
+        if pair.transactionHash == frame.content_hash():
+            return pair.result
+    raise AssertionError
+
+
+def test_payment_works_at_every_version():
+    def body(mgr, version):
+        root = _root(mgr)
+        dest = SecretKey(b"\x55" * 32)
+        fr = root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])
+        arts = mgr.close_ledger([fr], 1000)
+        res = _result_of(arts, fr)
+        assert res.result.switch == X.TransactionResultCode.txSUCCESS, \
+            (version, res)
+        assert mgr.lcl_header.ledgerVersion == version
+
+    for_all_versions(NID, body)
+
+
+OP_GATES = [
+    # (min_version, op builder)
+    (14, lambda root: X.Operation(
+        body=X.OperationBody.createClaimableBalanceOp(
+            X.CreateClaimableBalanceOp(
+                asset=X.Asset.native(), amount=100,
+                claimants=[X.Claimant.v0(X.ClaimantV0(
+                    destination=root.account_id,
+                    predicate=X.ClaimPredicate.unconditional()))])))),
+    (17, lambda root: X.Operation(
+        body=X.OperationBody.clawbackOp(X.ClawbackOp(
+            asset=make_asset("EUR", root.account_id),
+            from_=X.muxed_from_account_id(root.account_id), amount=1)))),
+    (18, lambda root: X.Operation(
+        body=X.OperationBody.liquidityPoolWithdrawOp(
+            X.LiquidityPoolWithdrawOp(
+                liquidityPoolID=b"\x01" * 32, amount=1,
+                minAmountA=0, minAmountB=0)))),
+    (11, lambda root: manage_buy_offer_op(
+        X.Asset.native(), make_asset("EUR", root.account_id), 10, 1, 1)),
+]
+
+
+@pytest.mark.parametrize("min_version,build", OP_GATES,
+                         ids=["claimable14", "clawback17", "pool18",
+                              "buyoffer11"])
+def test_op_gated_below_introduction_version(min_version, build):
+    def body(mgr, version):
+        root = _root(mgr)
+        fr = root.tx([build(root)])
+        arts = mgr.close_ledger([fr], 1000)
+        res = _result_of(arts, fr)
+        op_res = res.result.value[0] if res.result.value else None
+        if version < min_version:
+            assert res.result.switch == X.TransactionResultCode.txFAILED
+            assert op_res.switch == X.OperationResultCode.opNOT_SUPPORTED, \
+                (version, op_res)
+        else:
+            # at/after introduction the op is dispatched (it may fail for
+            # state reasons, but never opNOT_SUPPORTED)
+            assert op_res is None or \
+                op_res.switch != X.OperationResultCode.opNOT_SUPPORTED, \
+                (version, op_res)
+
+    for_all_versions(NID, body, versions=[min_version - 1, min_version])
+
+
+def test_fee_bump_gated_below_13():
+    def body(mgr, version):
+        root = _root(mgr)
+        inner = root.tx([native_payment_op(root.account_id, 1)], fee=100)
+        fb = X.FeeBumpTransaction(
+            feeSource=X.MuxedAccount.ed25519(
+                root.secret.public_key.ed25519),
+            fee=400,
+            innerTx=X.FeeBumpInnerTx.v1(inner.envelope.value),
+            ext=X.FeeBumpTransaction._spec[3][1].cls(0))
+        fb_env = X.TransactionEnvelope.feeBump(
+            X.FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+        frame = mgr.make_frame(fb_env)
+        payload = frame.content_hash()
+        fb_env.value.signatures.append(X.DecoratedSignature(
+            hint=root.secret.public_key.hint(),
+            signature=root.secret.sign(payload)))
+        arts = mgr.close_ledger([frame], 1000)
+        res = _result_of(arts, frame)
+        if version < 13:
+            assert res.result.switch == X.TransactionResultCode.txNOT_SUPPORTED
+        else:
+            assert res.result.switch in (
+                X.TransactionResultCode.txFEE_BUMP_INNER_SUCCESS,
+                X.TransactionResultCode.txFEE_BUMP_INNER_FAILED), res
+
+    for_all_versions(NID, body, versions=[12, 13])
+
+
+def test_precond_v2_gated_below_19():
+    def body(mgr, version):
+        root = _root(mgr)
+        tx = X.Transaction(
+            sourceAccount=X.MuxedAccount.ed25519(
+                root.secret.public_key.ed25519),
+            fee=100, seqNum=root.next_seq(),
+            cond=X.Preconditions.v2(X.PreconditionsV2(
+                timeBounds=None, ledgerBounds=None, minSeqNum=None,
+                minSeqAge=0, minSeqLedgerGap=0, extraSigners=[])),
+            memo=X.Memo.none(), operations=[
+                native_payment_op(root.account_id, 1)])
+        env = X.TransactionEnvelope.v1(X.TransactionV1Envelope(
+            tx=tx, signatures=[]))
+        frame = mgr.make_frame(env)
+        env.value.signatures.append(X.DecoratedSignature(
+            hint=root.secret.public_key.hint(),
+            signature=root.secret.sign(frame.content_hash())))
+        arts = mgr.close_ledger([frame], 1000)
+        res = _result_of(arts, frame)
+        if version < 19:
+            assert res.result.switch == X.TransactionResultCode.txNOT_SUPPORTED
+        else:
+            assert res.result.switch == X.TransactionResultCode.txSUCCESS, res
+
+    for_all_versions(NID, body, versions=[18, 19])
+
+
+def test_surge_pricing_counts_txs_below_11_and_ops_after():
+    from stellar_core_tpu.herder.tx_queue import TransactionQueue
+    from stellar_core_tpu.ledger.manager import LedgerManager
+
+    for version, expect in ((10, 3), (11, 1)):
+        mgr = LedgerManager(NID)
+        mgr.start_new_ledger(protocol_version=version)
+        mgr.lcl_header.maxTxSetSize = 3
+        root = _root(mgr)
+        q = TransactionQueue(mgr)
+        for i in range(3):
+            fr = root.tx([native_payment_op(root.account_id, 1)] * 3)
+            q.by_hash[fr.content_hash()] = fr  # bypass validity for unit test
+        got = q.tx_set_frames()
+        # v10: 3 txs fit (counted as txs); v11+: 3-op txs fill the 3-op cap
+        assert len(got) == expect, (version, len(got))
+
+
+def test_muxed_account_gated_below_13():
+    def body(mgr, version):
+        root = _root(mgr)
+        muxed_dest = X.MuxedAccount.med25519(X.MuxedAccount._arms[X.CryptoKeyType.KEY_TYPE_MUXED_ED25519][1].cls(
+            id=7, ed25519=root.secret.public_key.ed25519))
+        op = X.Operation(body=X.OperationBody.paymentOp(X.PaymentOp(
+            destination=muxed_dest, asset=X.Asset.native(), amount=1)))
+        fr = root.tx([op])
+        arts = mgr.close_ledger([fr], 1000)
+        res = _result_of(arts, fr)
+        if version < 13:
+            assert res.result.switch == X.TransactionResultCode.txNOT_SUPPORTED
+        else:
+            assert res.result.switch == X.TransactionResultCode.txSUCCESS, res
+
+    for_all_versions(NID, body, versions=[12, 13])
