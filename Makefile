@@ -1,0 +1,10 @@
+.PHONY: native test clean
+
+native:
+	python setup.py build_ext --inplace
+
+test:
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf build stellar_core_tpu/_cxdr*.so

@@ -1,0 +1,464 @@
+/* cxdr: native XDR serializer for stellar-core-tpu.
+ *
+ * The reference implements its XDR layer in C++ (xdrpp, generated
+ * marshalers); this extension is that native seam for the TPU framework:
+ * profiled replay time is dominated by serialization (PROFILE.md), so the
+ * pack path — the hot inner loop of hashing, bucket building and history
+ * writing — runs in C while the Python codec remains the semantic source
+ * of truth (differentially tested, automatic fallback when unbuilt).
+ *
+ * A type is compiled (once, Python side) into a nested tuple "program":
+ *   (OP_U32,) (OP_I32,) (OP_U64,) (OP_I64,) (OP_BOOL,) (OP_ENUM,)
+ *   (OP_OPAQUE, n) (OP_VAROPAQUE, max) (OP_STRING, max)
+ *   (OP_FIXARRAY, n, elem) (OP_VARARRAY, max, elem)
+ *   (OP_OPTIONAL, elem) (OP_VOID,)
+ *   (OP_STRUCT, (name0, prog0, name1, prog1, ...))
+ *   (OP_UNION, {switch_int: prog_or_None}, default_prog_or_None, has_default)
+ * cxdr.pack(program, value) returns the XDR bytes, raising cxdr.Error with
+ * the same rejection semantics as the Python codec (range checks, length
+ * caps, exact fixed-opaque lengths).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+enum {
+    OP_U32 = 1, OP_I32, OP_U64, OP_I64, OP_BOOL, OP_ENUM,
+    OP_OPAQUE, OP_VAROPAQUE, OP_STRING,
+    OP_FIXARRAY, OP_VARARRAY, OP_OPTIONAL, OP_VOID,
+    OP_STRUCT, OP_UNION, OP_PYCALL,
+};
+
+static PyObject *CxdrError;
+
+typedef struct {
+    char *data;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} Buf;
+
+static int
+buf_reserve(Buf *b, Py_ssize_t extra)
+{
+    if (b->len + extra <= b->cap)
+        return 0;
+    Py_ssize_t ncap = b->cap ? b->cap * 2 : 256;
+    while (ncap < b->len + extra)
+        ncap *= 2;
+    char *nd = PyMem_Realloc(b->data, ncap);
+    if (!nd) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    b->data = nd;
+    b->cap = ncap;
+    return 0;
+}
+
+static int
+buf_put(Buf *b, const void *src, Py_ssize_t n)
+{
+    if (buf_reserve(b, n) < 0)
+        return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+static int
+put_u32be(Buf *b, uint32_t v)
+{
+    unsigned char tmp[4] = {
+        (unsigned char)(v >> 24), (unsigned char)(v >> 16),
+        (unsigned char)(v >> 8), (unsigned char)v,
+    };
+    return buf_put(b, tmp, 4);
+}
+
+static int
+put_u64be(Buf *b, uint64_t v)
+{
+    unsigned char tmp[8] = {
+        (unsigned char)(v >> 56), (unsigned char)(v >> 48),
+        (unsigned char)(v >> 40), (unsigned char)(v >> 32),
+        (unsigned char)(v >> 24), (unsigned char)(v >> 16),
+        (unsigned char)(v >> 8), (unsigned char)v,
+    };
+    return buf_put(b, tmp, 8);
+}
+
+/* Extract an integer with overflow detection; returns 0 on success. */
+static int
+get_int64(PyObject *val, int64_t *out)
+{
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(val, &overflow);
+    if (overflow || (v == -1 && PyErr_Occurred())) {
+        PyErr_Clear();
+        PyErr_Format(CxdrError, "value out of range: %R", val);
+        return -1;
+    }
+    *out = (int64_t)v;
+    return 0;
+}
+
+static int
+get_uint64(PyObject *val, uint64_t *out)
+{
+    unsigned long long v = PyLong_AsUnsignedLongLong(val);
+    if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        PyErr_Format(CxdrError, "value out of range: %R", val);
+        return -1;
+    }
+    *out = (uint64_t)v;
+    return 0;
+}
+
+static int pack_value(PyObject *prog, PyObject *val, Buf *b, int depth);
+
+static int
+pack_bytes_body(Buf *b, const char *p, Py_ssize_t n, int with_len)
+{
+    if (with_len && put_u32be(b, (uint32_t)n) < 0)
+        return -1;
+    if (buf_put(b, p, n) < 0)
+        return -1;
+    static const char zeros[4] = {0, 0, 0, 0};
+    Py_ssize_t pad = (4 - (n % 4)) % 4;
+    if (pad && buf_put(b, zeros, pad) < 0)
+        return -1;
+    return 0;
+}
+
+static int
+as_bytes(PyObject *val, PyObject **owned, const char **p, Py_ssize_t *n,
+         int allow_str)
+{
+    /* bytes / bytearray always; str (utf-8) only for OP_STRING, matching
+       the Python codec's XdrString-only str acceptance */
+    *owned = NULL;
+    if (PyBytes_Check(val)) {
+        *p = PyBytes_AS_STRING(val);
+        *n = PyBytes_GET_SIZE(val);
+        return 0;
+    }
+    if (PyByteArray_Check(val)) {
+        *p = PyByteArray_AS_STRING(val);
+        *n = PyByteArray_GET_SIZE(val);
+        return 0;
+    }
+    if (allow_str && PyUnicode_Check(val)) {
+        PyObject *enc = PyUnicode_AsUTF8String(val);
+        if (!enc)
+            return -1;
+        *owned = enc;
+        *p = PyBytes_AS_STRING(enc);
+        *n = PyBytes_GET_SIZE(enc);
+        return 0;
+    }
+    PyErr_Format(CxdrError, "expected bytes, got %.80s",
+                 Py_TYPE(val)->tp_name);
+    return -1;
+}
+
+static int
+pack_value(PyObject *prog, PyObject *val, Buf *b, int depth)
+{
+    if (depth > 200) {
+        PyErr_SetString(CxdrError, "program too deep");
+        return -1;
+    }
+    long op = PyLong_AsLong(PyTuple_GET_ITEM(prog, 0));
+    switch (op) {
+    case OP_U32: {
+        uint64_t v;
+        if (get_uint64(val, &v) < 0 || v > 0xFFFFFFFFULL) {
+            if (!PyErr_Occurred())
+                PyErr_Format(CxdrError, "value out of range: %R", val);
+            return -1;
+        }
+        return put_u32be(b, (uint32_t)v);
+    }
+    case OP_I32: {
+        int64_t v;
+        if (get_int64(val, &v) < 0)
+            return -1;
+        if (v < INT32_MIN || v > INT32_MAX) {
+            PyErr_Format(CxdrError, "value out of range: %R", val);
+            return -1;
+        }
+        return put_u32be(b, (uint32_t)(int32_t)v);
+    }
+    case OP_ENUM: {
+        /* (OP_ENUM, members_dict): membership-checked like the Python
+           codec's _EnumAdapter */
+        PyObject *members = PyTuple_GET_ITEM(prog, 1);
+        PyObject *swint = PyNumber_Index(val);
+        if (!swint) {
+            PyErr_Clear();
+            PyErr_Format(CxdrError, "bad enum value %R", val);
+            return -1;
+        }
+        int contains = PyDict_Contains(members, swint);
+        if (contains <= 0) {
+            Py_DECREF(swint);
+            if (contains == 0)
+                PyErr_Format(CxdrError, "bad enum value %R", val);
+            return -1;
+        }
+        int64_t v;
+        int rc = get_int64(swint, &v);
+        Py_DECREF(swint);
+        if (rc < 0)
+            return -1;
+        return put_u32be(b, (uint32_t)(int32_t)v);
+    }
+    case OP_U64: {
+        uint64_t v;
+        if (get_uint64(val, &v) < 0)
+            return -1;
+        return put_u64be(b, v);
+    }
+    case OP_I64: {
+        int64_t v;
+        if (get_int64(val, &v) < 0)
+            return -1;
+        return put_u64be(b, (uint64_t)v);
+    }
+    case OP_BOOL: {
+        int truth = PyObject_IsTrue(val);
+        if (truth < 0)
+            return -1;
+        return put_u32be(b, (uint32_t)truth);
+    }
+    case OP_OPAQUE: {
+        Py_ssize_t want = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        PyObject *owned;
+        const char *p;
+        Py_ssize_t n;
+        if (as_bytes(val, &owned, &p, &n, 0) < 0)
+            return -1;
+        if (n != want) {
+            Py_XDECREF(owned);
+            PyErr_Format(CxdrError, "opaque[%zd]: got %zd bytes", want, n);
+            return -1;
+        }
+        int rc = pack_bytes_body(b, p, n, 0);
+        Py_XDECREF(owned);
+        return rc;
+    }
+    case OP_VAROPAQUE:
+    case OP_STRING: {
+        Py_ssize_t maxlen = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        PyObject *owned;
+        const char *p;
+        Py_ssize_t n;
+        if (as_bytes(val, &owned, &p, &n, op == OP_STRING) < 0)
+            return -1;
+        if (n > maxlen) {
+            Py_XDECREF(owned);
+            PyErr_Format(CxdrError, "opaque<%zd>: got %zd bytes", maxlen, n);
+            return -1;
+        }
+        int rc = pack_bytes_body(b, p, n, 1);
+        Py_XDECREF(owned);
+        return rc;
+    }
+    case OP_FIXARRAY:
+    case OP_VARARRAY: {
+        Py_ssize_t bound = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        PyObject *elem = PyTuple_GET_ITEM(prog, 2);
+        PyObject *seq = PySequence_Fast(val, "expected a sequence");
+        if (!seq)
+            return -1;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        if (op == OP_FIXARRAY ? (n != bound) : (n > bound)) {
+            Py_DECREF(seq);
+            PyErr_Format(CxdrError, "array bound %zd: got %zd", bound, n);
+            return -1;
+        }
+        if (op == OP_VARARRAY && put_u32be(b, (uint32_t)n) < 0) {
+            Py_DECREF(seq);
+            return -1;
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (pack_value(elem, PySequence_Fast_GET_ITEM(seq, i), b,
+                           depth + 1) < 0) {
+                Py_DECREF(seq);
+                return -1;
+            }
+        }
+        Py_DECREF(seq);
+        return 0;
+    }
+    case OP_OPTIONAL: {
+        if (val == Py_None)
+            return put_u32be(b, 0);
+        if (put_u32be(b, 1) < 0)
+            return -1;
+        return pack_value(PyTuple_GET_ITEM(prog, 1), val, b, depth + 1);
+    }
+    case OP_VOID:
+        return 0;
+    case OP_STRUCT: {
+        /* (OP_STRUCT, fields, cls) */
+        PyObject *fields = PyTuple_GET_ITEM(prog, 1);
+        PyObject *cls = PyTuple_GET_ITEM(prog, 2);
+        int inst = PyObject_IsInstance(val, cls);
+        if (inst < 0)
+            return -1;
+        if (!inst) {
+            PyErr_Format(CxdrError, "expected %.80s, got %.80s",
+                         ((PyTypeObject *)cls)->tp_name,
+                         Py_TYPE(val)->tp_name);
+            return -1;
+        }
+        Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+        for (Py_ssize_t i = 0; i < nf; i += 2) {
+            PyObject *name = PyTuple_GET_ITEM(fields, i);
+            PyObject *sub = PyTuple_GET_ITEM(fields, i + 1);
+            PyObject *fv = PyObject_GetAttr(val, name);
+            if (!fv)
+                return -1;
+            int rc = pack_value(sub, fv, b, depth + 1);
+            Py_DECREF(fv);
+            if (rc < 0)
+                return -1;
+        }
+        return 0;
+    }
+    case OP_UNION: {
+        /* (OP_UNION, arms, defprog, has_default, members_or_None, cls) */
+        PyObject *arms = PyTuple_GET_ITEM(prog, 1);
+        PyObject *defprog = PyTuple_GET_ITEM(prog, 2);
+        int has_default = PyObject_IsTrue(PyTuple_GET_ITEM(prog, 3));
+        PyObject *members = PyTuple_GET_ITEM(prog, 4);
+        PyObject *cls = PyTuple_GET_ITEM(prog, 5);
+        int inst = PyObject_IsInstance(val, cls);
+        if (inst < 0)
+            return -1;
+        if (!inst) {
+            PyErr_Format(CxdrError, "expected %.80s, got %.80s",
+                         ((PyTypeObject *)cls)->tp_name,
+                         Py_TYPE(val)->tp_name);
+            return -1;
+        }
+        PyObject *sw = PyObject_GetAttrString(val, "switch");
+        if (!sw)
+            return -1;
+        PyObject *swint = PyNumber_Index(sw);
+        Py_DECREF(sw);
+        if (!swint) {
+            PyErr_Clear();
+            PyErr_SetString(CxdrError, "union switch is not an integer");
+            return -1;
+        }
+        int64_t swv;
+        if (get_int64(swint, &swv) < 0 || swv < INT32_MIN ||
+            swv > INT32_MAX) {
+            Py_DECREF(swint);
+            if (!PyErr_Occurred())
+                PyErr_SetString(CxdrError, "union switch out of range");
+            return -1;
+        }
+        if (members != Py_None) {
+            /* enum-typed switch: membership check like _EnumAdapter */
+            int ok = PyDict_Contains(members, swint);
+            if (ok <= 0) {
+                Py_DECREF(swint);
+                if (ok == 0)
+                    PyErr_Format(CxdrError, "bad enum value %lld",
+                                 (long long)swv);
+                return -1;
+            }
+        }
+        PyObject *arm = PyDict_GetItem(arms, swint);  /* borrowed */
+        Py_DECREF(swint);
+        if (!arm) {
+            if (!has_default) {
+                PyErr_Format(CxdrError, "no arm for discriminant %lld",
+                             (long long)swv);
+                return -1;
+            }
+            arm = defprog;
+        }
+        if (put_u32be(b, (uint32_t)(int32_t)swv) < 0)
+            return -1;
+        if (arm == Py_None)
+            return 0;
+        PyObject *av = PyObject_GetAttrString(val, "value");
+        if (!av)
+            return -1;
+        int rc = pack_value(arm, av, b, depth + 1);
+        Py_DECREF(av);
+        return rc;
+    }
+    case OP_PYCALL: {
+        /* (OP_PYCALL, xdr_type): recursion/fallback seam — delegate to
+           the PYTHON pack path (_pack_py): recursive types render their
+           whole subtree in Python, which cannot re-enter this opcode for
+           the same value */
+        PyObject *t = PyTuple_GET_ITEM(prog, 1);
+        PyObject *res = PyObject_CallMethod(t, "_pack_py", "O", val);
+        if (!res)
+            return -1;
+        if (!PyBytes_Check(res)) {
+            Py_DECREF(res);
+            PyErr_SetString(CxdrError, "pack() did not return bytes");
+            return -1;
+        }
+        int rc = buf_put(b, PyBytes_AS_STRING(res), PyBytes_GET_SIZE(res));
+        Py_DECREF(res);
+        return rc;
+    }
+    default:
+        PyErr_Format(CxdrError, "bad opcode %ld", op);
+        return -1;
+    }
+}
+
+static PyObject *
+cxdr_pack(PyObject *self, PyObject *args)
+{
+    PyObject *prog, *val;
+    if (!PyArg_ParseTuple(args, "O!O", &PyTuple_Type, &prog, &val))
+        return NULL;
+    Buf b = {NULL, 0, 0};
+    if (pack_value(prog, val, &b, 0) < 0) {
+        PyMem_Free(b.data);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.data, b.len);
+    PyMem_Free(b.data);
+    return out;
+}
+
+static PyMethodDef cxdr_methods[] = {
+    {"pack", cxdr_pack, METH_VARARGS,
+     "pack(program, value) -> bytes: serialize value per the program."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef cxdr_module = {
+    PyModuleDef_HEAD_INIT, "_cxdr",
+    "Native XDR serializer (see native/cxdr.c).", -1, cxdr_methods,
+};
+
+PyMODINIT_FUNC
+PyInit__cxdr(void)
+{
+    PyObject *m = PyModule_Create(&cxdr_module);
+    if (!m)
+        return NULL;
+    CxdrError = PyErr_NewException("_cxdr.Error", NULL, NULL);
+    Py_XINCREF(CxdrError);
+    if (PyModule_AddObject(m, "Error", CxdrError) < 0) {
+        Py_XDECREF(CxdrError);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

@@ -1,0 +1,19 @@
+"""Build the native XDR serializer (see native/cxdr.c).
+
+    python setup.py build_ext --inplace
+
+The framework runs without it (pure-Python codec fallback); building it
+accelerates the serialization-bound replay path.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    name="stellar-core-tpu-native",
+    version="2.0.0",
+    ext_modules=[Extension(
+        "stellar_core_tpu._cxdr",
+        sources=["native/cxdr.c"],
+        extra_compile_args=["-O2"],
+    )],
+)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import enum
 import struct as _struct
+import sys
 from typing import Any, Dict, List, Optional as Opt, Sequence, Tuple
 
 _U32 = _struct.Struct(">I")
@@ -33,7 +34,20 @@ def _pad(n: int) -> int:
 class XdrType:
     """Protocol: pack_into(val, out: bytearray); unpack_from(buf, off) -> (val, off)."""
 
+    _cxdr_prog = None
+
     def pack(self, val: Any) -> bytes:
+        if _cxdr is not None:
+            prog = self._cxdr_prog
+            if prog is None:
+                prog = self._cxdr_prog = compile_program(self)
+            try:
+                return _cxdr.pack(prog, val)
+            except _cxdr.Error as e:
+                raise XdrError(str(e)) from None
+        return self._pack_py(val)
+
+    def _pack_py(self, val: Any) -> bytes:
         out = bytearray()
         self.pack_into(val, out)
         return bytes(out)
@@ -325,7 +339,11 @@ def xdr_struct(name: str, fields: List[Tuple[str, Any]], defaults: Opt[Dict[str,
 
         @classmethod
         def _xdr_adapter(cls):
-            return _StructAdapter(cls)
+            a = cls.__dict__.get("_cached_adapter")
+            if a is None:
+                a = _StructAdapter(cls)
+                cls._cached_adapter = a
+            return a
 
         def to_xdr(self) -> bytes:
             return self._xdr_adapter().pack(self)
@@ -435,7 +453,11 @@ def xdr_union(name: str, switch_type, arms: Dict[Any, Tuple[str, Any]],
 
         @classmethod
         def _xdr_adapter(cls):
-            return _UnionAdapter(cls)
+            a = cls.__dict__.get("_cached_adapter")
+            if a is None:
+                a = _UnionAdapter(cls)
+                cls._cached_adapter = a
+            return a
 
         def to_xdr(self) -> bytes:
             return self._xdr_adapter().pack(self)
@@ -513,3 +535,71 @@ def pack(t, val) -> bytes:
 
 def unpack(t, data: bytes):
     return _as_type(t).unpack(data)
+
+
+# ---------------------------------------------------------------------------
+# Native serializer integration (native/cxdr.c).  The Python pack_into
+# implementations above stay the semantic source of truth; compile_program
+# lowers a type to the C interpreter's tuple program, with OP_PYCALL as the
+# graceful degradation for recursive/unknown types.  Set STELLAR_TPU_NO_CXDR
+# to force the pure-Python path (the differential test does).
+
+import os as _os
+
+try:
+    if _os.environ.get("STELLAR_TPU_NO_CXDR"):
+        raise ImportError("cxdr disabled by STELLAR_TPU_NO_CXDR")
+    from stellar_core_tpu import _cxdr  # built via `make native`
+except ImportError:
+    _cxdr = None
+
+
+def compile_program(t) -> tuple:
+    t = _as_type(t)
+    if isinstance(t, _Uint32):
+        return (1,)
+    if isinstance(t, _Int32):
+        return (2,)
+    if isinstance(t, _Uint64):
+        return (3,)
+    if isinstance(t, _Int64):
+        return (4,)
+    if isinstance(t, _Bool):
+        return (5,)
+    if isinstance(t, _EnumAdapter):
+        return (6, {int(m): None for m in t.enum_cls})
+    if isinstance(t, Opaque):
+        return (7, t.n)
+    if isinstance(t, VarOpaque):
+        return (8, t.max_len)
+    if isinstance(t, XdrString):
+        return (9, t._op.max_len)
+    if isinstance(t, FixedArray):
+        return (10, t.n, compile_program(t.elem))
+    if isinstance(t, VarArray):
+        return (11, t.max_len, compile_program(t.elem))
+    if isinstance(t, Optional):
+        return (12, compile_program(t.elem))
+    if isinstance(t, _Void):
+        return (13,)
+    if isinstance(t, _StructAdapter):
+        parts = []
+        for fname, ftype in t.cls._spec:
+            parts.append(sys.intern(fname))
+            parts.append(compile_program(ftype))
+        return (14, tuple(parts), t.cls)
+    if isinstance(t, _UnionAdapter):
+        arms = {}
+        for k, (_an, at) in t.cls._arms.items():
+            arms[int(k)] = compile_program(at) if at is not None else None
+        default = t.cls._default
+        defprog = (compile_program(default[1])
+                   if default is not None and default[1] is not None else None)
+        # enum-typed switches carry the membership dict (None for plain
+        # int switches), matching the Python switch-type validation
+        sw_t = t.cls._switch_type
+        members = ({int(m): None for m in sw_t.enum_cls}
+                   if isinstance(sw_t, _EnumAdapter) else None)
+        return (15, arms, defprog, default is not None, members, t.cls)
+    # recursive forward refs and anything unknown: Python-callback seam
+    return (16, t)
