@@ -54,6 +54,11 @@ CREATE TABLE IF NOT EXISTS txhistory (
     ledgerseq   INTEGER PRIMARY KEY,
     txentry     BLOB NOT NULL,
     resultentry BLOB NOT NULL);
+CREATE TABLE IF NOT EXISTS peers (
+    host        TEXT NOT NULL,
+    port        INTEGER NOT NULL,
+    numfailures INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (host, port));
 CREATE INDEX IF NOT EXISTS scphistory_seq ON scphistory (ledgerseq);
 """
 
@@ -218,6 +223,21 @@ class Database:
     def prune_tx_history(self, below_seq: int) -> None:
         self.conn.execute("DELETE FROM txhistory WHERE ledgerseq < ?",
                           (below_seq,))
+
+    # -- peer address book (reference: PeerManager's peers table) -----------
+    def store_peer(self, host: str, port: int, num_failures: int) -> None:
+        self.conn.execute(
+            "INSERT INTO peers (host, port, numfailures) VALUES (?, ?, ?) "
+            "ON CONFLICT(host, port) DO UPDATE SET "
+            "numfailures = excluded.numfailures", (host, port, num_failures))
+
+    def load_peers(self) -> List[Tuple[str, int, int]]:
+        return self.conn.execute(
+            "SELECT host, port, numfailures FROM peers").fetchall()
+
+    def delete_peer(self, host: str, port: int) -> None:
+        self.conn.execute("DELETE FROM peers WHERE host = ? AND port = ?",
+                          (host, port))
 
     # -- publish queue (reference: HistoryManagerImpl publishqueue table) ----
     def queue_publish(self, checkpoint_ledger: int, has_json: str) -> None:

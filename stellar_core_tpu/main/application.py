@@ -78,7 +78,11 @@ class Application:
             self.herder.ledger_timespan = 1.0
         self.overlay = OverlayManager(self.clock, self.herder,
                                       self.network_id, self.node_secret,
-                                      listening_port=config.PEER_PORT)
+                                      listening_port=config.PEER_PORT,
+                                      database=self.database)
+        for addr in config.KNOWN_PEERS:
+            host, _, port = addr.partition(":")
+            self.overlay.peer_manager.add_address(host, int(port or 11625))
         self.transport: Optional[TCPTransport] = None
         if listen:
             self.transport = TCPTransport(
@@ -133,14 +137,22 @@ class Application:
     RECONNECT_INTERVAL = 2.0
 
     def _dial_known_peers(self) -> None:
+        """Dial address-book candidates up to the target connection count
+        (reference: OverlayManagerImpl::connectToMorePeers via
+        RandomPeerSource)."""
         if self.transport is None:
             return
-        for addr in self.config.KNOWN_PEERS:
-            host, _, port = addr.partition(":")
-            self.transport.connect(host, int(port or 11625))
+        want = self.config.TARGET_PEER_CONNECTIONS \
+            - self.overlay.num_authenticated()
+        if want <= 0:
+            return
+        exclude = self.overlay.connected_addresses()
+        for host, port in self.overlay.peer_manager.dial_candidates(
+                want, exclude=exclude):
+            self.transport.connect(host, port)
 
     def _start_reconnect_timer(self) -> None:
-        """Redial KNOWN_PEERS while under-connected (reference:
+        """Redial while under-connected (reference:
         OverlayManagerImpl::triggerPeerResolution on a timer).  Duplicate
         connections are resolved deterministically by the overlay's
         keep-smaller-dialer rule, so over-dialing is harmless."""
@@ -148,9 +160,7 @@ class Application:
         self._reconnect_timer = VirtualTimer(self.clock)
 
         def tick() -> None:
-            if self.overlay.num_authenticated() < len(
-                    self.config.KNOWN_PEERS):
-                self._dial_known_peers()
+            self._dial_known_peers()
             self._reconnect_timer.expires_from_now(
                 self.RECONNECT_INTERVAL, tick)
 

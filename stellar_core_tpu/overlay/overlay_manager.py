@@ -21,6 +21,7 @@ from ..util.metrics import registry as _registry
 from .flood import Floodgate, ItemFetcher, TxAdverts
 from .peer import Peer
 from .peer_auth import PeerAuth
+from .peer_manager import PeerManager
 
 log = slog.get("Overlay")
 
@@ -28,7 +29,7 @@ log = slog.get("Overlay")
 class OverlayManager:
     def __init__(self, clock, herder, network_id: bytes,
                  node_secret: SecretKey, listening_port: int = 0,
-                 auth_seed: Optional[bytes] = None):
+                 auth_seed: Optional[bytes] = None, database=None):
         self.clock = clock
         self.herder = herder
         self.network_id = network_id
@@ -39,6 +40,8 @@ class OverlayManager:
                                   auth_seed=auth_seed)
         self.pending_peers: List[Peer] = []
         self.authenticated_peers: Dict[bytes, Peer] = {}  # peer_id -> Peer
+        self.peer_manager = PeerManager(clock, database,
+                                        self_port=listening_port)
         self.floodgate = Floodgate()
         self.adverts = TxAdverts(self._send_advert, self._send_demand)
         self.fetcher = ItemFetcher(self._ask_for_item)
@@ -90,6 +93,18 @@ class OverlayManager:
         self.authenticated_peers[peer.peer_id] = peer
         log.info("peer %s authenticated (%s)", peer.peer_id.hex()[:8],
                  "outbound" if peer.we_called_remote else "inbound")
+        # learn the network (reference: Peer::recvAuth -> sendGetPeers)
+        peer.send_message(X.StellarMessage.getPeers())
+        if peer.remote_listening_port > 0 and hasattr(peer, "sock") \
+                and peer.sock is not None:
+            try:
+                host = peer.sock.getpeername()[0]
+                self.peer_manager.add_address(host,
+                                              peer.remote_listening_port)
+                self.peer_manager.record_success(host,
+                                                 peer.remote_listening_port)
+            except OSError:
+                pass
         # bring the peer up to date on consensus (reference:
         # Peer::recvAuth -> sendSCPState... via Herder)
         for env in self.herder.get_scp_state(0):
@@ -99,6 +114,11 @@ class OverlayManager:
     def _peer_dropped(self, peer: Peer) -> None:
         _registry().counter("overlay.peer.drop").inc()
         self.stats["dropped_peers"] += 1
+        # outbound dials that never authenticated feed the backoff policy
+        dial = getattr(peer, "dial_addr", None)
+        if dial is not None and peer.we_called_remote \
+                and peer.state != Peer.GOT_AUTH and peer.peer_id is None:
+            self.peer_manager.record_failure(*dial)
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
         if peer.peer_id is not None and \
@@ -111,6 +131,24 @@ class OverlayManager:
 
     def num_authenticated(self) -> int:
         return len(self.authenticated_peers)
+
+    def connected_addresses(self) -> set:
+        """(host, listening_port) of live connections — the dial-exclusion
+        set (pending dials included so a slow handshake isn't re-dialed)."""
+        out = set()
+        for peer in (*self.authenticated_peers.values(),
+                     *self.pending_peers):
+            dial = getattr(peer, "dial_addr", None)
+            if dial is not None:
+                out.add(dial)
+            elif peer.peer_id is not None and peer.remote_listening_port \
+                    and hasattr(peer, "sock") and peer.sock is not None:
+                try:
+                    out.add((peer.sock.getpeername()[0],
+                             peer.remote_listening_port))
+                except OSError:
+                    pass
+        return out
 
     # -- outbound flooding --------------------------------------------------
     def broadcast_scp_envelope(self, env) -> None:
@@ -195,9 +233,10 @@ class OverlayManager:
             for env in self.herder.get_scp_state(msg.value):
                 peer.send_message(X.StellarMessage.envelope(env))
         elif t == MT.GET_PEERS:
-            peer.send_message(X.StellarMessage.peers([]))
+            peer.send_message(X.StellarMessage.peers(
+                self.peer_manager.peers_to_send()))
         elif t == MT.PEERS:
-            pass  # address-book persistence arrives with PeerManager
+            self.peer_manager.add_peer_addresses(msg.value)
         else:
             log.warning("unhandled message type %s", t)
 

@@ -127,6 +127,7 @@ class TCPTransport:
             pass
         peer = TCPPeer(self.overlay, we_called_remote=True, sock=sock,
                        transport=self)
+        peer.dial_addr = (host, port)   # feeds PeerManager backoff on drop
         self.peers[sock] = peer
         self.selector.register(sock, selectors.EVENT_READ
                                | selectors.EVENT_WRITE)

@@ -329,3 +329,63 @@ class TestOverTCP:
         finally:
             for t in transports:
                 t.close()
+
+
+class TestPeerDiscovery:
+    def test_peers_gossip_reaches_new_node(self, tmp_path):
+        """C only knows A; A knows B: PEERS gossip must teach C about B
+        (reference: GET_PEERS/PEERS + PeerManager address book)."""
+        from stellar_core_tpu.database import Database
+        from stellar_core_tpu.overlay.peer_manager import PeerManager
+
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        sks = [SecretKey(bytes([0x0a + i]) * 32) for i in range(3)]
+        ids = [s.public_key.ed25519 for s in sks]
+        q = qset_of(ids, 2)
+        nodes, transports = [], []
+        for i, s in enumerate(sks):
+            h, o = _make_node(clock, s, q, bytes([0x71 + i]) * 32)
+            t = TCPTransport(o, listen_port=0)
+            nodes.append((h, o))
+            transports.append(t)
+        (ha, oa), (hb, ob), (hc, oc) = nodes
+        try:
+            # A <-> B connected; then C dials only A
+            transports[0].connect("127.0.0.1", ob.listening_port)
+            ok = clock.crank_until(
+                lambda: oa.num_authenticated() >= 1
+                and ob.num_authenticated() >= 1, timeout=10)
+            assert ok
+            transports[2].connect("127.0.0.1", oa.listening_port)
+            # C learns B's address via the PEERS exchange
+            ok = clock.crank_until(
+                lambda: any(port == ob.listening_port
+                            for _, port in
+                            oc.peer_manager.dial_candidates(50)), timeout=10)
+            assert ok, [r for r in oc.peer_manager._records]
+        finally:
+            for t in transports:
+                t.close()
+
+    def test_peer_manager_backoff_and_persistence(self, tmp_path):
+        from stellar_core_tpu.database import Database
+        from stellar_core_tpu.overlay.peer_manager import PeerManager
+
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        db = Database(str(tmp_path / "p.db"))
+        pm = PeerManager(clock, db)
+        pm.add_address("10.0.0.1", 11625)
+        pm.add_address("10.0.0.2", 11625)
+        assert len(pm.dial_candidates(10)) == 2
+        pm.record_failure("10.0.0.1", 11625)
+        # failed address backs off
+        assert pm.dial_candidates(10) == [("10.0.0.2", 11625)]
+        clock._virtual_now += 3600
+        assert len(pm.dial_candidates(10)) == 2
+        # persisted across restart
+        pm2 = PeerManager(clock, Database(db.path))
+        assert pm2.size == 2
+        # repeated failures forget the address
+        for _ in range(20):
+            pm.record_failure("10.0.0.1", 11625)
+        assert pm.size == 1
