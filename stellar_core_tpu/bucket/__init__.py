@@ -1,0 +1,15 @@
+from .bucket import Bucket, entry_sort_key, merge_buckets
+from .bucket_list import (NUM_LEVELS, BucketLevel, BucketList,
+                          keep_tombstone_entries, level_half,
+                          level_should_spill, level_size)
+from .future import FutureBucket
+from .index import BucketIndex
+from .manager import BucketDir
+from .snapshot import SearchableBucketListSnapshot
+
+__all__ = [
+    "Bucket", "BucketDir", "BucketIndex", "BucketLevel", "BucketList",
+    "FutureBucket", "NUM_LEVELS", "SearchableBucketListSnapshot",
+    "entry_sort_key", "keep_tombstone_entries", "level_half",
+    "level_should_spill", "level_size", "merge_buckets",
+]

@@ -41,24 +41,28 @@ class Bucket:
     """Immutable sorted bucket. entries EXCLUDE the meta entry; protocol
     version is carried separately and re-serialized as METAENTRY."""
 
-    __slots__ = ("entries", "protocol_version", "_hash", "_sort_keys")
+    __slots__ = ("entries", "protocol_version", "_hash", "_index")
 
     def __init__(self, entries: List[BucketEntry], protocol_version: int):
         self.entries = entries
         self.protocol_version = protocol_version
         self._hash: Optional[bytes] = None
-        self._sort_keys: Optional[List[bytes]] = None
+        self._index = None
+
+    def index(self):
+        """The bucket's point-lookup index, built lazily once per immutable
+        bucket (reference: BucketManager::maybeBuildIndex)."""
+        if self._index is None:
+            from .index import BucketIndex
+            self._index = BucketIndex([entry_sort_key(e)
+                                       for e in self.entries])
+        return self._index
 
     def find(self, key_bytes: bytes) -> Optional[BucketEntry]:
-        """Binary search by LedgerKey XDR (entries are sorted by exactly
-        this); the key list is built lazily once per immutable bucket."""
-        if self._sort_keys is None:
-            self._sort_keys = [entry_sort_key(e) for e in self.entries]
-        import bisect
-        i = bisect.bisect_left(self._sort_keys, key_bytes)
-        if i < len(self._sort_keys) and self._sort_keys[i] == key_bytes:
-            return self.entries[i]
-        return None
+        """Indexed lookup by LedgerKey XDR bytes (entries are sorted by
+        exactly this)."""
+        i = self.index().find(key_bytes)
+        return self.entries[i] if i is not None else None
 
     @staticmethod
     def empty() -> "Bucket":

@@ -2,10 +2,16 @@
 
 Reference: src/bucket/BucketListBase.{h,cpp} / LiveBucketList — levels of
 (curr, snap) buckets, spill cadence in powers of 4, levelShouldSpill /
-levelHalf / levelSize, getHash = tree of SHA-256s.  Merges that the reference
-runs asynchronously (FutureBucket on worker threads) are synchronous here;
-the observable bucket contents and hashes are the same (flagged as a perf
-item, not a semantics item).
+levelHalf / levelSize, getHash = tree of SHA-256s.
+
+Merge scheduling follows the reference's commit/prepare pipeline exactly
+(BucketLevel::commit / prepare / snap + FutureBucket): when level i−1 spills,
+level i first *commits* the merge prepared at the previous spill (which had a
+whole spill interval to run in the background) and then *prepares* a new
+future merge of its curr with the incoming snap.  Between spills the pending
+merge is invisible to the level hash — the spilled data remains visible as
+level i−1's snap — so the hash evolution is deterministic regardless of
+whether merges run synchronously (executor=None) or on a thread pool.
 """
 
 from __future__ import annotations
@@ -15,6 +21,7 @@ from typing import Iterable, List, Optional
 from ..crypto.sha import SHA256
 from ..xdr import LedgerEntry, LedgerKey
 from .bucket import Bucket, merge_buckets
+from .future import FutureBucket
 
 NUM_LEVELS = 11
 
@@ -40,52 +47,101 @@ def keep_tombstone_entries(level: int) -> bool:
 
 
 class BucketLevel:
-    __slots__ = ("curr", "snap")
+    __slots__ = ("curr", "snap", "next")
 
     def __init__(self) -> None:
         self.curr = Bucket.empty()
         self.snap = Bucket.empty()
+        self.next: Optional[FutureBucket] = None
 
     def snap_curr(self) -> Bucket:
+        """curr → snap (reference: BucketLevel::snap; the pending future is
+        untouched — it is committed by the level below's spill handling)."""
         self.snap = self.curr
         self.curr = Bucket.empty()
         return self.snap
+
+    def commit(self) -> None:
+        """Resolve the pending merge into curr (reference:
+        BucketLevel::commit)."""
+        if self.next is not None:
+            self.curr = self.next.resolve()
+            self.next = None
+
+    def prepare(self, spill: Bucket, keep_tombstones: bool,
+                protocol_version: int, executor=None) -> None:
+        """Start merging curr with the incoming spill (reference:
+        BucketLevel::prepare → FutureBucket ctor on a worker thread)."""
+        assert self.next is None, "prepare() without a prior commit()"
+        self.next = FutureBucket(self.curr, spill, keep_tombstones,
+                                 protocol_version, executor)
 
     def hash(self) -> bytes:
         return SHA256().add(self.curr.hash()).add(self.snap.hash()).finish()
 
 
 class BucketList:
-    def __init__(self) -> None:
+    def __init__(self, executor=None) -> None:
+        """executor: a concurrent.futures.Executor to run level merges in
+        the background (reference: worker-thread FutureBucket merges), or
+        None for synchronous merges — the outputs are identical either way."""
         self.levels: List[BucketLevel] = [BucketLevel() for _ in range(NUM_LEVELS)]
+        self.executor = executor
 
     def add_batch(self, ledger_seq: int, protocol_version: int,
                   init_entries: Iterable[LedgerEntry],
                   live_entries: Iterable[LedgerEntry],
                   dead_keys: Iterable[LedgerKey]) -> None:
-        """One ledger's changes enter level 0; spill boundaries cascade
-        older halves downward (reference: BucketListBase::addBatch)."""
+        """One ledger's changes enter level 0; spill boundaries snap the
+        level above, commit the previously prepared merge and prepare the
+        next one (reference: BucketListBase::addBatch)."""
         assert ledger_seq > 0
         for i in range(NUM_LEVELS - 1, 0, -1):
             if level_should_spill(ledger_seq, i - 1):
                 spill = self.levels[i - 1].snap_curr()
-                self.levels[i].curr = merge_buckets(
-                    self.levels[i].curr, spill,
-                    keep_tombstones=keep_tombstone_entries(i),
-                    protocol_version=protocol_version)
+                self.levels[i].commit()
+                self.levels[i].prepare(spill, keep_tombstone_entries(i),
+                                       protocol_version, self.executor)
         fresh = Bucket.fresh(protocol_version, init_entries, live_entries,
                              dead_keys)
-        self.levels[0].curr = merge_buckets(
-            self.levels[0].curr, fresh, keep_tombstones=True,
-            protocol_version=protocol_version)
+        # level 0 merges synchronously every ledger (reference: prepare +
+        # immediate commit — the batch is small and needed for this ledger's
+        # hash)
+        self.levels[0].prepare(fresh, True, protocol_version, None)
+        self.levels[0].commit()
 
     def hash(self) -> bytes:
         """bucketListHash in the ledger header: SHA-256 over level hashes
-        (each SHA-256(curr.hash || snap.hash))."""
+        (each SHA-256(curr.hash || snap.hash)); pending merges excluded."""
         h = SHA256()
         for lvl in self.levels:
             h.add(lvl.hash())
         return h.finish()
+
+    def resolve_all_merges(self) -> None:
+        """Block until every pending merge has an output (publish/persist
+        barrier — the HAS serializes next as a resolved output hash)."""
+        for lvl in self.levels:
+            if lvl.next is not None:
+                lvl.next.resolve()
+
+    def referenced_hashes(self) -> List[str]:
+        """Hex hashes of every bucket restart depends on — curr, snap and
+        pending-merge outputs or inputs (reference:
+        BucketManager::getAllReferencedBuckets feeding
+        forgetUnreferencedBuckets).  Never blocks on a running merge."""
+        out = []
+        for lvl in self.levels:
+            out.append(lvl.curr.hash().hex())
+            out.append(lvl.snap.hash().hex())
+            if lvl.next is not None:
+                if lvl.next.done:
+                    out.append(lvl.next.resolve().hash().hex())
+                else:
+                    curr_in, snap_in, _, _ = lvl.next.inputs
+                    out.append(curr_in.hash().hex())
+                    out.append(snap_in.hash().hex())
+        return out
 
     def buckets(self) -> List[Bucket]:
         out = []
@@ -93,6 +149,12 @@ class BucketList:
             out.append(lvl.curr)
             out.append(lvl.snap)
         return out
+
+    def snapshot(self, ledger_seq: int = 0):
+        """Immutable point-in-time view (reference:
+        SearchableBucketListSnapshot via BucketSnapshotManager)."""
+        from .snapshot import SearchableBucketListSnapshot
+        return SearchableBucketListSnapshot(self, ledger_seq)
 
     def lookup_latest(self, key_bytes: bytes) -> Optional[LedgerEntry]:
         """Newest version of a key across the list, or None if the newest

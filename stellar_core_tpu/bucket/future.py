@@ -1,0 +1,74 @@
+"""FutureBucket: a handle on an in-progress (or finished) bucket merge.
+
+Reference: src/bucket/FutureBucket.{h,cpp} — the reference runs level merges
+on worker threads and resolves the future lazily at the next spill boundary
+("commit"), so merge compute overlaps ledger closes.  The HAS serializes a
+level's pending merge as ``next``: ``{"state": 0}`` when clear or
+``{"state": 1, "output": <hex>}`` once resolved (FB_HASH_OUTPUT), which is
+how a restarted / catching-up node reconstructs the exact same future bucket
+lineage and therefore the exact same subsequent bucket-list hashes.
+
+Merges are pure functions of their inputs, so resolution order/threading
+never changes the output — sync mode (no executor) and threaded mode are
+bit-identical, which the test suite asserts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .bucket import Bucket, merge_buckets
+
+
+class FutureBucket:
+    """Either a running merge (executor future) or a resolved output.
+
+    Inputs are retained until resolution so an unresolved merge can be
+    serialized as FB_HASH_INPUTS (curr/snap hashes + merge params) in the
+    node's durable HAS — restart then re-runs the merge from inputs instead
+    of the close path having to block on resolve() every ledger."""
+
+    __slots__ = ("_future", "_output", "inputs")
+
+    def __init__(self, curr: Bucket, snap: Bucket, keep_tombstones: bool,
+                 protocol_version: int, executor=None):
+        self._output: Optional[Bucket] = None
+        self._future = None
+        self.inputs = (curr, snap, keep_tombstones, protocol_version)
+        if executor is not None:
+            self._future = executor.submit(
+                merge_buckets, curr, snap, keep_tombstones, protocol_version)
+        else:
+            self._output = merge_buckets(curr, snap, keep_tombstones,
+                                         protocol_version)
+
+    @staticmethod
+    def from_output(bucket: Bucket) -> "FutureBucket":
+        """Rehydrate a future from its serialized output hash (HAS state 1,
+        reference: FutureBucket::makeLive on the FB_HASH_OUTPUT path)."""
+        fb = FutureBucket.__new__(FutureBucket)
+        fb._future = None
+        fb._output = bucket
+        fb.inputs = None
+        return fb
+
+    @property
+    def done(self) -> bool:
+        return self._output is not None or self._future.done()
+
+    def resolve(self) -> Bucket:
+        """Block until the merge output is available and return it."""
+        if self._output is None:
+            self._output = self._future.result()
+            self._future = None
+        return self._output
+
+    def serialize(self) -> dict:
+        """The HAS `next` form (reference: FutureBucket::save): output hash
+        when already resolved, inputs otherwise — never blocks."""
+        if self.done:
+            return {"state": 1, "output": self.resolve().hash().hex()}
+        curr, snap, keep, proto = self.inputs
+        return {"state": 2, "curr": curr.hash().hex(),
+                "snap": snap.hash().hex(), "keepTombstones": keep,
+                "outputProtocol": proto}

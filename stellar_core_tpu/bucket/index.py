@@ -1,0 +1,51 @@
+"""BucketIndex: per-bucket point-lookup acceleration.
+
+Reference: src/bucket/BucketIndexImpl.{h,cpp} — the reference keeps, per
+bucket file, (a) a sorted key→offset index (individual or page-ranged) and
+(b) a binary-fuse membership filter so that the common case — "this bucket
+does not contain the key" — is answered without touching the file at all.
+
+Here buckets are in-memory sequences, so the analog is (a) the sorted
+LedgerKey-bytes array for bisection and (b) a set of 64-bit key fingerprints
+(CPython's SipHash via ``hash()``) as the membership filter.  A
+``lookup_latest`` over the 11-level list probes up to 22 buckets, of which
+at most a handful contain the key — the filter turns the other ~20 probes
+into one set lookup each instead of an O(log n) bisection over bytes keys.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import List, Optional
+
+
+class BucketIndex:
+    """Immutable index over one bucket's (sorted) entries."""
+
+    __slots__ = ("_keys", "_filter")
+
+    def __init__(self, sort_keys: List[bytes]):
+        self._keys = sort_keys
+        self._filter = frozenset(map(hash, sort_keys))
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def maybe_contains(self, key_bytes: bytes) -> bool:
+        """False ⇒ definitely absent (the fast negative path); True ⇒ must
+        bisect (no false negatives, same contract as the fuse filter)."""
+        return hash(key_bytes) in self._filter
+
+    def find(self, key_bytes: bytes) -> Optional[int]:
+        """Position of the entry with this exact LedgerKey, or None."""
+        if hash(key_bytes) not in self._filter:
+            return None
+        i = bisect_left(self._keys, key_bytes)
+        if i < len(self._keys) and self._keys[i] == key_bytes:
+            return i
+        return None
+
+    def lower_bound(self, key_bytes: bytes) -> int:
+        """First position with sort key >= key_bytes (range scans: the
+        reference's page-index getOffsetBounds analog)."""
+        return bisect_left(self._keys, key_bytes)

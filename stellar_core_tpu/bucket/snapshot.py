@@ -1,0 +1,62 @@
+"""SearchableBucketListSnapshot: immutable point-in-time ledger-state reads.
+
+Reference: src/bucket/SearchableBucketListSnapshot* + BucketSnapshotManager —
+the reference hands read-only bucket-list snapshots to threads that must not
+see (or block) the main thread's mutations: the HTTP query server
+(`getledgerentry`), background tx-validation pre-flight, and parallel apply.
+
+Buckets are immutable here, so a snapshot is just the ordered (newest-first)
+bucket references captured at construction; later ``add_batch`` calls on the
+live list never mutate what this object sees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from ..xdr import LedgerEntry, LedgerKey
+from .bucket import Bucket, _is_dead, entry_sort_key
+
+
+class SearchableBucketListSnapshot:
+    __slots__ = ("ledger_seq", "_buckets")
+
+    def __init__(self, bucket_list, ledger_seq: int = 0):
+        self.ledger_seq = ledger_seq
+        # newest-first: level 0 curr, level 0 snap, level 1 curr, ...
+        self._buckets: List[Bucket] = [b for b in bucket_list.buckets()
+                                       if not b.is_empty()]
+
+    def load(self, key) -> Optional[LedgerEntry]:
+        """Newest live version of a LedgerKey (or raw key bytes); None if
+        absent or dead."""
+        key_bytes = key if isinstance(key, bytes) else key.to_xdr()
+        for bucket in self._buckets:
+            be = bucket.find(key_bytes)
+            if be is not None:
+                return None if _is_dead(be) else be.value
+        return None
+
+    def load_keys(self, keys: Iterable) -> Dict[bytes, LedgerEntry]:
+        """Batched point loads (reference: loadKeysWithLimits); returns only
+        the keys that exist, keyed by their XDR bytes."""
+        out: Dict[bytes, LedgerEntry] = {}
+        for key in keys:
+            key_bytes = key if isinstance(key, bytes) else key.to_xdr()
+            entry = self.load(key_bytes)
+            if entry is not None:
+                out[key_bytes] = entry
+        return out
+
+    def scan(self) -> Iterable[LedgerEntry]:
+        """All live entries, newest version per key (reference: the
+        in-order full-list scans used by dump-ledger / invariants)."""
+        seen: set = set()
+        for bucket in self._buckets:
+            for be in bucket.entries:
+                kb = entry_sort_key(be)
+                if kb in seen:
+                    continue
+                seen.add(kb)
+                if not _is_dead(be):
+                    yield be.value

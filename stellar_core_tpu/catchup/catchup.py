@@ -258,10 +258,16 @@ class CatchupManager:
                 raise CatchupError(f"missing bucket {hh}")
             return b
 
+        def next_source(level: int):
+            try:
+                return has.rehydrate_next(level, archive.get_bucket)
+            except RuntimeError as e:
+                raise CatchupError(str(e)) from e
+
         from ..ledger.manager import assume_bucket_state
         try:
             mgr.root = assume_bucket_state(mgr.bucket_list, tail.header,
-                                           source)
+                                           source, next_source)
         except RuntimeError as e:
             raise CatchupError(str(e)) from e
         mgr.lcl_header = tail.header

@@ -59,6 +59,8 @@ CREATE TABLE IF NOT EXISTS peers (
     port        INTEGER NOT NULL,
     numfailures INTEGER NOT NULL DEFAULT 0,
     PRIMARY KEY (host, port));
+CREATE TABLE IF NOT EXISTS ban (
+    nodeid TEXT PRIMARY KEY);
 CREATE INDEX IF NOT EXISTS scphistory_seq ON scphistory (ledgerseq);
 """
 
@@ -238,6 +240,19 @@ class Database:
     def delete_peer(self, host: str, port: int) -> None:
         self.conn.execute("DELETE FROM peers WHERE host = ? AND port = ?",
                           (host, port))
+
+    # -- ban list (reference: BanManagerImpl's ban table) -------------------
+    def store_ban(self, node_id: bytes) -> None:
+        self.conn.execute("INSERT OR IGNORE INTO ban (nodeid) VALUES (?)",
+                          (node_id.hex(),))
+
+    def delete_ban(self, node_id: bytes) -> None:
+        self.conn.execute("DELETE FROM ban WHERE nodeid = ?",
+                          (node_id.hex(),))
+
+    def load_bans(self) -> List[bytes]:
+        return [bytes.fromhex(r[0]) for r in
+                self.conn.execute("SELECT nodeid FROM ban").fetchall()]
 
     # -- publish queue (reference: HistoryManagerImpl publishqueue table) ----
     def queue_publish(self, checkpoint_ledger: int, has_json: str) -> None:

@@ -41,8 +41,21 @@ class Upgrades:
     def __init__(self, params: Optional[UpgradeParameters] = None):
         self.params = params or UpgradeParameters()
 
-    def set_parameters(self, params: UpgradeParameters) -> None:
-        self.params = params
+    def set_parameters(self, params: Optional[UpgradeParameters]) -> None:
+        self.params = params or UpgradeParameters()
+
+    def pending_json(self) -> dict:
+        """The `/upgrades?mode=get` payload (reference:
+        CommandHandler::upgrades get mode)."""
+        p = self.params
+        return {
+            "upgradetime": p.upgrade_time,
+            "protocolversion": p.protocol_version,
+            "basefee": p.base_fee,
+            "maxtxsetsize": p.max_tx_set_size,
+            "basereserve": p.base_reserve,
+            "flags": p.flags,
+        }
 
     # ------------------------------------------------------------------
     def create_upgrades_for(self, header: X.LedgerHeader,

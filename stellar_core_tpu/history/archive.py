@@ -99,7 +99,31 @@ class HistoryArchiveState:
         self.server = server
         self.current_ledger = current_ledger
         self.network_passphrase = network_passphrase
-        self.level_hashes = level_hashes  # [{"curr": hex, "snap": hex}, ...]
+        # [{"curr": hex, "snap": hex, "next": <dict|None>}, ...] — "next" is
+        # the level's pending merge (reference: FutureBucket::save):
+        # {"state": 1, "output": hex} once resolved (FB_HASH_OUTPUT) or
+        # {"state": 2, "curr": hex, "snap": hex, keepTombstones,
+        # outputProtocol} while running (FB_HASH_INPUTS).  Restart/catchup
+        # must restore it to reproduce later bucket hashes.
+        self.level_hashes = level_hashes
+
+    @staticmethod
+    def from_bucket_list(current_ledger: int, network_passphrase: str,
+                         bucket_list,
+                         resolve: bool = True) -> "HistoryArchiveState":
+        """Snapshot a live bucket list.  resolve=True (publish path) blocks
+        until merges finish — the reference requires resolved futures in
+        published HAS files; resolve=False (per-close durable HAS) never
+        blocks and serializes running merges as inputs."""
+        if resolve:
+            bucket_list.resolve_all_merges()
+        level_hashes = [
+            {"curr": lvl.curr.hash().hex(), "snap": lvl.snap.hash().hex(),
+             "next": (lvl.next.serialize() if lvl.next is not None
+                      else None)}
+            for lvl in bucket_list.levels]
+        return HistoryArchiveState(current_ledger, network_passphrase,
+                                   level_hashes)
 
     def to_json(self) -> str:
         return json.dumps({
@@ -109,25 +133,78 @@ class HistoryArchiveState:
             "networkPassphrase": self.network_passphrase,
             "currentBuckets": [
                 {"curr": lh["curr"], "snap": lh["snap"],
-                 "next": {"state": 0}}
+                 "next": lh.get("next") or {"state": 0}}
                 for lh in self.level_hashes],
         }, indent=2)
 
     @staticmethod
     def from_json(text: str) -> "HistoryArchiveState":
         d = json.loads(text)
+        levels = []
+        for b in d["currentBuckets"]:
+            nxt = b.get("next")
+            if nxt is not None and nxt.get("state", 0) == 0:
+                nxt = None
+            levels.append({"curr": b["curr"], "snap": b["snap"],
+                           "next": nxt})
         return HistoryArchiveState(
             current_ledger=d["currentLedger"],
             network_passphrase=d.get("networkPassphrase", ""),
-            level_hashes=[{"curr": b["curr"], "snap": b["snap"]}
-                          for b in d["currentBuckets"]],
+            level_hashes=levels,
             server=d.get("server", ""))
 
     def bucket_hashes(self) -> List[str]:
+        """curr/snap hashes, 2 per level (positional: level*2 + {0,1})."""
         out = []
         for lh in self.level_hashes:
             out.append(lh["curr"])
             out.append(lh["snap"])
+        return out
+
+    def next_states(self) -> List[Optional[dict]]:
+        """Per-level pending-merge record, or None when clear."""
+        return [lh.get("next") for lh in self.level_hashes]
+
+    def rehydrate_next(self, level: int, bucket_source):
+        """Rebuild a level's FutureBucket from its serialized form
+        (reference: FutureBucket::makeLive).  bucket_source(hex) -> Bucket
+        must raise or return None for missing buckets; the all-zero hash is
+        the (perfectly valid) empty bucket."""
+        from ..bucket.bucket import Bucket
+        from ..bucket.future import FutureBucket
+
+        nxt = self.level_hashes[level].get("next")
+        if nxt is None:
+            return None
+
+        def load(hh: str) -> Bucket:
+            if hh == "0" * 64:
+                return Bucket.empty()
+            b = bucket_source(hh)
+            if b is None:
+                raise RuntimeError(f"missing bucket {hh}")
+            return b
+
+        if nxt["state"] == 1:
+            return FutureBucket.from_output(load(nxt["output"]))
+        # state 2: re-run the merge from inputs (synchronously — restart
+        # is not the hot path)
+        return FutureBucket(load(nxt["curr"]), load(nxt["snap"]),
+                            bool(nxt["keepTombstones"]),
+                            int(nxt["outputProtocol"]))
+
+    def all_bucket_hashes(self) -> List[str]:
+        """Every referenced bucket incl. next outputs/inputs (what catchup
+        must download and what GC must keep — reference:
+        HistoryArchiveState::differingBuckets scope)."""
+        out = self.bucket_hashes()
+        for nxt in self.next_states():
+            if nxt is None:
+                continue
+            if nxt["state"] == 1:
+                out.append(nxt["output"])
+            else:
+                out.extend((nxt["curr"], nxt["snap"]))
         return out
 
 

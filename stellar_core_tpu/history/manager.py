@@ -89,11 +89,11 @@ class HistoryManager:
                    if a.tx_entry.txSet.txs]
             results = [a.result_entry for a in self._pending
                        if a.result_entry.txResultSet.results]
-        level_hashes = [
-            {"curr": lvl.curr.hash().hex(), "snap": lvl.snap.hash().hex()}
-            for lvl in self.ledger_mgr.bucket_list.levels]
-        has = HistoryArchiveState(checkpoint_seq, self.network_passphrase,
-                                  level_hashes)
+        bl = self.ledger_mgr.bucket_list
+        has = HistoryArchiveState.from_bucket_list(
+            checkpoint_seq, self.network_passphrase, bl)
+        pending = [lvl.next.resolve() for lvl in bl.levels
+                   if lvl.next is not None]
         for archive in self.archives:
             archive.put_xdr_file(
                 category_path(CATEGORY_LEDGER, checkpoint_seq),
@@ -104,7 +104,7 @@ class HistoryManager:
             archive.put_xdr_file(
                 category_path(CATEGORY_RESULTS, checkpoint_seq),
                 [_THRE.pack(r) for r in results])
-            for bucket in self.ledger_mgr.bucket_list.buckets():
+            for bucket in bl.buckets() + pending:
                 if not bucket.is_empty():
                     archive.put_bucket(bucket)
             archive.put_state(has)

@@ -49,13 +49,20 @@ class ClosedLedgerArtifacts:
 
 
 def assume_bucket_state(bucket_list, header: X.LedgerHeader,
-                        bucket_source) -> LedgerTxnRoot:
+                        bucket_source, next_source=None) -> LedgerTxnRoot:
     """Fill `bucket_list`'s levels from `bucket_source(hex_hash) -> Bucket`
     and derive the authoritative entry store newest-first (first record per
     key wins; DEADENTRY shadows older versions).  Verifies the reassembled
     list against header.bucketListHash.  Shared by restart
     (loadLastKnownLedger) and catchup state assumption (ApplyBucketsWork +
-    BucketApplicator)."""
+    BucketApplicator).
+
+    next_source(level) -> Optional[FutureBucket]: the level's pending merge
+    (HAS "next", reference: FutureBucket::makeLive, usually built via
+    HistoryArchiveState.rehydrate_next) — restoring it is what makes
+    post-restore bucket hashes identical to a node that never restarted.
+    Entry derivation skips next buckets: their content is older-or-equal to
+    what curr/snap already expose."""
     from ..bucket.bucket_list import NUM_LEVELS
 
     seen: set = set()
@@ -75,6 +82,8 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
                     if kb not in seen:
                         seen.add(kb)
                         root._apply_delta({kb: be.value}, None)
+        if next_source is not None:
+            bucket_list.levels[i].next = next_source(i)
     if bucket_list.hash() != header.bucketListHash:
         raise RuntimeError("assumed bucket list hash != header hash")
     return root
@@ -85,13 +94,17 @@ _DEFAULT_INVARIANTS = object()
 
 class LedgerManager:
     def __init__(self, network_id: bytes,
-                 invariant_manager=_DEFAULT_INVARIANTS):
+                 invariant_manager=_DEFAULT_INVARIANTS,
+                 merge_executor=None):
         """invariant_manager: an InvariantManager, None to disable, or
         default = all invariants enabled (reference ships them off by
         default; this framework inverts that — fail-stop by default, opt
-        out on the hot replay path)."""
+        out on the hot replay path).
+
+        merge_executor: thread pool for background bucket merges
+        (reference: WORKER_THREADS-driven FutureBucket merges)."""
         self.network_id = network_id
-        self.bucket_list = BucketList()
+        self.bucket_list = BucketList(executor=merge_executor)
         self.root: Optional[LedgerTxnRoot] = None
         self.lcl_header: Optional[X.LedgerHeader] = None
         self.lcl_hash: Optional[bytes] = None
@@ -320,22 +333,30 @@ class LedgerManager:
 
     def _has_json(self) -> str:
         from ..history.archive import HistoryArchiveState
-        level_hashes = [{"curr": lvl.curr.hash().hex(),
-                         "snap": lvl.snap.hash().hex()}
-                        for lvl in self.bucket_list.levels]
-        return HistoryArchiveState(self.last_closed_ledger_seq,
-                                   self.network_id.hex(),
-                                   level_hashes).to_json()
+        # resolve=False: the per-close durable HAS must not block on
+        # background merges — running merges persist as inputs (state 2)
+        return HistoryArchiveState.from_bucket_list(
+            self.last_closed_ledger_seq, self.network_id.hex(),
+            self.bucket_list, resolve=False).to_json()
 
     def _persist_lcl(self) -> None:
         """Bucket files first (content-addressed, idempotent), then the
         header row + storestate pointers in one sqlite transaction — a crash
         between the two leaves only orphaned bucket files, never a DB that
-        references missing buckets."""
+        references missing buckets.  Pending merges persist without
+        blocking: resolved ones as their output, running ones as their
+        inputs (both content-addressed here)."""
         from ..database import PersistentState
         for lvl in self.bucket_list.levels:
             self.bucket_dir.save(lvl.curr)
             self.bucket_dir.save(lvl.snap)
+            if lvl.next is not None:
+                if lvl.next.done:
+                    self.bucket_dir.save(lvl.next.resolve())
+                else:
+                    curr_in, snap_in, _, _ = lvl.next.inputs
+                    self.bucket_dir.save(curr_in)
+                    self.bucket_dir.save(snap_in)
         self.db.store_header(self.lcl_hash, self.lcl_header)
         self.db.set_state(PersistentState.LAST_CLOSED_LEDGER,
                           self.lcl_hash.hex())
@@ -385,7 +406,11 @@ class LedgerManager:
                 raise RuntimeError(f"missing bucket {hashes[idx]}")
             return bucket
 
-        mgr.root = assume_bucket_state(mgr.bucket_list, header, source)
+        def next_source(level: int):
+            return has.rehydrate_next(level, bucket_dir.load)
+
+        mgr.root = assume_bucket_state(mgr.bucket_list, header, source,
+                                       next_source)
         mgr.lcl_header = header
         mgr.lcl_hash = bytes.fromhex(lcl_hex)
         mgr.db = database

@@ -55,15 +55,25 @@ class Application:
         invariants = (InvariantManager.from_patterns(config.INVARIANT_CHECKS)
                       if config.INVARIANT_CHECKS else None)
 
+        # worker pool (reference: Application::postOnBackgroundThread /
+        # WORKER_THREADS — bucket merges run here)
+        from concurrent.futures import ThreadPoolExecutor
+        self.worker_pool = (ThreadPoolExecutor(
+            max_workers=config.WORKER_THREADS,
+            thread_name_prefix="worker")
+            if config.WORKER_THREADS > 0 else None)
+
         # ledger ------------------------------------------------------------
         if self.database is not None and self.database.get_state(
                 PersistentState.LAST_CLOSED_LEDGER) is not None:
             self.lm = LedgerManager.load_last_known_ledger(
                 self.network_id, self.database, self.bucket_dir,
                 invariant_manager=invariants)
+            self.lm.bucket_list.executor = self.worker_pool
         else:
             self.lm = LedgerManager(self.network_id,
-                                    invariant_manager=invariants)
+                                    invariant_manager=invariants,
+                                    merge_executor=self.worker_pool)
             self.lm.start_new_ledger()
             if self.database is not None:
                 self.lm.enable_persistence(self.database, self.bucket_dir)
@@ -103,6 +113,10 @@ class Application:
             accel=config.ACCEL == "tpu",
             accel_chunk=config.ACCEL_CHUNK_SIZE)
 
+        # maintenance -------------------------------------------------------
+        from .maintainer import Maintainer
+        self.maintainer = Maintainer(self)
+
         # http admin --------------------------------------------------------
         self.http = None
         if config.HTTP_PORT:
@@ -129,6 +143,7 @@ class Application:
             self.herder.start()
         self._dial_known_peers()
         self._start_reconnect_timer()
+        self.maintainer.start()
         log.info("%s up: node=%s lcl=%d port=%d", VERSION,
                  self.node_secret.public_key.to_strkey()[:12],
                  self.lm.last_closed_ledger_seq,
@@ -184,6 +199,9 @@ class Application:
             self.http.stop()
         if self.transport is not None:
             self.transport.close()
+        if self.worker_pool is not None:
+            self.lm.bucket_list.resolve_all_merges()
+            self.worker_pool.shutdown(wait=True)
         if self.database is not None:
             self.database.close()
 
@@ -237,9 +255,71 @@ class Application:
             out["result_xdr"] = res.result.to_xdr().hex()
         return out
 
-    def quorum_info(self) -> dict:
+    def quorum_info(self, transitive: bool = False) -> dict:
         qmap = self.herder.quorum_map()
-        return {
+        out = {
             "node_count": len(qmap),
             "nodes": {k.hex()[:16]: (v is not None) for k, v in qmap.items()},
         }
+        if transitive:
+            from ..herder.quorum_intersection import check_intersection
+            known = {k: v for k, v in qmap.items() if v is not None}
+            if known:
+                res = check_intersection(known)
+                out["intersection"] = {
+                    "intersects": res.intersects,
+                    "node_count": len(known),
+                }
+        return out
+
+    # -- admin-endpoint backends (reference: CommandHandler actions) ---------
+    def manual_close(self) -> dict:
+        """Trigger the next consensus round immediately (reference:
+        `/manualclose` with MANUAL_CLOSE / RUN_STANDALONE)."""
+        seq = self.lm.last_closed_ledger_seq + 1
+        self.herder.trigger_next_ledger(seq)
+        return {"status": "triggered", "ledger": seq}
+
+    def connect_to(self, host: str, port: int) -> dict:
+        if self.transport is None:
+            return {"status": "ERROR", "detail": "node not listening"}
+        self.overlay.peer_manager.add_address(host, port)
+        self.transport.connect(host, port)
+        return {"status": "connecting", "peer": f"{host}:{port}"}
+
+    def drop_peer(self, node_id: bytes) -> dict:
+        peer = self.overlay.authenticated_peers.get(node_id)
+        if peer is None:
+            return {"status": "ERROR", "detail": "no such peer"}
+        peer.drop("dropped by admin")
+        return {"status": "dropped"}
+
+    def self_check(self) -> dict:
+        from .selfcheck import self_check
+        return self_check(self.lm, self.database, self.bucket_dir,
+                          self.history.archives)
+
+    def survey_node(self, node_id=None) -> dict:
+        """Start a time-sliced survey; with a node id, also request that
+        node's topology data."""
+        if self.overlay.survey._nonce is None:
+            nonce = self.overlay.survey.start_survey()
+        else:
+            nonce = self.overlay.survey._nonce
+        if node_id is not None:
+            self.overlay.survey.send_request(node_id)
+        return {"status": "surveying", "nonce": nonce}
+
+    def stop_survey(self) -> dict:
+        self.overlay.survey.stop_survey()
+        return {"status": "stopped"}
+
+    def get_ledger_entry(self, key_bytes: bytes) -> dict:
+        """`/getledgerentry` (reference: QueryServer getledgerentry) —
+        served from an immutable bucket-list snapshot."""
+        snap = self.lm.bucket_list.snapshot(self.lm.last_closed_ledger_seq)
+        entry = snap.load(key_bytes)
+        if entry is None:
+            return {"found": False, "ledger": snap.ledger_seq}
+        return {"found": True, "ledger": snap.ledger_seq,
+                "entry_xdr": entry.to_xdr().hex()}

@@ -1,8 +1,10 @@
-"""Command line: run / new-db / catchup / publish /
-check-quorum-intersection / sec-to-pub / version.
+"""Command line (reference: src/main/CommandLine.{h,cpp}).
 
-Reference: src/main/CommandLine.{h,cpp} — the stellar-core subcommand
-surface, minus the ones whose subsystems don't exist here yet.
+Subcommands: run / new-db / new-hist / catchup / publish /
+check-quorum-intersection / self-check / verify-checkpoints /
+report-last-history-checkpoint / offline-info / print-xdr / dump-xdr /
+dump-ledger / encode-asset / sign-transaction / convert-id / http-command /
+fuzz / gen-fuzz / apply-load / test / sec-to-pub / gen-seed / version.
 """
 
 from __future__ import annotations
@@ -148,6 +150,311 @@ def cmd_check_quorum_intersection(args) -> int:
     return 0 if res.intersects else 2
 
 
+def cmd_new_hist(args) -> int:
+    """Initialize the configured history archives with a genesis HAS
+    (reference: `stellar-core new-hist`)."""
+    cfg = _load_config(args)
+    if not cfg.HISTORY:
+        print("config has no HISTORY archives", file=sys.stderr)
+        return 1
+    from .application import Application
+    app = Application(cfg, listen=False)
+    for archive in app.history.archives:
+        from ..history.archive import HistoryArchiveState
+        has = HistoryArchiveState.from_bucket_list(
+            app.lm.last_closed_ledger_seq, cfg.NETWORK_PASSPHRASE,
+            app.lm.bucket_list)
+        archive.put_state(has)
+        print(f"initialized archive at {archive.root}")
+    app.stop()
+    return 0
+
+
+def cmd_self_check(args) -> int:
+    """Verify durable state integrity (reference: `stellar-core
+    self-check`)."""
+    cfg = _load_config(args)
+    from .application import Application
+    app = Application(cfg, listen=False)
+    report = app.self_check()
+    print(json.dumps(report, indent=1))
+    app.stop()
+    return 0 if report["ok"] else 1
+
+
+def cmd_verify_checkpoints(args) -> int:
+    """Verify the header hash chain of an archive (reference:
+    `stellar-core verify-checkpoints`)."""
+    from ..catchup.catchup import CatchupManager, CatchupError
+    from ..history.archive import FileHistoryArchive
+    cfg = _load_config(args) if args.conf else None
+    archive = FileHistoryArchive(args.archive)
+    has = archive.get_state()
+    if has is None:
+        print("archive has no HAS", file=sys.stderr)
+        return 1
+    nid = cfg.network_id() if cfg else b"\x00" * 32
+    cm = CatchupManager(nid, cfg.NETWORK_PASSPHRASE if cfg else "")
+    try:
+        headers = cm._read_headers(archive, has.current_ledger)
+        from ..catchup.catchup import verify_ledger_chain
+        verify_ledger_chain(headers)
+    except CatchupError as e:
+        print(f"verification FAILED: {e}", file=sys.stderr)
+        return 1
+    print(f"verified {len(headers)} headers through checkpoint "
+          f"{has.current_ledger}; tip hash {headers[-1].hash.hex()}")
+    return 0
+
+
+def cmd_report_last_history_checkpoint(args) -> int:
+    from ..history.archive import FileHistoryArchive
+    archive = FileHistoryArchive(args.archive)
+    has = archive.get_state()
+    if has is None:
+        print("archive has no HAS", file=sys.stderr)
+        return 1
+    print(has.to_json())
+    return 0
+
+
+def cmd_offline_info(args) -> int:
+    """Info from durable state without joining the network (reference:
+    `stellar-core offline-info`)."""
+    cfg = _load_config(args)
+    from .application import Application
+    app = Application(cfg, listen=False)
+    print(json.dumps({"info": app.info()}, indent=1))
+    app.stop()
+    return 0
+
+
+_XDR_TYPES = {
+    "tx-envelope": "TransactionEnvelope",
+    "tx-result": "TransactionResult",
+    "ledger-header": "LedgerHeader",
+    "ledger-entry": "LedgerEntry",
+    "scp-envelope": "SCPEnvelope",
+    "stellar-message": "StellarMessage",
+    "bucket-entry": "BucketEntry",
+}
+
+
+def _xdr_to_jsonable(val):
+    """Structural dump of any decoded XDR value (reference: XDRCereal
+    XDR→JSON printing)."""
+    import enum as _enum
+    from ..xdr import codec as C
+    if isinstance(val, bytes):
+        return val.hex()
+    if isinstance(val, _enum.IntEnum):
+        return val.name
+    if isinstance(val, (int, str, bool)) or val is None:
+        return val
+    if isinstance(val, list):
+        return [_xdr_to_jsonable(v) for v in val]
+    if hasattr(val, "_spec"):   # struct
+        return {f: _xdr_to_jsonable(getattr(val, f))
+                for f, _ in val._spec}
+    if hasattr(val, "switch"):  # union
+        return {"type": _xdr_to_jsonable(val.switch),
+                "value": _xdr_to_jsonable(val.value)}
+    return repr(val)
+
+
+def cmd_print_xdr(args) -> int:
+    """Decode one XDR value from a file (reference: `stellar-core
+    print-xdr`)."""
+    from .. import xdr as X
+    cls = getattr(X, _XDR_TYPES[args.filetype])
+    with open(args.path, "rb") as f:
+        data = f.read()
+    if args.base64:
+        import base64
+        data = base64.b64decode(data)
+    val = cls.from_xdr(data)
+    print(json.dumps(_xdr_to_jsonable(val), indent=1))
+    return 0
+
+
+def cmd_dump_xdr(args) -> int:
+    """Decode a stream of length-prefixed XDR records (an archive .xdr
+    file) (reference: `stellar-core dump-xdr`)."""
+    import gzip
+    from .. import xdr as X
+    from ..history.archive import unpack_xdr_stream
+    cls = getattr(X, _XDR_TYPES[args.filetype])
+    adapter = cls._xdr_adapter()
+    with open(args.path, "rb") as f:
+        data = f.read()
+    if args.path.endswith(".gz"):
+        data = gzip.decompress(data)
+    n = 0
+    for rec in unpack_xdr_stream(data):
+        val = adapter.unpack(rec)
+        print(json.dumps(_xdr_to_jsonable(val)))
+        n += 1
+    print(f"# {n} records", file=sys.stderr)
+    return 0
+
+
+def cmd_dump_ledger(args) -> int:
+    """Dump live ledger entries from durable state (reference:
+    `stellar-core dump-ledger`)."""
+    cfg = _load_config(args)
+    from .application import Application
+    app = Application(cfg, listen=False)
+    snap = app.lm.bucket_list.snapshot(app.lm.last_closed_ledger_seq)
+    n = 0
+    for entry in snap.scan():
+        print(json.dumps(_xdr_to_jsonable(entry)))
+        n += 1
+        if args.limit and n >= args.limit:
+            break
+    print(f"# {n} entries at ledger {snap.ledger_seq}", file=sys.stderr)
+    app.stop()
+    return 0
+
+
+def cmd_encode_asset(args) -> int:
+    """Print the XDR of an asset (reference: `stellar-core encode-asset`)."""
+    from .. import xdr as X
+    from ..crypto.keys import PublicKey
+    if args.code is None:
+        asset = X.Asset.native()
+    else:
+        if not args.issuer:
+            print("--issuer is required with --code", file=sys.stderr)
+            return 1
+        from ..testutils import make_asset
+        issuer = X.AccountID.ed25519(
+            PublicKey.from_strkey(args.issuer).ed25519)
+        asset = make_asset(args.code, issuer)
+    print(asset.to_xdr().hex())
+    return 0
+
+
+def cmd_sign_transaction(args) -> int:
+    """Add a signature to a transaction-envelope XDR file; the seed comes
+    from stdin (reference: `stellar-core sign-transaction`)."""
+    from .. import xdr as X
+    from ..crypto.keys import SecretKey
+    from ..crypto.sha import sha256
+    from ..transactions.frame import TransactionFrame
+    with open(args.path, "rb") as f:
+        env = X.TransactionEnvelope.from_xdr(f.read())
+    seed = sys.stdin.readline().strip()
+    sk = SecretKey.from_strkey_seed(seed)
+    nid = sha256(args.netid.encode())
+    frame = TransactionFrame(nid, env)
+    env.value.signatures.append(X.DecoratedSignature(
+        hint=sk.public_key.hint(),
+        signature=sk.sign(frame.content_hash())))
+    out = env.to_xdr()
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(out)
+    else:
+        print(out.hex())
+    return 0
+
+
+def cmd_convert_id(args) -> int:
+    """Print every representation of a node/account id (reference:
+    `stellar-core convert-id`)."""
+    from ..crypto.keys import PublicKey
+    ident = args.ident
+    raw = None
+    if ident.startswith("G") and len(ident) == 56:
+        raw = PublicKey.from_strkey(ident).ed25519
+    else:
+        try:
+            raw = bytes.fromhex(ident)
+        except ValueError:
+            pass
+    if raw is None or len(raw) != 32:
+        print("unrecognized id (want G... strkey or 64 hex chars)",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "hex": raw.hex(),
+        "strkey": PublicKey(raw).to_strkey(),
+    }, indent=1))
+    return 0
+
+
+def cmd_http_command(args) -> int:
+    """Send a command to a running node's admin port (reference:
+    `stellar-core http-command`)."""
+    import urllib.request
+    cfg = _load_config(args)
+    cmd = args.cmd if args.cmd.startswith("/") else "/" + args.cmd
+    url = f"http://127.0.0.1:{cfg.HTTP_PORT}{cmd}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        print(resp.read().decode())
+    return 0
+
+
+def cmd_fuzz(args) -> int:
+    """Run a deterministic fuzz campaign (reference: `stellar-core fuzz`
+    over FuzzerImpl)."""
+    from ..fuzz import OverlayFuzzer, TransactionFuzzer, fuzz_xdr_roundtrip
+    if args.mode == "tx":
+        crashes = TransactionFuzzer(seed=args.seed).run(args.iters)
+    elif args.mode == "overlay":
+        crashes = OverlayFuzzer(seed=args.seed).run(args.iters)
+    else:
+        crashes = fuzz_xdr_roundtrip(seed=args.seed, iters=args.iters)
+    print(f"{args.mode} fuzz: {args.iters} cases, {len(crashes)} findings")
+    for c in crashes[:20]:
+        print(f"  {c}")
+    return 1 if crashes else 0
+
+
+def cmd_gen_fuzz(args) -> int:
+    """Write a seed corpus of random XDR inputs (reference: `stellar-core
+    gen-fuzz`)."""
+    import os
+    import random as _random
+    from .. import xdr as X
+    from ..fuzz import random_xdr_value
+    os.makedirs(args.output, exist_ok=True)
+    rng = _random.Random(args.seed)
+    cls = {"tx": X.TransactionEnvelope,
+           "overlay": X.StellarMessage}[args.mode]
+    n = 0
+    for i in range(args.count):
+        val = random_xdr_value(cls, rng)
+        try:
+            blob = val.to_xdr()
+        except Exception:
+            continue
+        with open(os.path.join(args.output,
+                               f"fuzz-{args.mode}-{i:04d}.xdr"), "wb") as f:
+            f.write(blob)
+        n += 1
+    print(f"wrote {n} corpus files to {args.output}")
+    return 0
+
+
+def cmd_apply_load(args) -> int:
+    """Max-TPS apply benchmark without consensus (reference:
+    `stellar-core apply-load` / ApplyLoad)."""
+    from ..simulation.apply_load import ApplyLoad
+    al = ApplyLoad(n_accounts=args.accounts)
+    report = al.run(n_ledgers=args.ledgers, txs_per_ledger=args.txs)
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+def cmd_test(args) -> int:
+    """Run the test suite (reference: `stellar-core test` — Catch2 in the
+    binary; here it delegates to pytest on the repo's tests/)."""
+    import subprocess
+    cmd = [sys.executable, "-m", "pytest"] + (args.pytest_args or ["-q"])
+    return subprocess.call(cmd)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="stellar-core-tpu",
@@ -179,6 +486,94 @@ def main(argv=None) -> int:
     s.add_argument("json_path")
     s.set_defaults(fn=cmd_check_quorum_intersection)
 
+    s = sub.add_parser("new-hist", help="initialize history archives")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_new_hist)
+
+    s = sub.add_parser("self-check", help="verify durable state integrity")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_self_check)
+
+    s = sub.add_parser("verify-checkpoints",
+                       help="verify an archive's header hash chain")
+    s.add_argument("--archive", required=True)
+    s.add_argument("--conf", default="")
+    s.set_defaults(fn=cmd_verify_checkpoints)
+
+    s = sub.add_parser("report-last-history-checkpoint",
+                       help="print an archive's HAS")
+    s.add_argument("--archive", required=True)
+    s.set_defaults(fn=cmd_report_last_history_checkpoint)
+
+    s = sub.add_parser("offline-info", help="node info from durable state")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_offline_info)
+
+    s = sub.add_parser("print-xdr", help="decode one XDR value from a file")
+    s.add_argument("path")
+    s.add_argument("--filetype", choices=sorted(_XDR_TYPES),
+                   default="tx-envelope")
+    s.add_argument("--base64", action="store_true")
+    s.set_defaults(fn=cmd_print_xdr)
+
+    s = sub.add_parser("dump-xdr", help="decode an XDR stream file")
+    s.add_argument("path")
+    s.add_argument("--filetype", choices=sorted(_XDR_TYPES),
+                   default="ledger-header")
+    s.set_defaults(fn=cmd_dump_xdr)
+
+    s = sub.add_parser("dump-ledger", help="dump live ledger entries")
+    s.add_argument("--conf", required=True)
+    s.add_argument("--limit", type=int, default=0)
+    s.set_defaults(fn=cmd_dump_ledger)
+
+    s = sub.add_parser("encode-asset", help="print an asset's XDR")
+    s.add_argument("--code", default=None)
+    s.add_argument("--issuer", default=None)
+    s.set_defaults(fn=cmd_encode_asset)
+
+    s = sub.add_parser("sign-transaction",
+                       help="sign a tx-envelope XDR file (seed on stdin)")
+    s.add_argument("path")
+    s.add_argument("--netid", required=True,
+                   help="network passphrase")
+    s.add_argument("--output", default="")
+    s.set_defaults(fn=cmd_sign_transaction)
+
+    s = sub.add_parser("convert-id", help="print id representations")
+    s.add_argument("ident")
+    s.set_defaults(fn=cmd_convert_id)
+
+    s = sub.add_parser("http-command",
+                       help="send a command to a running node")
+    s.add_argument("cmd")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_http_command)
+
+    s = sub.add_parser("fuzz", help="run a deterministic fuzz campaign")
+    s.add_argument("--mode", choices=["tx", "overlay", "xdr"], default="tx")
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--iters", type=int, default=500)
+    s.set_defaults(fn=cmd_fuzz)
+
+    s = sub.add_parser("gen-fuzz", help="write a fuzz seed corpus")
+    s.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    s.add_argument("--output", required=True)
+    s.add_argument("--count", type=int, default=100)
+    s.add_argument("--seed", type=int, default=0)
+    s.set_defaults(fn=cmd_gen_fuzz)
+
+    s = sub.add_parser("apply-load",
+                       help="max-TPS apply benchmark (no consensus)")
+    s.add_argument("--accounts", type=int, default=1000)
+    s.add_argument("--ledgers", type=int, default=20)
+    s.add_argument("--txs", type=int, default=200)
+    s.set_defaults(fn=cmd_apply_load)
+
+    s = sub.add_parser("test", help="run the test suite (pytest)")
+    s.add_argument("pytest_args", nargs="*")
+    s.set_defaults(fn=cmd_test)
+
     s = sub.add_parser("sec-to-pub", help="seed strkey -> public strkey")
     s.add_argument("seed", help="S... seed, or - to read from stdin")
     s.set_defaults(fn=cmd_sec_to_pub)
@@ -190,4 +585,11 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_version)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into a consumer that closed early (| head) — exit
+        # quietly like any well-behaved unix tool
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0

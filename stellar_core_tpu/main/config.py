@@ -53,6 +53,7 @@ class Config:
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
     LOG_LEVEL: str = "INFO"
+    WORKER_THREADS: int = 4                  # background bucket merges
 
     # -- derived -------------------------------------------------------------
     def network_id(self) -> bytes:
@@ -93,7 +94,7 @@ class Config:
             "BUCKET_DIR_PATH", "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
-            "ACCEL_CHUNK_SIZE", "LOG_LEVEL",
+            "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "WORKER_THREADS",
         }
         for key, val in raw.items():
             if key in simple:

@@ -1,10 +1,14 @@
-"""HTTP admin endpoint: /info /metrics /quorum /peers /tx /scp.
+"""HTTP admin endpoints (reference: src/main/CommandHandler.{h,cpp}).
 
-Reference: src/main/CommandHandler.{h,cpp} over lib/httpthreaded — the
-admin server runs on its own threads and marshals work onto the main
-thread.  Here a ThreadingHTTPServer serves reads directly (GIL-atomic
-snapshots of plain dicts) and marshals /tx submission onto the clock's
-action queue, waiting for the main crank loop to process it.
+Full surface: /info /metrics /quorum /peers /tx /scp /ll /logrotate
+/manualclose /bans /unban /connect /droppeer /maintenance /clearmetrics
+/self-check /upgrades /surveytopologytimesliced /getsurveyresult
+/getledgerentry.
+
+The admin server runs on its own threads and marshals work onto the main
+thread: a ThreadingHTTPServer serves reads directly (GIL-atomic snapshots
+of plain dicts) and marshals mutations (/tx, /manualclose, /connect, ...)
+onto the clock's action queue, waiting for the main crank loop.
 """
 
 from __future__ import annotations
@@ -44,20 +48,29 @@ class CommandHandler:
             self.server = None
 
     # ------------------------------------------------------------------
-    def _submit_tx_on_main(self, blob: bytes) -> dict:
-        """Marshal tx submission onto the clock loop and wait (reference:
+    def _on_main(self, fn, name: str = "http-cmd") -> dict:
+        """Marshal a mutation onto the clock loop and wait (reference:
         CommandHandler routes through the app's main thread)."""
         done = threading.Event()
         result: dict = {}
 
         def work() -> None:
-            result.update(self.app.submit_tx(blob))
+            try:
+                out = fn()
+                if isinstance(out, dict):
+                    result.update(out)
+            except Exception as e:
+                result.update({"status": "ERROR", "detail": str(e)})
             done.set()
 
-        self.app.clock.post_action(work, name="http-tx")
+        self.app.clock.post_action(work, name=name)
         if not done.wait(timeout=10.0):
             return {"status": "ERROR", "detail": "timed out"}
         return result
+
+    def _submit_tx_on_main(self, blob: bytes) -> dict:
+        return self._on_main(lambda: self.app.submit_tx(blob),
+                             name="http-tx")
 
     def _make_handler(self):
         handler_self = self
@@ -95,7 +108,10 @@ class CommandHandler:
                     elif url.path == "/metrics":
                         self._reply({"metrics": self._snap(app.metrics)})
                     elif url.path == "/quorum":
-                        self._reply(self._snap(app.quorum_info))
+                        transitive = parse_qs(url.query).get(
+                            "transitive", ["false"])[0] == "true"
+                        self._reply(self._snap(
+                            lambda: app.quorum_info(transitive)))
                     elif url.path == "/peers":
                         self._reply({"authenticated": self._snap(
                             lambda: [p.hex() for p in
@@ -115,12 +131,134 @@ class CommandHandler:
                                          "detail": "blob must be hex"}, 400)
                             return
                         self._reply(handler_self._submit_tx_on_main(raw))
+                    elif url.path == "/ll":
+                        self._log_level(parse_qs(url.query))
+                    elif url.path == "/logrotate":
+                        from ..util import logging as slog2
+                        slog2.rotate()
+                        self._reply({"status": "rotated"})
+                    elif url.path == "/manualclose":
+                        self._reply(handler_self._on_main(
+                            lambda: app.manual_close(), name="manualclose"))
+                    elif url.path == "/bans":
+                        self._reply({"bans": [n.hex() for n in
+                                     app.overlay.ban_manager.banned_nodes()]})
+                    elif url.path == "/unban":
+                        # marshalled: the ban table lives in the main
+                        # thread's sqlite connection
+                        nid = bytes.fromhex(
+                            parse_qs(url.query).get("node", [""])[0])
+                        out = handler_self._on_main(
+                            lambda: app.overlay.ban_manager.unban_node(nid),
+                            name="unban")
+                        self._reply(out or {"status": "unbanned"})
+                    elif url.path == "/ban":
+                        nid = bytes.fromhex(
+                            parse_qs(url.query).get("node", [""])[0])
+                        out = handler_self._on_main(
+                            lambda: app.overlay.ban_manager.ban_node(nid),
+                            name="ban")
+                        self._reply(out or {"status": "banned"})
+                    elif url.path == "/connect":
+                        qs = parse_qs(url.query)
+                        host = qs.get("peer", [""])[0]
+                        port = int(qs.get("port", ["11625"])[0])
+                        self._reply(handler_self._on_main(
+                            lambda: app.connect_to(host, port),
+                            name="connect"))
+                    elif url.path == "/droppeer":
+                        nid = parse_qs(url.query).get("node", [""])[0]
+                        self._reply(handler_self._on_main(
+                            lambda: app.drop_peer(bytes.fromhex(nid)),
+                            name="droppeer"))
+                    elif url.path == "/maintenance":
+                        self._reply(handler_self._on_main(
+                            app.maintainer.perform_maintenance,
+                            name="maintenance"))
+                    elif url.path == "/clearmetrics":
+                        from ..util.metrics import registry
+                        registry().clear()
+                        self._reply({"status": "cleared"})
+                    elif url.path == "/self-check":
+                        self._reply(handler_self._on_main(
+                            app.self_check, name="self-check"))
+                    elif url.path == "/upgrades":
+                        self._upgrades(parse_qs(url.query))
+                    elif url.path == "/surveytopologytimesliced":
+                        qs = parse_qs(url.query)
+                        node = qs.get("node", [""])[0]
+                        self._reply(handler_self._on_main(
+                            lambda: app.survey_node(
+                                bytes.fromhex(node) if node else None),
+                            name="survey"))
+                    elif url.path == "/stopsurvey":
+                        self._reply(handler_self._on_main(
+                            lambda: app.stop_survey(), name="stopsurvey"))
+                    elif url.path == "/getsurveyresult":
+                        self._reply(self._snap(app.overlay.survey.results))
+                    elif url.path == "/getledgerentry":
+                        # marshalled: snapshot construction must not race
+                        # add_batch's spill window on the main thread
+                        key = bytes.fromhex(
+                            parse_qs(url.query).get("key", [""])[0])
+                        self._reply(handler_self._on_main(
+                            lambda: app.get_ledger_entry(key),
+                            name="getledgerentry"))
                     else:
                         self._reply({"error": "unknown endpoint",
-                                     "endpoints": ["/info", "/metrics",
-                                                   "/quorum", "/peers",
-                                                   "/scp", "/tx"]}, 404)
+                                     "endpoints": sorted(_ENDPOINTS)}, 404)
                 except Exception as e:  # admin surface must never crash
                     self._reply({"error": str(e)}, 500)
 
+            def _log_level(self, qs) -> None:
+                from ..util import logging as slog2
+                level = qs.get("level", [None])[0]
+                partition = qs.get("partition", [None])[0]
+                if level is None:
+                    self._reply({"levels": slog2.current_levels()})
+                    return
+                slog2.set_level(level.upper(), partition)
+                self._reply({"status": "ok", "partition": partition or "all",
+                             "level": level.upper()})
+
+            def _upgrades(self, qs) -> None:
+                app = handler_self.app
+                mode = qs.get("mode", ["get"])[0]
+                if mode == "get":
+                    self._reply(self._snap(
+                        lambda: app.herder.upgrades.pending_json()))
+                elif mode == "clear":
+                    out = handler_self._on_main(
+                        lambda: app.herder.upgrades.set_parameters(None),
+                        name="upgrades-clear")
+                    self._reply(out or {"status": "cleared"})
+                elif mode == "set":
+                    from ..herder.upgrades import UpgradeParameters
+                    params = UpgradeParameters(
+                        upgrade_time=int(qs.get("upgradetime", ["0"])[0]),
+                        protocol_version=(
+                            int(qs["protocolversion"][0])
+                            if "protocolversion" in qs else None),
+                        base_fee=(int(qs["basefee"][0])
+                                  if "basefee" in qs else None),
+                        max_tx_set_size=(int(qs["maxtxsetsize"][0])
+                                         if "maxtxsetsize" in qs else None),
+                        base_reserve=(int(qs["basereserve"][0])
+                                      if "basereserve" in qs else None))
+                    out = handler_self._on_main(
+                        lambda: app.herder.upgrades.set_parameters(params),
+                        name="upgrades-set")
+                    self._reply(out or {"status": "set"})
+                else:
+                    self._reply({"error": f"bad mode {mode}"}, 400)
+
         return Handler
+
+
+_ENDPOINTS = [
+    "/info", "/metrics", "/quorum", "/peers", "/scp", "/tx", "/ll",
+    "/logrotate", "/manualclose", "/bans", "/ban", "/unban", "/connect",
+    "/droppeer", "/maintenance", "/clearmetrics", "/self-check",
+    "/upgrades", "/surveytopologytimesliced", "/stopsurvey",
+    "/getsurveyresult", "/getledgerentry",
+]

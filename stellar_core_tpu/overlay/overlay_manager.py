@@ -18,10 +18,12 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..util import logging as slog
 from ..util.metrics import registry as _registry
+from .ban import BanManager
 from .flood import Floodgate, ItemFetcher, TxAdverts
 from .peer import Peer
 from .peer_auth import PeerAuth
 from .peer_manager import PeerManager
+from .survey import SurveyManager
 
 log = slog.get("Overlay")
 
@@ -45,6 +47,8 @@ class OverlayManager:
         self.floodgate = Floodgate()
         self.adverts = TxAdverts(self._send_advert, self._send_demand)
         self.fetcher = ItemFetcher(self._ask_for_item)
+        self.ban_manager = BanManager(database)
+        self.survey = SurveyManager(self, node_secret)
         self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0}
 
         # herder wiring (same seams the in-process simulation uses)
@@ -77,8 +81,12 @@ class OverlayManager:
         self.pending_peers.append(peer)
 
     def _peer_authenticated(self, peer: Peer) -> None:
+        if self.ban_manager.is_banned(peer.peer_id):
+            peer.drop("banned node")
+            return
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
+        self.survey.record_added_peer()
         old = self.authenticated_peers.get(peer.peer_id)
         if old is not None and old is not peer:
             # simultaneous cross-connections: both sides must pick the SAME
@@ -114,6 +122,8 @@ class OverlayManager:
     def _peer_dropped(self, peer: Peer) -> None:
         _registry().counter("overlay.peer.drop").inc()
         self.stats["dropped_peers"] += 1
+        if peer.is_authenticated():
+            self.survey.record_dropped_peer()
         # outbound dials that never authenticated feed the backoff policy
         dial = getattr(peer, "dial_addr", None)
         if dial is not None and peer.we_called_remote \
@@ -237,8 +247,35 @@ class OverlayManager:
                 self.peer_manager.peers_to_send()))
         elif t == MT.PEERS:
             self.peer_manager.add_peer_addresses(msg.value)
+        elif t in (MT.TIME_SLICED_SURVEY_REQUEST,
+                   MT.TIME_SLICED_SURVEY_RESPONSE,
+                   MT.TIME_SLICED_SURVEY_START_COLLECTING,
+                   MT.TIME_SLICED_SURVEY_STOP_COLLECTING):
+            self._recv_survey(peer, msg)
         else:
             log.warning("unhandled message type %s", t)
+
+    def _recv_survey(self, peer: Peer, msg: X.StellarMessage) -> None:
+        """Dedup + dispatch to the SurveyManager; relay when the handler
+        accepts the message (reference: Peer::recvSurvey* →
+        SurveyManager::relayOrProcess...)."""
+        h = sha256(msg.to_xdr())
+        if not self.floodgate.add_record(
+                h, self.herder.tracking_consensus_ledger_index(), peer):
+            self.stats["deduped"] += 1
+            return
+        t = msg.switch
+        MT = X.MessageType
+        handler = {
+            MT.TIME_SLICED_SURVEY_REQUEST: self.survey.recv_request,
+            MT.TIME_SLICED_SURVEY_RESPONSE: self.survey.recv_response,
+            MT.TIME_SLICED_SURVEY_START_COLLECTING:
+                self.survey.recv_start_collecting,
+            MT.TIME_SLICED_SURVEY_STOP_COLLECTING:
+                self.survey.recv_stop_collecting,
+        }[t]
+        if handler(peer, msg.value):
+            self._broadcast(msg, h)
 
     def _recv_scp(self, peer: Peer, msg: X.StellarMessage) -> None:
         env = msg.value

@@ -1,8 +1,9 @@
 """LoadGenerator: synthetic traffic for tests and benchmarks.
 
 Reference: src/simulation/LoadGenerator.{h,cpp} — modes: create accounts /
-pay (we add per-ledger batching identical in spirit to generateLoad's
-txrate pacing, minus the timer loop: callers drive ledgers explicitly).
+pay / pretend (we add per-ledger batching identical in spirit to
+generateLoad's txrate pacing, minus the timer loop: callers drive ledgers
+explicitly).  Soroban modes are out of scope (SURVEY.md §2.4).
 """
 
 from __future__ import annotations
@@ -70,6 +71,35 @@ class LoadGenerator:
                 amount = self.rng.randrange(1, 1_000_000)
                 frames.append(src.tx([native_payment_op(dst.account_id,
                                                         amount)]))
+            self._close(frames)
+
+    def pretend_ledgers(self, n_ledgers: int, txs_per_ledger: int = 20,
+                        ops_per_tx: int = 3) -> None:
+        """'Pretend' mode: load-shaped but state-light traffic — each tx
+        carries benign ManageData/BumpSequence ops (reference: LoadGenerator
+        LOAD_PRETEND mode's setOptions/manageData fillers)."""
+        assert self.accounts, "create accounts first"
+        for _ in range(n_ledgers):
+            frames = []
+            for _ in range(txs_per_ledger):
+                src = self.rng.choice(self.accounts)
+                ops = []
+                for k in range(ops_per_tx):
+                    if self.rng.random() < 0.5:
+                        name = f"pretend-{self.rng.randrange(4)}"
+                        ops.append(X.Operation(
+                            sourceAccount=None,
+                            body=X.OperationBody.manageDataOp(
+                                X.ManageDataOp(
+                                    dataName=name.encode(),
+                                    dataValue=bytes([self.rng.randrange(
+                                        256)]) * 8))))
+                    else:
+                        ops.append(X.Operation(
+                            sourceAccount=None,
+                            body=X.OperationBody.bumpSequenceOp(
+                                X.BumpSequenceOp(bumpTo=0))))
+                frames.append(src.tx(ops))
             self._close(frames)
 
     def run_to_checkpoint_boundary(self) -> None:

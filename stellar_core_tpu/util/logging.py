@@ -11,7 +11,7 @@ from typing import Dict
 PARTITIONS = (
     "Fs", "SCP", "Bucket", "Overlay", "History", "Ledger", "Herder", "Tx",
     "Database", "Process", "Work", "Invariant", "Perf", "Main",
-    "CommandHandler",
+    "CommandHandler", "Fuzz",
 )
 
 _loggers: Dict[str, logging.Logger] = {}
@@ -48,3 +48,24 @@ def set_level(level: str, partition: str | None = None) -> None:
         logging.getLogger("stellar").setLevel(lvl)
     else:
         get(partition).setLevel(lvl)
+
+
+def current_levels() -> dict:
+    """Effective level per partition (reference: /ll with no args)."""
+    _configure()
+    out = {"(root)": logging.getLevelName(
+        logging.getLogger("stellar").getEffectiveLevel())}
+    for p in PARTITIONS:
+        out[p] = logging.getLevelName(get(p).getEffectiveLevel())
+    return out
+
+
+def rotate() -> None:
+    """Close+reopen file handlers (reference: /logrotate).  Stream handlers
+    have nothing to rotate; file handlers re-open their path so an external
+    rotator can move the old file first."""
+    _configure()
+    for h in logging.getLogger("stellar").handlers:
+        if isinstance(h, logging.FileHandler):
+            h.close()
+            h.stream = h._open()

@@ -123,6 +123,10 @@ class MetricsRegistry:
         return {k: m.snapshot() for k, m in sorted(self._metrics.items())
                 if prefix is None or k.startswith(prefix)}
 
+    def clear(self) -> None:
+        """Drop all recorded metrics (reference: /clearmetrics)."""
+        self._metrics.clear()
+
 
 _registry = MetricsRegistry()
 

@@ -3,8 +3,8 @@ Stellar-overlay.x) — the P2P wire protocol: HELLO/AUTH handshake types,
 flood adverts/demands, item fetch, flow control and the authenticated
 message envelope."""
 
-from .codec import (Int32, Opaque, Uint32, Uint64, VarArray, XdrString,
-                    xdr_enum, xdr_struct, xdr_union)
+from .codec import (Int32, Opaque, Uint32, Uint64, VarArray, VarOpaque,
+                    XdrString, xdr_enum, xdr_struct, xdr_union)
 from .types import Hash, NodeID, Signature, Uint256
 
 ErrorCode = xdr_enum("ErrorCode", {
@@ -83,6 +83,10 @@ MessageType = xdr_enum("MessageType", {
     "FLOOD_ADVERT": 18,
     "FLOOD_DEMAND": 19,
     "SEND_MORE_EXTENDED": 20,
+    "TIME_SLICED_SURVEY_REQUEST": 21,
+    "TIME_SLICED_SURVEY_RESPONSE": 22,
+    "TIME_SLICED_SURVEY_START_COLLECTING": 23,
+    "TIME_SLICED_SURVEY_STOP_COLLECTING": 24,
 })
 
 DontHave = xdr_struct("DontHave", [
@@ -111,6 +115,146 @@ FloodDemand = xdr_struct("FloodDemand", [
 ])
 
 
+# -- time-sliced network survey (reference: Stellar-overlay.x survey types +
+# src/overlay/SurveyManager) -------------------------------------------------
+
+SurveyMessageCommandType = xdr_enum("SurveyMessageCommandType", {
+    "TIME_SLICED_SURVEY_TOPOLOGY": 1,
+})
+
+SurveyMessageResponseType = xdr_enum("SurveyMessageResponseType", {
+    "SURVEY_TOPOLOGY_RESPONSE_V2": 2,
+})
+
+SurveyRequestMessage = xdr_struct("SurveyRequestMessage", [
+    ("surveyorPeerID", NodeID),
+    ("surveyedPeerID", NodeID),
+    ("ledgerNum", Uint32),
+    ("encryptionKey", Curve25519Public),
+    ("commandType", SurveyMessageCommandType),
+], defaults={"commandType":
+             SurveyMessageCommandType.TIME_SLICED_SURVEY_TOPOLOGY})
+
+TimeSlicedSurveyRequestMessage = xdr_struct("TimeSlicedSurveyRequestMessage", [
+    ("request", SurveyRequestMessage),
+    ("nonce", Uint32),
+    ("inboundPeersIndex", Uint32),
+    ("outboundPeersIndex", Uint32),
+], defaults={"inboundPeersIndex": 0, "outboundPeersIndex": 0})
+
+SignedTimeSlicedSurveyRequestMessage = xdr_struct(
+    "SignedTimeSlicedSurveyRequestMessage", [
+        ("requestSignature", Signature),
+        ("request", TimeSlicedSurveyRequestMessage),
+    ])
+
+EncryptedBody = VarOpaque(64000)
+
+SurveyResponseMessage = xdr_struct("SurveyResponseMessage", [
+    ("surveyorPeerID", NodeID),
+    ("surveyedPeerID", NodeID),
+    ("ledgerNum", Uint32),
+    ("commandType", SurveyMessageCommandType),
+    ("encryptedBody", EncryptedBody),
+], defaults={"commandType":
+             SurveyMessageCommandType.TIME_SLICED_SURVEY_TOPOLOGY})
+
+TimeSlicedSurveyResponseMessage = xdr_struct(
+    "TimeSlicedSurveyResponseMessage", [
+        ("response", SurveyResponseMessage),
+        ("nonce", Uint32),
+    ])
+
+SignedTimeSlicedSurveyResponseMessage = xdr_struct(
+    "SignedTimeSlicedSurveyResponseMessage", [
+        ("responseSignature", Signature),
+        ("response", TimeSlicedSurveyResponseMessage),
+    ])
+
+TimeSlicedSurveyStartCollectingMessage = xdr_struct(
+    "TimeSlicedSurveyStartCollectingMessage", [
+        ("surveyorID", NodeID),
+        ("nonce", Uint32),
+        ("ledgerNum", Uint32),
+    ])
+
+SignedTimeSlicedSurveyStartCollectingMessage = xdr_struct(
+    "SignedTimeSlicedSurveyStartCollectingMessage", [
+        ("signature", Signature),
+        ("startCollecting", TimeSlicedSurveyStartCollectingMessage),
+    ])
+
+TimeSlicedSurveyStopCollectingMessage = xdr_struct(
+    "TimeSlicedSurveyStopCollectingMessage", [
+        ("surveyorID", NodeID),
+        ("nonce", Uint32),
+        ("ledgerNum", Uint32),
+    ])
+
+SignedTimeSlicedSurveyStopCollectingMessage = xdr_struct(
+    "SignedTimeSlicedSurveyStopCollectingMessage", [
+        ("signature", Signature),
+        ("stopCollecting", TimeSlicedSurveyStopCollectingMessage),
+    ])
+
+PeerStats = xdr_struct("PeerStats", [
+    ("id", NodeID),
+    ("versionStr", XdrString(100)),
+    ("messagesRead", Uint64),
+    ("messagesWritten", Uint64),
+    ("bytesRead", Uint64),
+    ("bytesWritten", Uint64),
+    ("secondsConnected", Uint64),
+    ("uniqueFloodBytesRecv", Uint64),
+    ("duplicateFloodBytesRecv", Uint64),
+    ("uniqueFetchBytesRecv", Uint64),
+    ("duplicateFetchBytesRecv", Uint64),
+    ("uniqueFloodMessageRecv", Uint64),
+    ("duplicateFloodMessageRecv", Uint64),
+    ("uniqueFetchMessageRecv", Uint64),
+    ("duplicateFetchMessageRecv", Uint64),
+], defaults={k: 0 for k in (
+    "messagesRead", "messagesWritten", "bytesRead", "bytesWritten",
+    "secondsConnected", "uniqueFloodBytesRecv", "duplicateFloodBytesRecv",
+    "uniqueFetchBytesRecv", "duplicateFetchBytesRecv",
+    "uniqueFloodMessageRecv", "duplicateFloodMessageRecv",
+    "uniqueFetchMessageRecv", "duplicateFetchMessageRecv")})
+
+TimeSlicedPeerData = xdr_struct("TimeSlicedPeerData", [
+    ("peerStats", PeerStats),
+    ("averageLatencyMs", Uint32),
+], defaults={"averageLatencyMs": 0})
+
+TimeSlicedNodeData = xdr_struct("TimeSlicedNodeData", [
+    ("addedAuthenticatedPeers", Uint32),
+    ("droppedAuthenticatedPeers", Uint32),
+    ("totalInboundPeerCount", Uint32),
+    ("totalOutboundPeerCount", Uint32),
+    ("p75SCPFirstToSelfLatencyMs", Uint32),
+    ("p75SCPSelfToOtherLatencyMs", Uint32),
+    ("lostSyncCount", Uint32),
+    ("isValidator", Uint32),
+    ("maxInboundPeerCount", Uint32),
+    ("maxOutboundPeerCount", Uint32),
+], defaults={k: 0 for k in (
+    "addedAuthenticatedPeers", "droppedAuthenticatedPeers",
+    "totalInboundPeerCount", "totalOutboundPeerCount",
+    "p75SCPFirstToSelfLatencyMs", "p75SCPSelfToOtherLatencyMs",
+    "lostSyncCount", "isValidator", "maxInboundPeerCount",
+    "maxOutboundPeerCount")})
+
+TopologyResponseBodyV2 = xdr_struct("TopologyResponseBodyV2", [
+    ("inboundPeers", VarArray(TimeSlicedPeerData, 25)),
+    ("outboundPeers", VarArray(TimeSlicedPeerData, 25)),
+    ("nodeData", TimeSlicedNodeData),
+])
+
+SurveyResponseBody = xdr_union("SurveyResponseBody", SurveyMessageResponseType, {
+    SurveyMessageResponseType.SURVEY_TOPOLOGY_RESPONSE_V2:
+        ("topologyResponseBodyV2", TopologyResponseBodyV2),
+})
+
+
 def _build_stellar_message():
     # deferred imports dodge a cycle: transaction.py imports nothing from
     # here, but xdr/__init__ imports both
@@ -137,6 +281,18 @@ def _build_stellar_message():
                                          SendMoreExtended),
         MessageType.FLOOD_ADVERT: ("floodAdvert", FloodAdvert),
         MessageType.FLOOD_DEMAND: ("floodDemand", FloodDemand),
+        MessageType.TIME_SLICED_SURVEY_REQUEST:
+            ("signedTimeSlicedSurveyRequestMessage",
+             SignedTimeSlicedSurveyRequestMessage),
+        MessageType.TIME_SLICED_SURVEY_RESPONSE:
+            ("signedTimeSlicedSurveyResponseMessage",
+             SignedTimeSlicedSurveyResponseMessage),
+        MessageType.TIME_SLICED_SURVEY_START_COLLECTING:
+            ("signedTimeSlicedSurveyStartCollectingMessage",
+             SignedTimeSlicedSurveyStartCollectingMessage),
+        MessageType.TIME_SLICED_SURVEY_STOP_COLLECTING:
+            ("signedTimeSlicedSurveyStopCollectingMessage",
+             SignedTimeSlicedSurveyStopCollectingMessage),
     })
 
 

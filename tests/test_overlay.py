@@ -389,3 +389,91 @@ class TestPeerDiscovery:
         for _ in range(20):
             pm.record_failure("10.0.0.1", 11625)
         assert pm.size == 1
+
+
+class TestSurvey:
+    """Reference: src/overlay/test/SurveyManagerTests.cpp — time-sliced
+    survey over a 3-node chain: surveyor A, relay B, surveyed C."""
+
+    def _three_chain(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sks = [SecretKey(bytes([0x30 + i]) * 32) for i in range(3)]
+        q = qset_of([s.public_key.ed25519 for s in sks], 2)
+        nodes = [_make_node(clock, s, q, bytes([0x40 + i]) * 32)
+                 for i, s in enumerate(sks)]
+        # chain A - B - C (A and C are not neighbours)
+        make_loopback_pair(nodes[0][1], nodes[1][1])
+        make_loopback_pair(nodes[1][1], nodes[2][1])
+        _crank(clock)
+        return clock, sks, nodes
+
+    def test_survey_roundtrip_through_relay(self):
+        clock, sks, nodes = self._three_chain()
+        oa, oc = nodes[0][1], nodes[2][1]
+        nonce = oa.survey.start_survey(nonce=7)
+        _crank(clock)
+        assert oc.survey.collecting is not None
+        assert oc.survey.collecting.nonce == nonce
+        oa.survey.send_request(sks[2].public_key.ed25519)
+        _crank(clock)
+        res = oa.survey.results()
+        key = sks[2].public_key.ed25519.hex()
+        assert key in res["topology"], res
+        body = res["topology"][key]
+        # C has one authenticated peer (B)
+        total = body["nodeData"]["totalInbound"] \
+            + body["nodeData"]["totalOutbound"]
+        assert total == 1
+        oa.survey.stop_survey()
+        _crank(clock)
+        assert oc.survey.collecting is None
+
+    def test_request_outside_collecting_phase_ignored(self):
+        clock, sks, nodes = self._three_chain()
+        oa, oc = nodes[0][1], nodes[2][1]
+        # no start_survey: requests must be dropped, nothing recorded
+        oa.survey._nonce = 99
+        from stellar_core_tpu.crypto import box as cbox
+        oa.survey._enc_pk, oa.survey._enc_sk = cbox.keypair(b"k" * 32)
+        oa.survey.send_request(sks[2].public_key.ed25519)
+        _crank(clock)
+        assert oa.survey.results()["topology"] == {}
+
+    def test_forged_start_collecting_rejected(self):
+        clock, sks, nodes = self._three_chain()
+        oc = nodes[2][1]
+        from stellar_core_tpu import xdr as X
+        msg = X.TimeSlicedSurveyStartCollectingMessage(
+            surveyorID=X.NodeID.ed25519(sks[0].public_key.ed25519),
+            nonce=1, ledgerNum=1)
+        forged = X.SignedTimeSlicedSurveyStartCollectingMessage(
+            signature=b"\x00" * 64, startCollecting=msg)
+        assert oc.survey.recv_start_collecting(None, forged) is False
+        assert oc.survey.collecting is None
+
+
+class TestBanManager:
+    def test_ban_drops_and_persists(self, tmp_path):
+        from stellar_core_tpu.database import Database
+        from stellar_core_tpu.overlay.ban import BanManager
+        db = Database(str(tmp_path / "ban.db"))
+        bm = BanManager(db)
+        nid = b"\x07" * 32
+        bm.ban_node(nid)
+        assert bm.is_banned(nid)
+        bm2 = BanManager(Database(db.path))  # fresh load from disk
+        assert bm2.is_banned(nid)
+        bm2.unban_node(nid)
+        assert not bm2.is_banned(nid)
+        assert BanManager(Database(db.path)).banned_nodes() == []
+
+    def test_banned_peer_cannot_authenticate(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x51" * 32), SecretKey(b"\x52" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"x" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"y" * 32)
+        oa.ban_manager.ban_node(sk_b.public_key.ed25519)
+        pa, pb = make_loopback_pair(oa, ob)
+        _crank(clock)
+        assert oa.num_authenticated() == 0

@@ -156,9 +156,7 @@ def test_bucket_dir_gc_keeps_referenced(tmp_path):
     mgr.enable_persistence(db, bdir)
     root = _root_of(mgr)
     _run_some_ledgers(mgr, root)
-    referenced = {lvl.curr.hash().hex() for lvl in mgr.bucket_list.levels} \
-        | {lvl.snap.hash().hex() for lvl in mgr.bucket_list.levels}
-    removed = bdir.gc(referenced)
+    removed = bdir.gc(mgr.bucket_list.referenced_hashes())
     assert removed > 0  # superseded level-0 currs from earlier closes
     # everything needed for restart still present
     mgr.db.close()
