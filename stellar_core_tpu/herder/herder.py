@@ -83,6 +83,9 @@ class Herder(SCPDriver):
         self.broadcast: Callable[[object], None] = lambda env: None
         self.tx_flood: Callable[[object], None] = lambda frame: None
         self.out_of_sync_handler: Callable[[], None] = lambda: None
+        # observability hook (survey lostSyncCount); fires on each
+        # tracking -> syncing transition
+        self.lost_sync_hook: Callable[[], None] = lambda: None
         self.ledger_closed_hook: Callable[[object], None] = lambda arts: None
 
         self.db = None  # database.Database; attach_persistence()
@@ -440,6 +443,7 @@ class Herder(SCPDriver):
                         self.tracking_consensus_ledger_index(),
                         sorted(self._buffered))
             self.state = HerderState.SYNCING
+            self.lost_sync_hook()
             self.out_of_sync_handler()
 
     def _arm_trigger(self, next_seq: int) -> None:

@@ -208,37 +208,23 @@ class HistoryArchiveState:
         return out
 
 
-# -- file-backed archive ----------------------------------------------------
+# -- archives ---------------------------------------------------------------
 
-class FileHistoryArchive:
-    """Local directory archive (the TmpDirHistoryConfigurator analog used by
-    every reference history test — SURVEY.md §4 fixtures)."""
+class HistoryArchiveBase:
+    """Category/HAS/bucket layer shared by every archive transport; the
+    transport provides get_bytes/put_bytes/exists (reference: the archive
+    itself is a dumb blob store — HistoryArchive only knows paths)."""
 
     WELL_KNOWN = ".well-known/stellar-history.json"
 
-    def __init__(self, root: str):
-        self.root = root
-
-    def _full(self, rel: str) -> str:
-        return os.path.join(self.root, rel)
-
     def put_bytes(self, rel: str, data: bytes) -> None:
-        path = self._full(rel)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        raise NotImplementedError
 
     def get_bytes(self, rel: str) -> Optional[bytes]:
-        try:
-            with open(self._full(rel), "rb") as f:
-                return f.read()
-        except FileNotFoundError:
-            return None
+        raise NotImplementedError
 
     def exists(self, rel: str) -> bool:
-        return os.path.exists(self._full(rel))
+        return self.get_bytes(rel) is not None
 
     # gzip'd XDR streams
     def put_xdr_file(self, rel: str, records: List[bytes]) -> None:
@@ -281,3 +267,108 @@ class FileHistoryArchive:
         if b.hash().hex() != hash_hex:
             raise ValueError(f"bucket hash mismatch for {hash_hex}")
         return b
+
+
+class FileHistoryArchive(HistoryArchiveBase):
+    """Local directory archive (the TmpDirHistoryConfigurator analog used by
+    every reference history test — SURVEY.md §4 fixtures)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _full(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def put_bytes(self, rel: str, data: bytes) -> None:
+        path = self._full(rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_bytes(self, rel: str) -> Optional[bytes]:
+        try:
+            with open(self._full(rel), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self._full(rel))
+
+
+class CommandHistoryArchive(HistoryArchiveBase):
+    """Shell-command archive transport (reference: HistoryArchive
+    get=/put=/mkdir= templates in [HISTORY.<name>], run as subprocesses —
+    `curl -sf {0} -o {1}`, `aws s3 cp {0} {1}`, `cp {0} {1}` ...).
+
+    Templates use `{0}`/`{1}` exactly like the reference: for *get*,
+    {0} = remote path, {1} = local destination file; for *put*,
+    {0} = local source file, {1} = remote path.  Commands run
+    synchronously here; the historywork units add pipelining above this
+    layer (reference: ProcessManager runs N gets concurrently — the Work
+    DAG achieves the overlap in this framework)."""
+
+    def __init__(self, get_template: str = "", put_template: str = "",
+                 mkdir_template: str = ""):
+        import tempfile
+        self.get_template = get_template
+        self.put_template = put_template
+        self.mkdir_template = mkdir_template
+        self._tmp = tempfile.mkdtemp(prefix="sctpu-archive-")
+        self._made_dirs: set = set()
+
+    def _run(self, cmdline: str) -> bool:
+        import subprocess
+        from ..util import logging as slog
+        res = subprocess.run(cmdline, shell=True, capture_output=True)
+        if res.returncode != 0:
+            slog.get("History").warning(
+                "archive command failed (%d): %s", res.returncode, cmdline)
+        return res.returncode == 0
+
+    def _mkdir_remote(self, rel: str) -> None:
+        if not self.mkdir_template:
+            return
+        d = os.path.dirname(rel)
+        if d and d not in self._made_dirs:
+            self._made_dirs.add(d)
+            self._run(self.mkdir_template.format(d))
+
+    def put_bytes(self, rel: str, data: bytes) -> None:
+        if not self.put_template:
+            raise RuntimeError("archive has no put command")
+        local = os.path.join(self._tmp, "put.tmp")
+        with open(local, "wb") as f:
+            f.write(data)
+        self._mkdir_remote(rel)
+        if not self._run(self.put_template.format(local, rel)):
+            raise RuntimeError(f"archive put failed for {rel}")
+
+    def get_bytes(self, rel: str) -> Optional[bytes]:
+        if not self.get_template:
+            raise RuntimeError("archive has no get command")
+        local = os.path.join(self._tmp, "get.tmp")
+        try:
+            os.unlink(local)
+        except FileNotFoundError:
+            pass
+        if not self._run(self.get_template.format(rel, local)):
+            return None
+        try:
+            with open(local, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+def make_archive(get_spec: str = "", put_spec: str = "",
+                 mkdir_spec: str = ""):
+    """Config → archive: specs containing `{0}` are command templates
+    (reference semantics); a bare path is a local directory archive."""
+    if "{0}" in get_spec or "{0}" in put_spec:
+        return CommandHistoryArchive(get_template=get_spec,
+                                     put_template=put_spec,
+                                     mkdir_template=mkdir_spec)
+    return FileHistoryArchive(put_spec or get_spec)

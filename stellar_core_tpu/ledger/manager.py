@@ -331,37 +331,42 @@ class LedgerManager:
         if self.lcl_header is not None:
             self._persist_lcl()
 
-    def _has_json(self) -> str:
-        from ..history.archive import HistoryArchiveState
-        # resolve=False: the per-close durable HAS must not block on
-        # background merges — running merges persist as inputs (state 2)
-        return HistoryArchiveState.from_bucket_list(
-            self.last_closed_ledger_seq, self.network_id.hex(),
-            self.bucket_list, resolve=False).to_json()
-
     def _persist_lcl(self) -> None:
         """Bucket files first (content-addressed, idempotent), then the
         header row + storestate pointers in one sqlite transaction — a crash
         between the two leaves only orphaned bucket files, never a DB that
         references missing buckets.  Pending merges persist without
         blocking: resolved ones as their output, running ones as their
-        inputs (both content-addressed here)."""
+        inputs.
+
+        The HAS is serialized ONCE and the level loop saves exactly the
+        buckets that serialization recorded — serializing twice would race
+        a background merge completing in between, and a HAS naming a
+        state-1 output that was never written bricks restart."""
         from ..database import PersistentState
-        for lvl in self.bucket_list.levels:
+        from ..history.archive import HistoryArchiveState
+        has = HistoryArchiveState.from_bucket_list(
+            self.last_closed_ledger_seq, self.network_id.hex(),
+            self.bucket_list, resolve=False)
+        for lvl, lh in zip(self.bucket_list.levels, has.level_hashes):
             self.bucket_dir.save(lvl.curr)
             self.bucket_dir.save(lvl.snap)
-            if lvl.next is not None:
-                if lvl.next.done:
-                    self.bucket_dir.save(lvl.next.resolve())
-                else:
-                    curr_in, snap_in, _, _ = lvl.next.inputs
-                    self.bucket_dir.save(curr_in)
-                    self.bucket_dir.save(snap_in)
+            nxt = lh["next"]
+            if nxt is None:
+                continue
+            if nxt["state"] == 1:
+                # recorded as output ⇒ the merge was done at serialize
+                # time; resolve() returns that same output instantly
+                self.bucket_dir.save(lvl.next.resolve())
+            else:
+                curr_in, snap_in, _, _ = lvl.next.inputs
+                self.bucket_dir.save(curr_in)
+                self.bucket_dir.save(snap_in)
         self.db.store_header(self.lcl_hash, self.lcl_header)
         self.db.set_state(PersistentState.LAST_CLOSED_LEDGER,
                           self.lcl_hash.hex())
         self.db.set_state(PersistentState.HISTORY_ARCHIVE_STATE,
-                          self._has_json())
+                          has.to_json())
         self.db.set_state(PersistentState.NETWORK_PASSPHRASE,
                           self.network_id.hex())
         self.db.commit()

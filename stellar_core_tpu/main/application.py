@@ -99,10 +99,11 @@ class Application:
                 self.overlay, listen_port=config.PEER_PORT)
 
         # history + catchup -------------------------------------------------
-        archives: List[FileHistoryArchive] = []
+        from ..history.archive import make_archive
+        archives = []
         for spec in config.HISTORY:
-            archives.append(FileHistoryArchive(
-                spec.put_path or spec.get_path))
+            archives.append(make_archive(spec.get_path, spec.put_path,
+                                         spec.mkdir_cmd))
         self.history = HistoryManager(self.lm, config.NETWORK_PASSPHRASE,
                                       archives, database=self.database)
         if config.METADATA_OUTPUT_STREAM:
@@ -274,8 +275,14 @@ class Application:
 
     # -- admin-endpoint backends (reference: CommandHandler actions) ---------
     def manual_close(self) -> dict:
-        """Trigger the next consensus round immediately (reference:
-        `/manualclose` with MANUAL_CLOSE / RUN_STANDALONE)."""
+        """Trigger the next consensus round immediately.  Gated exactly
+        like the reference (`CommandHandler::manualClose` requires
+        MANUAL_CLOSE or RUN_STANDALONE) — on a live validator an admin
+        trigger would race the herder's own ledger timer for the slot."""
+        if not (self.config.MANUAL_CLOSE or self.config.RUN_STANDALONE):
+            return {"status": "ERROR",
+                    "detail": "manualclose requires MANUAL_CLOSE or "
+                              "RUN_STANDALONE"}
         seq = self.lm.last_closed_ledger_seq + 1
         self.herder.trigger_next_ledger(seq)
         return {"status": "triggered", "ledger": seq}

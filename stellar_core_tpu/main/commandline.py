@@ -80,16 +80,16 @@ def cmd_run(args) -> int:
 def cmd_catchup(args) -> int:
     """Catch up from a history archive (reference: `stellar-core catchup`)."""
     cfg = _load_config(args)
-    from ..history.archive import FileHistoryArchive
-    from .application import Application
+    from ..history.archive import make_archive
 
-    archive_path = args.archive
-    if not archive_path:
-        if not cfg.HISTORY:
-            print("no archive configured or given", file=sys.stderr)
-            return 1
-        archive_path = cfg.HISTORY[0].get_path or cfg.HISTORY[0].put_path
-    archive = FileHistoryArchive(archive_path)
+    if args.archive:
+        archive = make_archive(args.archive)
+    elif cfg.HISTORY:
+        spec = cfg.HISTORY[0]
+        archive = make_archive(spec.get_path, spec.put_path, spec.mkdir_cmd)
+    else:
+        print("no archive configured or given", file=sys.stderr)
+        return 1
     from ..catchup.catchup import CatchupManager
     cm = CatchupManager(cfg.network_id(), cfg.NETWORK_PASSPHRASE,
                         accel=cfg.ACCEL == "tpu",
@@ -165,7 +165,8 @@ def cmd_new_hist(args) -> int:
             app.lm.last_closed_ledger_seq, cfg.NETWORK_PASSPHRASE,
             app.lm.bucket_list)
         archive.put_state(has)
-        print(f"initialized archive at {archive.root}")
+        print("initialized archive at "
+              f"{getattr(archive, 'root', '(command transport)')}")
     app.stop()
     return 0
 
@@ -186,9 +187,9 @@ def cmd_verify_checkpoints(args) -> int:
     """Verify the header hash chain of an archive (reference:
     `stellar-core verify-checkpoints`)."""
     from ..catchup.catchup import CatchupManager, CatchupError
-    from ..history.archive import FileHistoryArchive
+    from ..history.archive import make_archive
     cfg = _load_config(args) if args.conf else None
-    archive = FileHistoryArchive(args.archive)
+    archive = make_archive(args.archive)
     has = archive.get_state()
     if has is None:
         print("archive has no HAS", file=sys.stderr)
@@ -208,8 +209,8 @@ def cmd_verify_checkpoints(args) -> int:
 
 
 def cmd_report_last_history_checkpoint(args) -> int:
-    from ..history.archive import FileHistoryArchive
-    archive = FileHistoryArchive(args.archive)
+    from ..history.archive import make_archive
+    archive = make_archive(args.archive)
     has = archive.get_state()
     if has is None:
         print("archive has no HAS", file=sys.stderr)

@@ -22,8 +22,9 @@ from .. import xdr as X
 @dataclass
 class HistoryArchiveConfig:
     name: str
-    get_path: str = ""        # local directory (subprocess templates later)
+    get_path: str = ""        # local dir, or command template with {0}/{1}
     put_path: str = ""
+    mkdir_cmd: str = ""       # optional remote-mkdir template ({0} = dir)
 
 
 @dataclass
@@ -33,6 +34,7 @@ class Config:
     NODE_IS_VALIDATOR: bool = True
     RUN_STANDALONE: bool = False
     FORCE_SCP: bool = False
+    MANUAL_CLOSE: bool = False               # /manualclose trigger allowed
 
     QUORUM_SET_VALIDATORS: List[str] = field(default_factory=list)  # G...
     QUORUM_SET_THRESHOLD: int = 0            # 0 = simple majority
@@ -89,7 +91,8 @@ class Config:
         cfg = Config()
         simple = {
             "NETWORK_PASSPHRASE", "NODE_SEED", "NODE_IS_VALIDATOR",
-            "RUN_STANDALONE", "FORCE_SCP", "PEER_PORT", "HTTP_PORT",
+            "RUN_STANDALONE", "FORCE_SCP", "MANUAL_CLOSE",
+            "PEER_PORT", "HTTP_PORT",
             "KNOWN_PEERS", "TARGET_PEER_CONNECTIONS", "DATABASE",
             "BUCKET_DIR_PATH", "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
@@ -106,6 +109,7 @@ class Config:
                 for name, spec in val.items():
                     cfg.HISTORY.append(HistoryArchiveConfig(
                         name=name, get_path=spec.get("get", ""),
-                        put_path=spec.get("put", "")))
+                        put_path=spec.get("put", ""),
+                        mkdir_cmd=spec.get("mkdir", "")))
             # unknown keys are tolerated (reference warns; we ignore)
         return cfg

@@ -49,6 +49,7 @@ class OverlayManager:
         self.fetcher = ItemFetcher(self._ask_for_item)
         self.ban_manager = BanManager(database)
         self.survey = SurveyManager(self, node_secret)
+        herder.lost_sync_hook = self.survey.record_lost_sync
         self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0}
 
         # herder wiring (same seams the in-process simulation uses)
@@ -204,6 +205,7 @@ class OverlayManager:
 
     def clear_below(self, ledger_seq: int) -> None:
         self.floodgate.clear_below(ledger_seq)
+        self.survey.maybe_expire()
 
     # -- inbound dispatch ---------------------------------------------------
     def ledger_version(self) -> int:

@@ -156,17 +156,38 @@ class SurveyManager:
         }
 
     # -- surveyed side -------------------------------------------------------
+    def _surveyor_permitted(self, surveyor: bytes) -> bool:
+        """Only nodes in the local transitive quorum (or self) may survey
+        (reference: SurveyManager::surveyorPermitted — surveyors outside
+        the quorum map are ignored so arbitrary peers cannot harvest
+        topology or disrupt running surveys)."""
+        if surveyor == self.overlay.node_id:
+            return True
+        herder = self.overlay.herder
+        qmap = herder.quorum_map()
+        if surveyor in qmap:
+            return True
+        from ..scp.quorum import qset_nodes
+        for qset in qmap.values():
+            if qset is not None and surveyor in qset_nodes(qset):
+                return True
+        return False
+
     def recv_start_collecting(self, peer, signed) -> bool:
         """Returns True if the message is fresh/valid (and should be
         relayed)."""
         msg = signed.startCollecting
         surveyor = msg.surveyorID.value
+        if not self._surveyor_permitted(surveyor):
+            return False
         if not self._verify(surveyor, self.TAG_START, msg.to_xdr(),
                             signed.signature):
             return False
-        if self.collecting is not None \
-                and self.collecting.nonce == msg.nonce:
-            return False  # already collecting this run
+        self.maybe_expire()
+        if self.collecting is not None:
+            # one survey at a time; a fresh START must not clobber a live
+            # collecting phase (an abandoned one expires via maybe_expire)
+            return False
         self.collecting = CollectingState(surveyor, msg.nonce, msg.ledgerNum)
         return True
 

@@ -44,6 +44,7 @@ class LoadGenerator:
 
     def create_accounts(self, n: int, per_ledger: int = 50,
                         balance: int = 10_000_000_000) -> None:
+        from ..xdr.transaction import MAX_OPS_PER_TX
         created = 0
         while created < n:
             batch = min(per_ledger, n - created)
@@ -54,8 +55,11 @@ class LoadGenerator:
                 ops.append(create_account_op(
                     X.AccountID.ed25519(sk.public_key.ed25519), balance))
                 new_accounts.append(sk)
-            tx = self.root.tx(ops)
-            self._close([tx])
+            # a ledger batch larger than the per-tx op cap splits into
+            # several root txs within the same ledger
+            frames = [self.root.tx(ops[j:j + MAX_OPS_PER_TX])
+                      for j in range(0, len(ops), MAX_OPS_PER_TX)]
+            self._close(frames)
             header = self.mgr.lcl_header
             for sk in new_accounts:
                 self.accounts.append(TestAccount(

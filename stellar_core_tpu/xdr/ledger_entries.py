@@ -305,16 +305,18 @@ ContractDataDurability = xdr_enum("ContractDataDurability", {
     "PERSISTENT": 1,
 })
 
-# SCVal is a large recursive union; we carry it as opaque bytes until the
-# Soroban layer lands (keeps LedgerEntry round-trip exact for classic use).
-SCValOpaque = VarOpaque()
+from .contract import SCAddress, SCVal, _AssetFwd  # noqa: E402
+
+# tie the contract-module's Asset forward reference (ContractIDPreimage
+# FROM_ASSET) now that Asset exists
+_AssetFwd._target = Asset._xdr_adapter()
 
 ContractDataEntry = xdr_struct("ContractDataEntry", [
     ("ext", ExtensionPoint),
-    ("contract", SCValOpaque),
-    ("key", SCValOpaque),
+    ("contract", SCAddress),
+    ("key", SCVal),
     ("durability", ContractDataDurability),
-    ("val", SCValOpaque),
+    ("val", SCVal),
 ])
 
 ContractCodeEntry = xdr_struct("ContractCodeEntry", [
@@ -383,7 +385,7 @@ _LKClaimableBalance = xdr_struct("LedgerKeyClaimableBalance", [
 _LKLiquidityPool = xdr_struct("LedgerKeyLiquidityPool", [
     ("liquidityPoolID", PoolID)])
 _LKContractData = xdr_struct("LedgerKeyContractData", [
-    ("contract", SCValOpaque), ("key", SCValOpaque),
+    ("contract", SCAddress), ("key", SCVal),
     ("durability", ContractDataDurability)])
 _LKContractCode = xdr_struct("LedgerKeyContractCode", [("hash", Hash)])
 _LKConfigSetting = xdr_struct("LedgerKeyConfigSetting", [("configSettingID", Int32)])

@@ -245,16 +245,16 @@ LiquidityPoolWithdrawOp = xdr_struct("LiquidityPoolWithdrawOp", [
     ("minAmountB", Int64),
 ])
 
-# Soroban ops. The host is out of scope (SURVEY.md §2.4 capability gap) and
-# HostFunction/SCVal are large recursive unions not yet modeled, so
-# InvokeHostFunctionOp carries its body in a framework-local VarOpaque framing.
-# KNOWN WIRE-COMPAT GAP: self-produced envelopes round-trip, but genuine
-# network envelopes with Soroban ops will NOT decode until HostFunction lands
-# (the real body is `HostFunction hostFunction; SorobanAuthorizationEntry
-# auth<>` encoded inline, no length prefix).
+# Soroban ops.  The wasm HOST is out of scope (SURVEY.md §2.4 capability
+# gap — apply yields opNOT_SUPPORTED), but the schema is real: HostFunction,
+# SCVal and the auth tree live in contract.py, so Soroban-carrying envelopes
+# decode and round-trip byte-exactly.
+from .contract import HostFunction, SorobanAuthorizationEntry  # noqa: E402
+
 InvokeHostFunctionOp = xdr_struct("InvokeHostFunctionOp", [
-    ("raw", VarOpaque()),
-])
+    ("hostFunction", HostFunction),
+    ("auth", VarArray(SorobanAuthorizationEntry)),
+], defaults={"auth": list})
 ExtendFootprintTTLOp = xdr_struct("ExtendFootprintTTLOp", [
     ("ext", ExtensionPoint),
     ("extendTo", Uint32),

@@ -261,3 +261,36 @@ def test_multisig_catchup_accel_pairs_all_signers(tmp_path):
     keys.clear_verify_cache()
     cm_cpu = CatchupManager(nid, "multisig accel net", accel=False)
     assert cm_cpu.catchup_complete(archive).lcl_hash == mgr.lcl_hash
+
+
+def test_command_template_archive_publish_and_catchup(tmp_path):
+    """Archive driven by get=/put=/mkdir= shell templates (reference:
+    HistoryArchive command indirection; tests use cp/mkdir exactly like
+    TmpDirHistoryConfigurator)."""
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.history.archive import (CommandHistoryArchive,
+                                                  make_archive)
+
+    root = tmp_path / "cmdarch"
+    root.mkdir()
+    archive = make_archive(
+        get_spec=f"cp {root}/{{0}} {{1}}",
+        put_spec=f"cp {{0}} {root}/{{1}}",
+        mkdir_spec=f"mkdir -p {root}/{{0}}")
+    assert isinstance(archive, CommandHistoryArchive)
+
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=13)
+    gen.create_accounts(12, per_ledger=6)
+    gen.payment_ledgers(10, txs_per_ledger=5)
+    gen.run_to_checkpoint_boundary()
+    assert history.published_checkpoints
+
+    # a FAILING get returns None (missing object), not an exception
+    assert archive.get_bytes("no/such/object") is None
+
+    cm = CatchupManager(NID, PASSPHRASE)
+    fresh = cm.catchup_complete(archive)
+    assert fresh.lcl_hash == mgr.lcl_hash
