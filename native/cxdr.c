@@ -420,6 +420,424 @@ pack_value(PyObject *prog, PyObject *val, Buf *b, int depth)
     }
 }
 
+/* ------------------------------------------------------------------ */
+/* unpack: the deserialization mirror (catchup replay's hot loop is   */
+/* archive-stream + bucket-entry DECODING — PROFILE.md round 2).      */
+/* Same strictness as the Python codec: canonical padding, length     */
+/* caps, bool/enum membership, short-buffer errors.                   */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    const unsigned char *data;
+    Py_ssize_t len;
+    Py_ssize_t off;
+    PyObject *src; /* borrowed: the original bytes object (OP_PYCALL) */
+} Rdr;
+
+static PyObject *str_switch, *str_value;
+
+static int
+rd_need(Rdr *r, Py_ssize_t n, const char *what)
+{
+    if (r->off + n > r->len) {
+        PyErr_Format(CxdrError, "short buffer for %s", what);
+        return -1;
+    }
+    return 0;
+}
+
+static int
+rd_u32(Rdr *r, uint32_t *out, const char *what)
+{
+    if (rd_need(r, 4, what) < 0)
+        return -1;
+    const unsigned char *p = r->data + r->off;
+    *out = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    r->off += 4;
+    return 0;
+}
+
+static int
+rd_u64(Rdr *r, uint64_t *out, const char *what)
+{
+    if (rd_need(r, 8, what) < 0)
+        return -1;
+    const unsigned char *p = r->data + r->off;
+    *out = ((uint64_t)p[0] << 56) | ((uint64_t)p[1] << 48) |
+           ((uint64_t)p[2] << 40) | ((uint64_t)p[3] << 32) |
+           ((uint64_t)p[4] << 24) | ((uint64_t)p[5] << 16) |
+           ((uint64_t)p[6] << 8) | (uint64_t)p[7];
+    r->off += 8;
+    return 0;
+}
+
+static int
+rd_pad(Rdr *r, Py_ssize_t n)
+{
+    Py_ssize_t pad = (4 - (n % 4)) % 4;
+    if (rd_need(r, pad, "padding") < 0)
+        return -1;
+    for (Py_ssize_t i = 0; i < pad; i++) {
+        if (r->data[r->off + i]) {
+            PyErr_SetString(CxdrError, "nonzero padding");
+            return -1;
+        }
+    }
+    r->off += pad;
+    return 0;
+}
+
+static PyObject *unpack_value(PyObject *prog, Rdr *r, int depth);
+
+static PyObject *
+alloc_instance(PyObject *cls)
+{
+    /* __slots__ value classes: allocate without running __init__ */
+    PyTypeObject *tp = (PyTypeObject *)cls;
+    return tp->tp_alloc(tp, 0);
+}
+
+static PyObject *
+unpack_value(PyObject *prog, Rdr *r, int depth)
+{
+    if (depth > 200) {
+        PyErr_SetString(CxdrError, "program too deep");
+        return NULL;
+    }
+    long op = PyLong_AsLong(PyTuple_GET_ITEM(prog, 0));
+    switch (op) {
+    case OP_U32: {
+        uint32_t v;
+        if (rd_u32(r, &v, "uint32") < 0)
+            return NULL;
+        return PyLong_FromUnsignedLong(v);
+    }
+    case OP_I32: {
+        uint32_t v;
+        if (rd_u32(r, &v, "int32") < 0)
+            return NULL;
+        return PyLong_FromLong((long)(int32_t)v);
+    }
+    case OP_ENUM: {
+        PyObject *members = PyTuple_GET_ITEM(prog, 1);
+        uint32_t v;
+        if (rd_u32(r, &v, "enum") < 0)
+            return NULL;
+        PyObject *key = PyLong_FromLong((long)(int32_t)v);
+        if (!key)
+            return NULL;
+        PyObject *member = PyDict_GetItem(members, key); /* borrowed */
+        Py_DECREF(key);
+        if (!member) {
+            PyErr_Format(CxdrError, "bad enum value %ld",
+                         (long)(int32_t)v);
+            return NULL;
+        }
+        Py_INCREF(member);
+        return member;
+    }
+    case OP_U64: {
+        uint64_t v;
+        if (rd_u64(r, &v, "uint64") < 0)
+            return NULL;
+        return PyLong_FromUnsignedLongLong(v);
+    }
+    case OP_I64: {
+        uint64_t v;
+        if (rd_u64(r, &v, "int64") < 0)
+            return NULL;
+        return PyLong_FromLongLong((long long)(int64_t)v);
+    }
+    case OP_BOOL: {
+        uint32_t v;
+        if (rd_u32(r, &v, "bool") < 0)
+            return NULL;
+        if (v > 1) {
+            PyErr_Format(CxdrError, "bad bool %lu", (unsigned long)v);
+            return NULL;
+        }
+        PyObject *out = v ? Py_True : Py_False;
+        Py_INCREF(out);
+        return out;
+    }
+    case OP_OPAQUE: {
+        Py_ssize_t n = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        if (rd_need(r, n, "opaque") < 0)
+            return NULL;
+        PyObject *out = PyBytes_FromStringAndSize(
+            (const char *)r->data + r->off, n);
+        if (!out)
+            return NULL;
+        r->off += n;
+        if (rd_pad(r, n) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case OP_VAROPAQUE:
+    case OP_STRING: {
+        Py_ssize_t maxlen = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        uint32_t n;
+        if (rd_u32(r, &n, "var opaque length") < 0)
+            return NULL;
+        if ((Py_ssize_t)n > maxlen) {
+            PyErr_Format(CxdrError, "opaque<%zd>: length %lu", maxlen,
+                         (unsigned long)n);
+            return NULL;
+        }
+        if (rd_need(r, (Py_ssize_t)n, "var opaque") < 0)
+            return NULL;
+        PyObject *out = PyBytes_FromStringAndSize(
+            (const char *)r->data + r->off, (Py_ssize_t)n);
+        if (!out)
+            return NULL;
+        r->off += (Py_ssize_t)n;
+        if (rd_pad(r, (Py_ssize_t)n) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case OP_FIXARRAY:
+    case OP_VARARRAY: {
+        Py_ssize_t bound = PyLong_AsSsize_t(PyTuple_GET_ITEM(prog, 1));
+        PyObject *elem = PyTuple_GET_ITEM(prog, 2);
+        Py_ssize_t n;
+        if (op == OP_FIXARRAY) {
+            n = bound;
+        } else {
+            uint32_t ln;
+            if (rd_u32(r, &ln, "array length") < 0)
+                return NULL;
+            if ((Py_ssize_t)ln > bound) {
+                PyErr_Format(CxdrError, "array<%zd>: length %lu", bound,
+                             (unsigned long)ln);
+                return NULL;
+            }
+            n = (Py_ssize_t)ln;
+        }
+        /* every non-void element consumes >= 4 wire bytes: reject wire
+           lengths the remaining buffer cannot possibly satisfy BEFORE
+           preallocating (a hostile 4-byte length claiming 2^32-1 elements
+           must fail like the Python decoder's short-buffer error, not
+           attempt a multi-GB PyList_New) */
+        long elem_op = PyLong_AsLong(PyTuple_GET_ITEM(elem, 0));
+        if (elem_op != OP_VOID && n > (r->len - r->off) / 4) {
+            PyErr_SetString(CxdrError, "short buffer for array");
+            return NULL;
+        }
+        PyObject *lst = PyList_New(n);
+        if (!lst)
+            return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *v = unpack_value(elem, r, depth + 1);
+            if (!v) {
+                Py_DECREF(lst);
+                return NULL;
+            }
+            PyList_SET_ITEM(lst, i, v);
+        }
+        return lst;
+    }
+    case OP_OPTIONAL: {
+        uint32_t flag;
+        if (rd_u32(r, &flag, "optional flag") < 0)
+            return NULL;
+        if (flag > 1) {
+            PyErr_Format(CxdrError, "bad bool %lu", (unsigned long)flag);
+            return NULL;
+        }
+        if (!flag)
+            Py_RETURN_NONE;
+        return unpack_value(PyTuple_GET_ITEM(prog, 1), r, depth + 1);
+    }
+    case OP_VOID:
+        Py_RETURN_NONE;
+    case OP_STRUCT: {
+        PyObject *fields = PyTuple_GET_ITEM(prog, 1);
+        PyObject *cls = PyTuple_GET_ITEM(prog, 2);
+        PyObject *obj = alloc_instance(cls);
+        if (!obj)
+            return NULL;
+        Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+        for (Py_ssize_t i = 0; i < nf; i += 2) {
+            PyObject *name = PyTuple_GET_ITEM(fields, i);
+            PyObject *sub = PyTuple_GET_ITEM(fields, i + 1);
+            PyObject *v = unpack_value(sub, r, depth + 1);
+            if (!v) {
+                Py_DECREF(obj);
+                return NULL;
+            }
+            int rc = PyObject_SetAttr(obj, name, v);
+            Py_DECREF(v);
+            if (rc < 0) {
+                Py_DECREF(obj);
+                return NULL;
+            }
+        }
+        return obj;
+    }
+    case OP_UNION: {
+        PyObject *arms = PyTuple_GET_ITEM(prog, 1);
+        PyObject *defprog = PyTuple_GET_ITEM(prog, 2);
+        int has_default = PyObject_IsTrue(PyTuple_GET_ITEM(prog, 3));
+        PyObject *members = PyTuple_GET_ITEM(prog, 4);
+        PyObject *cls = PyTuple_GET_ITEM(prog, 5);
+        uint32_t raw;
+        if (rd_u32(r, &raw, "union switch") < 0)
+            return NULL;
+        PyObject *swint = PyLong_FromLong((long)(int32_t)raw);
+        if (!swint)
+            return NULL;
+        PyObject *sw = swint; /* what .switch will hold */
+        if (members != Py_None) {
+            PyObject *member = PyDict_GetItem(members, swint); /* borrowed */
+            if (!member) {
+                Py_DECREF(swint);
+                PyErr_Format(CxdrError, "bad enum value %ld",
+                             (long)(int32_t)raw);
+                return NULL;
+            }
+            Py_INCREF(member);
+            Py_DECREF(swint);
+            sw = member;
+            swint = NULL;
+        }
+        /* arm lookup needs the plain int key */
+        PyObject *key = swint ? sw : PyLong_FromLong((long)(int32_t)raw);
+        if (!key) {
+            Py_DECREF(sw);
+            return NULL;
+        }
+        PyObject *arm = PyDict_GetItem(arms, key); /* borrowed */
+        int arm_found = (arm != NULL);
+        if (key != sw)
+            Py_DECREF(key);
+        if (!arm_found) {
+            if (!has_default) {
+                Py_DECREF(sw);
+                PyErr_Format(CxdrError, "no arm for discriminant %ld",
+                             (long)(int32_t)raw);
+                return NULL;
+            }
+            arm = defprog;
+        }
+        PyObject *av;
+        if (arm == Py_None) {
+            av = Py_None;
+            Py_INCREF(av);
+        } else {
+            av = unpack_value(arm, r, depth + 1);
+            if (!av) {
+                Py_DECREF(sw);
+                return NULL;
+            }
+        }
+        PyObject *obj = alloc_instance(cls);
+        if (!obj) {
+            Py_DECREF(sw);
+            Py_DECREF(av);
+            return NULL;
+        }
+        int rc = PyObject_SetAttr(obj, str_switch, sw);
+        Py_DECREF(sw);
+        if (rc == 0) {
+            rc = PyObject_SetAttr(obj, str_value, av);
+        }
+        Py_DECREF(av);
+        if (rc < 0) {
+            Py_DECREF(obj);
+            return NULL;
+        }
+        return obj;
+    }
+    case OP_PYCALL: {
+        /* recursion/fallback seam: delegate to the Python unpack_from,
+           which returns (value, new_offset) over the ORIGINAL buffer */
+        PyObject *t = PyTuple_GET_ITEM(prog, 1);
+        PyObject *res = PyObject_CallMethod(t, "unpack_from", "On",
+                                            r->src, r->off);
+        if (!res)
+            return NULL;
+        if (!PyTuple_Check(res) || PyTuple_GET_SIZE(res) != 2) {
+            Py_DECREF(res);
+            PyErr_SetString(CxdrError,
+                            "unpack_from() did not return (val, off)");
+            return NULL;
+        }
+        PyObject *val = PyTuple_GET_ITEM(res, 0);
+        Py_ssize_t noff = PyLong_AsSsize_t(PyTuple_GET_ITEM(res, 1));
+        if (noff == -1 && PyErr_Occurred()) {
+            Py_DECREF(res);
+            return NULL;
+        }
+        if (noff < r->off || noff > r->len) {
+            Py_DECREF(res);
+            PyErr_SetString(CxdrError, "unpack_from() offset out of range");
+            return NULL;
+        }
+        Py_INCREF(val);
+        Py_DECREF(res);
+        r->off = noff;
+        return val;
+    }
+    default:
+        PyErr_Format(CxdrError, "bad opcode %ld", op);
+        return NULL;
+    }
+}
+
+static PyObject *
+cxdr_unpack_from(PyObject *self, PyObject *args)
+{
+    PyObject *prog, *src;
+    Py_ssize_t off = 0;
+    if (!PyArg_ParseTuple(args, "O!O|n", &PyTuple_Type, &prog, &src, &off))
+        return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(src, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (off < 0 || off > view.len) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(CxdrError, "offset out of range");
+        return NULL;
+    }
+    Rdr r = {(const unsigned char *)view.buf, view.len, off, src};
+    PyObject *val = unpack_value(prog, &r, 0);
+    Py_ssize_t end = r.off;
+    PyBuffer_Release(&view);
+    if (!val)
+        return NULL;
+    PyObject *out = Py_BuildValue("Nn", val, end);
+    return out;
+}
+
+static PyObject *
+cxdr_unpack(PyObject *self, PyObject *args)
+{
+    PyObject *prog, *src;
+    if (!PyArg_ParseTuple(args, "O!O", &PyTuple_Type, &prog, &src))
+        return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(src, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    Rdr r = {(const unsigned char *)view.buf, view.len, 0, src};
+    PyObject *val = unpack_value(prog, &r, 0);
+    Py_ssize_t end = r.off, total = view.len;
+    PyBuffer_Release(&view);
+    if (!val)
+        return NULL;
+    if (end != total) {
+        Py_DECREF(val);
+        PyErr_Format(CxdrError, "trailing bytes: consumed %zd of %zd",
+                     end, total);
+        return NULL;
+    }
+    return val;
+}
+
 static PyObject *
 cxdr_pack(PyObject *self, PyObject *args)
 {
@@ -439,6 +857,10 @@ cxdr_pack(PyObject *self, PyObject *args)
 static PyMethodDef cxdr_methods[] = {
     {"pack", cxdr_pack, METH_VARARGS,
      "pack(program, value) -> bytes: serialize value per the program."},
+    {"unpack", cxdr_unpack, METH_VARARGS,
+     "unpack(program, data) -> value: full-consumption deserialize."},
+    {"unpack_from", cxdr_unpack_from, METH_VARARGS,
+     "unpack_from(program, data, off=0) -> (value, new_off)."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -457,6 +879,12 @@ PyInit__cxdr(void)
     Py_XINCREF(CxdrError);
     if (PyModule_AddObject(m, "Error", CxdrError) < 0) {
         Py_XDECREF(CxdrError);
+        Py_DECREF(m);
+        return NULL;
+    }
+    str_switch = PyUnicode_InternFromString("switch");
+    str_value = PyUnicode_InternFromString("value");
+    if (!str_switch || !str_value) {
         Py_DECREF(m);
         return NULL;
     }

@@ -101,7 +101,7 @@ class Bucket:
         off = 0
         protocol = 0
         while off < len(data):
-            e, off = _BE.unpack_from(data, off)
+            e, off = _BE.unpack_from_fast(data, off)
             if e.switch == BucketEntryType.METAENTRY:
                 protocol = e.value.ledgerVersion
             else:

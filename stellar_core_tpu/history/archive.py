@@ -333,8 +333,10 @@ class CommandHistoryArchive(HistoryArchiveBase):
             return
         d = os.path.dirname(rel)
         if d and d not in self._made_dirs:
-            self._made_dirs.add(d)
-            self._run(self.mkdir_template.format(d))
+            # cache only on success — a transient mkdir failure must be
+            # retried by the next put, not poisoned into the cache
+            if self._run(self.mkdir_template.format(d)):
+                self._made_dirs.add(d)
 
     def put_bytes(self, rel: str, data: bytes) -> None:
         if not self.put_template:

@@ -168,6 +168,32 @@ class LedgerManager:
             txs=[f.envelope for f in ordered])
         return tx_set, sha256(tx_set.to_xdr()), ordered
 
+    @staticmethod
+    def apply_order(frames: Sequence[TransactionFrame]
+                    ) -> List[TransactionFrame]:
+        """Deterministic APPLY order (reference: TxSetFrame::
+        getTxsInApplyOrder / ApplyTxSorter): each source account's txs in
+        sequence-number order — hash order alone would seq-fail all but
+        the lowest-seq tx of a multi-tx source — interleaved across
+        sources by picking the queue head with the smallest content hash.
+        Consensus-critical: live close and catchup replay must agree."""
+        import heapq
+        by_src: dict = {}
+        for f in frames:
+            by_src.setdefault(f.source_account_id().to_xdr(), []).append(f)
+        for q in by_src.values():
+            q.sort(key=lambda f: f.seq_num)
+        heads = [(q[0].content_hash(), src) for src, q in by_src.items()]
+        heapq.heapify(heads)
+        out: List[TransactionFrame] = []
+        while heads:
+            _, src = heapq.heappop(heads)
+            q = by_src[src]
+            out.append(q.pop(0))
+            if q:
+                heapq.heappush(heads, (q[0].content_hash(), src))
+        return out
+
     # -- close --------------------------------------------------------------
     def close_ledger(self, frames: Sequence[TransactionFrame],
                      close_time: int,
@@ -188,8 +214,8 @@ class LedgerManager:
         if tx_set is None:
             tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
         else:
-            ordered = sorted(frames, key=lambda f: f.content_hash())
             tx_set_hash = sha256(tx_set.to_xdr())
+        ordered = self.apply_order(frames)
         if stellar_value is not None:
             if stellar_value.txSetHash != tx_set_hash:
                 # fail-stop: committing a header that names a tx set other

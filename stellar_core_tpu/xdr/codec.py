@@ -53,10 +53,32 @@ class XdrType:
         return bytes(out)
 
     def unpack(self, data: bytes) -> Any:
+        if _cxdr_unpack is not None:
+            prog = self._cxdr_prog
+            if prog is None:
+                prog = self._cxdr_prog = compile_program(self)
+            try:
+                return _cxdr.unpack(prog, data)
+            except _cxdr.Error as e:
+                raise XdrError(str(e)) from None
         val, off = self.unpack_from(data, 0)
         if off != len(data):
             raise XdrError(f"trailing bytes: consumed {off} of {len(data)}")
         return val
+
+    def unpack_from_fast(self, buf: bytes, off: int = 0) -> Tuple[Any, int]:
+        """Native-accelerated unpack_from when the extension is built
+        (stream decoding — the catchup-replay hot loop); falls back to the
+        pure-Python recursion otherwise."""
+        if _cxdr_unpack is not None:
+            prog = self._cxdr_prog
+            if prog is None:
+                prog = self._cxdr_prog = compile_program(self)
+            try:
+                return _cxdr.unpack_from(prog, buf, off)
+            except _cxdr.Error as e:
+                raise XdrError(str(e)) from None
+        return self.unpack_from(buf, off)
 
     def pack_into(self, val: Any, out: bytearray) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -581,6 +603,9 @@ try:
 except ImportError:
     _cxdr = None
 
+# unpack arrived after pack; tolerate a stale built extension
+_cxdr_unpack = getattr(_cxdr, "unpack", None)
+
 
 def compile_program(t) -> tuple:
     t = _as_type(t)
@@ -595,7 +620,9 @@ def compile_program(t) -> tuple:
     if isinstance(t, _Bool):
         return (5,)
     if isinstance(t, _EnumAdapter):
-        return (6, {int(m): None for m in t.enum_cls})
+        # values are the member objects: pack only membership-checks the
+        # keys; unpack returns the member (same as _EnumAdapter)
+        return (6, {int(m): m for m in t.enum_cls})
     if isinstance(t, Opaque):
         return (7, t.n)
     if isinstance(t, VarOpaque):
@@ -623,10 +650,11 @@ def compile_program(t) -> tuple:
         default = t.cls._default
         defprog = (compile_program(default[1])
                    if default is not None and default[1] is not None else None)
-        # enum-typed switches carry the membership dict (None for plain
-        # int switches), matching the Python switch-type validation
+        # enum-typed switches carry the member dict (None for plain
+        # int switches): pack membership-checks the keys, unpack maps the
+        # wire int back to the member object for `.switch`
         sw_t = t.cls._switch_type
-        members = ({int(m): None for m in sw_t.enum_cls}
+        members = ({int(m): m for m in sw_t.enum_cls}
                    if isinstance(sw_t, _EnumAdapter) else None)
         return (15, arms, defprog, default is not None, members, t.cls)
     # recursive forward refs and anything unknown: Python-callback seam

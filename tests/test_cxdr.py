@@ -130,3 +130,82 @@ def test_strictness_parity_with_python():
         X.Price._xdr_adapter().pack(Fake())
     with pytest.raises(X.XdrError):
         C.Opaque(5).pack("hello")
+
+
+@pytest.mark.parametrize("val", list(_sample_values()),
+                         ids=lambda v: type(v).__name__)
+def test_unpack_identical_to_python(val):
+    """Native unpack must reproduce the Python decoder's objects exactly —
+    including enum members (not bare ints) for enum fields/switches."""
+    adapter = type(val)._xdr_adapter()
+    blob = adapter._pack_py(val)
+    native = C._cxdr.unpack(adapter._cxdr_prog
+                            or C.compile_program(adapter), blob)
+    py, off = adapter.unpack_from(blob, 0)
+    assert off == len(blob)
+    assert native == py == val
+    if hasattr(val, "switch"):
+        assert type(native.switch) is type(val.switch)
+
+
+def test_unpack_from_fast_streams():
+    """Sequential stream decode (the bucket/catchup pattern)."""
+    vals = [X.Price(n=i, d=i + 1) for i in range(50)]
+    adapter = X.Price._xdr_adapter()
+    blob = b"".join(adapter.pack(v) for v in vals)
+    off = 0
+    out = []
+    while off < len(blob):
+        v, off = adapter.unpack_from_fast(blob, off)
+        out.append(v)
+    assert out == vals
+
+
+def test_unpack_rejections_match_python():
+    """Mutated bytes must be accepted/rejected identically by the native
+    and Python decoders, and accepted values must be equal (the fuzz
+    differential that guards hash integrity)."""
+    import random
+    from stellar_core_tpu.fuzz import mutate_bytes, random_xdr_value
+
+    rng = random.Random(99)
+    roots = [X.TransactionEnvelope, X.LedgerEntry, X.StellarMessage,
+             X.LedgerHeader, X.BucketEntry]
+    checked = 0
+    for i in range(300):
+        cls = rng.choice(roots)
+        val = random_xdr_value(cls, rng)
+        try:
+            blob = val.to_xdr()
+        except X.XdrError:
+            continue
+        adapter = cls._xdr_adapter()
+        mut = mutate_bytes(blob, rng)
+        native_err = py_err = None
+        native_val = py_val = None
+        try:
+            native_val = C._cxdr.unpack(adapter._cxdr_prog, mut)
+        except C._cxdr.Error as e:
+            native_err = True
+        try:
+            py_val, off = adapter.unpack_from(mut, 0)
+            if off != len(mut):
+                raise X.XdrError("trailing")
+        except (X.XdrError, OverflowError):
+            py_err = True
+        assert bool(native_err) == bool(py_err), \
+            f"case {i}: native={native_err} py={py_err}"
+        if native_err is None:
+            assert native_val == py_val
+        checked += 1
+    assert checked > 100
+
+
+def test_hostile_array_length_rejected_without_allocation():
+    """A 4-byte wire length claiming 2^32-ish elements must fail as
+    XdrError before any preallocation (regression: bare MemoryError)."""
+    import struct
+    adapter = X.TransactionSet._xdr_adapter()
+    blob = b"\x11" * 32 + struct.pack(">I", 0xFFFFFFF0)
+    with pytest.raises(X.XdrError):
+        adapter.unpack(blob)

@@ -451,6 +451,35 @@ class TestSurvey:
         assert oc.survey.recv_start_collecting(None, forged) is False
         assert oc.survey.collecting is None
 
+    def test_unauthorized_surveyor_rejected(self):
+        """Only transitive-quorum members may survey (reference:
+        SurveyManager surveyor permission check)."""
+        clock, sks, nodes = self._three_chain()
+        oc = nodes[2][1]
+        from stellar_core_tpu import xdr as X
+        from stellar_core_tpu.crypto.keys import SecretKey
+        stranger = SecretKey(b"\x7e" * 32)
+        msg = X.TimeSlicedSurveyStartCollectingMessage(
+            surveyorID=X.NodeID.ed25519(stranger.public_key.ed25519),
+            nonce=5, ledgerNum=1)
+        signed = X.SignedTimeSlicedSurveyStartCollectingMessage(
+            signature=stranger.sign(oc.survey.TAG_START + msg.to_xdr()),
+            startCollecting=msg)
+        assert oc.survey.recv_start_collecting(None, signed) is False
+        assert oc.survey.collecting is None
+
+    def test_second_start_does_not_clobber_live_survey(self):
+        clock, sks, nodes = self._three_chain()
+        oa, ob, oc = (n[1] for n in nodes)
+        oa.survey.start_survey(nonce=1)
+        _crank(clock)
+        assert oc.survey.collecting.nonce == 1
+        # B (also in quorum) tries to start its own survey: C must keep
+        # the live phase
+        ob.survey.start_survey(nonce=2)
+        _crank(clock)
+        assert oc.survey.collecting.nonce == 1
+
 
 class TestBanManager:
     def test_ban_drops_and_persists(self, tmp_path):
@@ -477,33 +506,3 @@ class TestBanManager:
         pa, pb = make_loopback_pair(oa, ob)
         _crank(clock)
         assert oa.num_authenticated() == 0
-
-def test_unauthorized_surveyor_rejected():
-        """Only transitive-quorum members may survey (reference:
-        SurveyManager surveyor permission check)."""
-        clock, sks, nodes = TestSurvey()._three_chain()
-        oc = nodes[2][1]
-        from stellar_core_tpu import xdr as X
-        from stellar_core_tpu.crypto.keys import SecretKey
-        stranger = SecretKey(b"\x7e" * 32)
-        msg = X.TimeSlicedSurveyStartCollectingMessage(
-            surveyorID=X.NodeID.ed25519(stranger.public_key.ed25519),
-            nonce=5, ledgerNum=1)
-        signed = X.SignedTimeSlicedSurveyStartCollectingMessage(
-            signature=stranger.sign(
-                oc.survey.TAG_START + msg.to_xdr()),
-            startCollecting=msg)
-        assert oc.survey.recv_start_collecting(None, signed) is False
-        assert oc.survey.collecting is None
-
-def test_second_start_does_not_clobber_live_survey():
-        clock, sks, nodes = TestSurvey()._three_chain()
-        oa, ob, oc = (n[1] for n in nodes)
-        oa.survey.start_survey(nonce=1)
-        _crank(clock)
-        assert oc.survey.collecting.nonce == 1
-        # B (also in quorum) tries to start its own survey: C must keep
-        # the live phase
-        ob.survey.start_survey(nonce=2)
-        _crank(clock)
-        assert oc.survey.collecting.nonce == 1

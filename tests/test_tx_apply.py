@@ -316,3 +316,44 @@ def test_fee_bump(mgr, root):
     assert res.result.switch == X.TransactionResultCode.txFEE_BUMP_INNER_SUCCESS
     assert _acc(mgr, sponsor.account_id).balance == sp0 - 200  # 2 ops * base
     assert _acc(mgr, a.account_id).balance == a0 - 1  # only the payment
+
+
+def test_multiple_txs_same_source_one_ledger(mgr, root):
+    """Apply order must run a source's txs in sequence order even though
+    the tx SET is hash-ordered (reference: TxSetFrame::getTxsInApplyOrder;
+    regression: hash-only ordering seq-failed all but one tx)."""
+    dests = [SecretKey(bytes([0x70 + i]) * 32) for i in range(6)]
+    frames = [root.tx([create_account_op(
+        X.AccountID.ed25519(d.public_key.ed25519), 10_000_000_000)])
+        for d in dests]
+    arts = _close(mgr, *frames)
+    results = arts.result_entry.txResultSet.results
+    assert len(results) == 6
+    for pair in results:
+        assert pair.result.result.switch == X.TransactionResultCode.txSUCCESS
+    for d in dests:
+        k = X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(d.public_key.ed25519))).to_xdr()
+        assert mgr.root.get_entry(k) is not None
+
+
+def test_same_source_apply_order_survives_replay(tmp_path):
+    """Publisher and fresh replayer must agree on the seq-aware apply
+    order (consensus-critical determinism)."""
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.history.archive import FileHistoryArchive
+    from stellar_core_tpu.history.manager import HistoryManager
+    from stellar_core_tpu.simulation.loadgen import LoadGenerator
+    from stellar_core_tpu.testutils import network_id
+
+    nid = network_id("apply order replay")
+    m = LedgerManager(nid)
+    m.start_new_ledger()
+    arch = FileHistoryArchive(str(tmp_path / "a"))
+    hist = HistoryManager(m, "apply order replay", [arch])
+    gen = LoadGenerator(m, hist, seed=5)
+    gen.create_accounts(150, per_ledger=150)   # 2 root txs in one ledger
+    gen.payment_ledgers(3, txs_per_ledger=10)
+    gen.run_to_checkpoint_boundary()
+    fresh = CatchupManager(nid, "apply order replay").catchup_complete(arch)
+    assert fresh.lcl_hash == m.lcl_hash
