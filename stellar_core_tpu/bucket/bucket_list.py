@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Iterable, List, Optional
 
 from ..crypto.sha import SHA256
+from ..util.assertions import release_assert
 from ..xdr import LedgerEntry, LedgerKey
 from .bucket import Bucket, merge_buckets
 from .future import FutureBucket
@@ -72,7 +73,8 @@ class BucketLevel:
                 protocol_version: int, executor=None) -> None:
         """Start merging curr with the incoming spill (reference:
         BucketLevel::prepare → FutureBucket ctor on a worker thread)."""
-        assert self.next is None, "prepare() without a prior commit()"
+        release_assert(self.next is None,
+                       "prepare() without a prior commit()")
         self.next = FutureBucket(self.curr, spill, keep_tombstones,
                                  protocol_version, executor)
 
@@ -95,7 +97,7 @@ class BucketList:
         """One ledger's changes enter level 0; spill boundaries snap the
         level above, commit the previously prepared merge and prepare the
         next one (reference: BucketListBase::addBatch)."""
-        assert ledger_seq > 0
+        release_assert(ledger_seq > 0, "ledger_seq must be positive")
         for i in range(NUM_LEVELS - 1, 0, -1):
             if level_should_spill(ledger_seq, i - 1):
                 spill = self.levels[i - 1].snap_curr()

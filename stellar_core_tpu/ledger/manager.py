@@ -25,6 +25,7 @@ from ..crypto.sha import sha256
 from ..bucket.bucket_list import BucketList
 from ..transactions.frame import TransactionFrame
 from ..util import logging as slog
+from ..util.assertions import release_assert
 from .ledger_txn import LedgerTxn, LedgerTxnRoot
 
 log = slog.get("Ledger")
@@ -207,7 +208,8 @@ class LedgerManager:
         `stellar_value` is the externalized consensus value (carries voted
         upgrades, applied after the tx phase — reference:
         LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
-        assert self.root is not None, "start_new_ledger/load first"
+        release_assert(self.root is not None,
+                       "start_new_ledger/load first")
         from ..util.metrics import registry
         _close_timer = registry().timer("ledger.ledger.close")
         _t0 = time.perf_counter()

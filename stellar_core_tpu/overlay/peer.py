@@ -339,14 +339,51 @@ class LoopbackPeer(Peer):
     def __init__(self, overlay, we_called_remote: bool):
         super().__init__(overlay, we_called_remote)
         self.partner: Optional["LoopbackPeer"] = None
-        self.drop_outbound = False   # test hook: simulate a black hole
+        # fault-injection knobs (reference: LoopbackPeer's damage/drop/
+        # reorder probabilities used by overlay tests)
+        self.drop_outbound = False       # black hole
+        self.damage_probability = 0.0    # flip a byte in outbound frames
+        self.drop_probability = 0.0      # silently drop outbound frames
+        self.reorder_probability = 0.0   # delay a frame behind the next
+        import random as _random
+        self.fault_rng = _random.Random(0)  # deterministic by default
+        self._held_back: Optional[bytes] = None
 
     def _write_bytes(self, data: bytes) -> None:
         if self.partner is None or self.drop_outbound:
             return
+        rng = self.fault_rng
+        held, self._held_back = self._held_back, None
+        frames = []
+        dropped = (self.drop_probability
+                   and rng.random() < self.drop_probability)
+        if not dropped:
+            if self.damage_probability \
+                    and rng.random() < self.damage_probability \
+                    and len(data) > 4:
+                # flip a PAYLOAD bit (offset >= 4): damaging the record
+                # mark/length would stall the frame decoder rather than
+                # exercise the MAC fail-stop (reference: LoopbackPeer
+                # damages message bodies)
+                buf = bytearray(data)
+                buf[rng.randrange(4, len(buf))] ^= 1 << rng.randrange(8)
+                data = bytes(buf)
+            if self.reorder_probability \
+                    and rng.random() < self.reorder_probability \
+                    and held is None:
+                self._held_back = data   # delivered behind the NEXT frame
+            else:
+                frames.append(data)
+        if held is not None:
+            # the previously held frame lands AFTER this one (that's the
+            # reorder) — and even if this frame was dropped, the held one
+            # must not be silently lost
+            frames.append(held)
         partner = self.partner
-        self.overlay.clock.post_action(
-            lambda: partner.data_received(data), name="loopback-delivery")
+        for frame in frames:
+            self.overlay.clock.post_action(
+                lambda f=frame: partner.data_received(f),
+                name="loopback-delivery")
 
     def _close_transport(self) -> None:
         if self.partner is not None and self.partner.state != Peer.CLOSING:

@@ -4,7 +4,10 @@ Reference: src/simulation/ (SURVEY.md §2.1).
 """
 
 from .loadgen import LoadGenerator
-from .simulation import SimNode, Simulation, make_core_topology, qset_of
+from .simulation import (SimNode, Simulation, make_core_topology,
+                         make_cycle_topology,
+                         make_hierarchical_topology, qset_of)
 
 __all__ = ["LoadGenerator", "SimNode", "Simulation", "make_core_topology",
+           "make_cycle_topology", "make_hierarchical_topology",
            "qset_of"]

@@ -219,3 +219,39 @@ def make_core_topology(n: int, threshold: Optional[int] = None,
     for s in secrets:
         sim.add_node(s, q)
     return sim
+
+
+def make_cycle_topology(n: int,
+                        passphrase: bytes = b"sim cycle") -> Simulation:
+    """Ring: each validator trusts itself and both ring neighbours (2-of-3
+    slices).  Reference: Topologies::cycle — connectivity-limited liveness
+    testing; intersection holds because adjacent slices chain around the
+    ring."""
+    sim = Simulation(passphrase)
+    secrets = [SecretKey(bytes([i + 1]) * 32) for i in range(n)]
+    ids = [s.public_key.ed25519 for s in secrets]
+    for i, s in enumerate(secrets):
+        neigh = [ids[i], ids[(i - 1) % n], ids[(i + 1) % n]]
+        sim.add_node(s, qset_of(neigh, 2))
+    return sim
+
+
+def make_hierarchical_topology(n_orgs: int, nodes_per_org: int = 3,
+                               passphrase: bytes = b"sim tiers"
+                               ) -> Simulation:
+    """Tiered: org-inner 2-of-3 qsets nested under a 2/3-of-orgs outer
+    threshold — the tier-1 shape (reference: Topologies::hierarchicalQuorum;
+    same org structure the quorum-intersection bench uses)."""
+    from ..crypto.sha import sha256
+    sim = Simulation(passphrase)
+    secrets = [[SecretKey(sha256(b"hier-node-%d-%d" % (o, g)))
+                for g in range(nodes_per_org)] for o in range(n_orgs)]
+    inner = [qset_of([s.public_key.ed25519 for s in org],
+                     (2 * nodes_per_org + 2) // 3) for org in secrets]
+    outer_threshold = (2 * n_orgs + 2) // 3
+    outer = SX.SCPQuorumSet(threshold=outer_threshold, validators=[],
+                            innerSets=inner)
+    for org in secrets:
+        for s in org:
+            sim.add_node(s, outer)
+    return sim

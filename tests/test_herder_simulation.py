@@ -135,3 +135,24 @@ class TestQuorumTracking:
         assert sim.crank_until_ledger(3, timeout=120)
         for n in sim.nodes:
             assert n.herder.quorum_tracker.node_count == 3
+
+
+def test_cycle_topology_externalizes():
+    """Ring of 2-of-3 neighbour slices reaches consensus (reference:
+    Topologies::cycle acceptance tests)."""
+    from stellar_core_tpu.simulation.simulation import make_cycle_topology
+    sim = make_cycle_topology(4)
+    sim.start_all_nodes()
+    assert sim.crank_until_ledger(3, timeout=300)
+    assert sim.hashes_agree()
+
+
+def test_hierarchical_topology_externalizes():
+    """Tier-1-shaped org hierarchy reaches consensus (reference:
+    Topologies::hierarchicalQuorum)."""
+    from stellar_core_tpu.simulation.simulation import (
+        make_hierarchical_topology)
+    sim = make_hierarchical_topology(3, nodes_per_org=3)
+    sim.start_all_nodes()
+    assert sim.crank_until_ledger(3, timeout=300)
+    assert sim.hashes_agree()

@@ -506,3 +506,48 @@ class TestBanManager:
         pa, pb = make_loopback_pair(oa, ob)
         _crank(clock)
         assert oa.num_authenticated() == 0
+
+
+class TestLoopbackFaultInjection:
+    """Reference: LoopbackPeer damage/drop/reorder knobs — the overlay must
+    fail-stop (drop the peer) on damaged frames, never crash."""
+
+    def _pair(self):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x81" * 32), SecretKey(b"\x82" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"p" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"q" * 32)
+        pa, pb = make_loopback_pair(oa, ob)
+        _crank(clock)
+        assert pa.is_authenticated() and pb.is_authenticated()
+        return clock, pa, pb
+
+    def test_damaged_frame_drops_peer_not_process(self):
+        clock, pa, pb = self._pair()
+        pa.damage_probability = 1.0
+        from stellar_core_tpu import xdr as X
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(7))
+        _crank(clock)
+        # HMAC over the damaged frame fails -> peer dropped, no exception
+        assert pb.state == pb.CLOSING or pa.state == pa.CLOSING
+
+    def test_dropped_frames_are_silent(self):
+        clock, pa, pb = self._pair()
+        pa.drop_probability = 1.0
+        from stellar_core_tpu import xdr as X
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(7))
+        _crank(clock)
+        assert pa.is_authenticated() and pb.is_authenticated()
+
+    def test_reordered_frames_break_auth_sequence(self):
+        """Authenticated streams are sequence-numbered: reordering must be
+        detected (reference: per-message sequence in the HMAC)."""
+        clock, pa, pb = self._pair()
+        pa.reorder_probability = 1.0
+        from stellar_core_tpu import xdr as X
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(1))
+        pa.reorder_probability = 0.0
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(2))
+        _crank(clock)
+        assert pb.state == pb.CLOSING or pa.state == pa.CLOSING
