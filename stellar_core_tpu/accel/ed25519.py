@@ -183,6 +183,49 @@ def verify_forward_raw(s_raw, h_raw, key_idx, ucx, ucy, uct, r_bytes):
 _verify_kernel_raw = jax.jit(verify_forward_raw)
 
 
+def _make_mesh():
+    """1-D device mesh over all visible devices, or None single-device.
+    Multi-chip scaling is pure data parallelism over the signature batch
+    (SURVEY.md §2.5: DP == vmap over signatures), expressed with
+    jax.sharding.Mesh + shard_map so the same code drives a v5e-8 and the
+    virtual 8-device CPU mesh the test suite pins."""
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs), ("data",))
+
+
+def _shard(fn, mesh, in_specs):
+    """jit(shard_map(fn)) with batch-sharded output, handling the
+    jax.shard_map (check_vma) vs jax.experimental.shard_map (check_rep)
+    API split — the kernels' scan carries start unvarying, so the
+    varying-manual-axes check must be off either way."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P("data"), check_vma=False))
+    except (ImportError, TypeError):  # older jax
+        from jax.experimental.shard_map import shard_map as esm
+        return jax.jit(esm(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=P("data"), check_rep=False))
+
+
+def _sharded_generic(mesh):
+    from jax.sharding import PartitionSpec as P
+    b, rep = P("data"), P()
+    return _shard(verify_forward_raw, mesh, (b, b, b, rep, rep, rep, b))
+
+
+def _sharded_tables(mesh):
+    from jax.sharding import PartitionSpec as P
+    from . import tables as _tables
+    b, rep = P("data"), P()
+    return _shard(_tables.verify_tables_forward, mesh,
+                  (b, b, b, b, rep, rep))
+
+
 class Ed25519BatchVerifier:
     """Chunked, jit-cached batch verifier (one compile per chunk size).
 
@@ -216,6 +259,18 @@ class Ed25519BatchVerifier:
         self._pk_cache: dict = {}
         from . import tables as _tables
         self._tables = _tables.KeyTableCache(table_slots)
+        # multi-chip: shard the batch over every visible device (v5e-8
+        # topology or the tests' virtual CPU mesh); single device uses the
+        # plain jitted kernels
+        self._mesh = _make_mesh()
+        if self._mesh is not None:
+            self._ndev = self._mesh.devices.size
+            self._kernel_raw = _sharded_generic(self._mesh)
+            self._kernel_tables = _sharded_tables(self._mesh)
+        else:
+            self._ndev = 1
+            self._kernel_raw = _verify_kernel_raw
+            self._kernel_tables = _tables._verify_tables_jit
         self._use_counts: dict = {}
         # offload observability (VERDICT r1 weak #4): how much of the work
         # runs on which device path.
@@ -314,11 +369,17 @@ class Ed25519BatchVerifier:
             """Full chunks stay chunk_size; a tail pads only to a
             power-of-two bucket (min 256) so a small remainder stream does
             not dispatch an almost-empty full-width kernel, while the set of
-            compiled shapes stays bounded."""
+            compiled shapes stays bounded.  Widths are rounded up to a
+            multiple of the device count so shard_map splits evenly."""
             if count >= cs:
-                return cs
-            return min(cs, max(self.tail_floor,
-                               1 << (count - 1).bit_length()))
+                w = cs
+            else:
+                w = min(cs, max(self.tail_floor,
+                                1 << (count - 1).bit_length()))
+            ndev = self._ndev
+            if w % ndev:
+                w += ndev - (w % ndev)
+            return w
 
         # -- table path (hot keys): raw bytes + slot ids, no doublings ---
         if hot_idx:
@@ -340,7 +401,7 @@ class Ed25519BatchVerifier:
                         [a[start:end],
                          np.zeros((pad,) + a.shape[1:], a.dtype)])
 
-                verdict = _tables._verify_tables_jit(
+                verdict = self._kernel_tables(
                     jnp.asarray(padded(s_raw)), jnp.asarray(padded(hh)),
                     jnp.asarray(padded(slots)), jnp.asarray(padded(rb)),
                     tabs.table, base_tab)
@@ -384,7 +445,7 @@ class Ed25519BatchVerifier:
                         [a[start:end],
                          np.zeros((pad,) + a.shape[1:], a.dtype)])
 
-                verdict = _verify_kernel_raw(
+                verdict = self._kernel_raw(
                     jnp.asarray(padded(s_raw)), jnp.asarray(padded(hh)),
                     jnp.asarray(padded(kidx)), ucx_d, ucy_d, uct_d,
                     jnp.asarray(padded(rb)))

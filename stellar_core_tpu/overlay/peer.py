@@ -371,7 +371,12 @@ class LoopbackPeer(Peer):
             if self.reorder_probability \
                     and rng.random() < self.reorder_probability \
                     and held is None:
-                self._held_back = data   # delivered behind the NEXT frame
+                # delivered behind the NEXT frame; a posted backstop keeps
+                # quiesced traffic from turning 'reorder' into 'drop'
+                self._held_back = data
+                self._backstop_rounds = 2
+                self.overlay.clock.post_action(self._reorder_backstop,
+                                               name="loopback-reorder-flush")
             else:
                 frames.append(data)
         if held is not None:
@@ -385,7 +390,30 @@ class LoopbackPeer(Peer):
                 lambda f=frame: partner.data_received(f),
                 name="loopback-delivery")
 
+    def _flush_held(self) -> None:
+        """Deliver a reorder-held frame that nothing has overtaken."""
+        held, self._held_back = self._held_back, None
+        if held is not None and self.partner is not None:
+            partner = self.partner
+            self.overlay.clock.post_action(
+                lambda: partner.data_received(held),
+                name="loopback-delivery")
+
+    def _reorder_backstop(self) -> None:
+        """Flush a still-held frame after a grace round — frames posted
+        later in the same crank get to overtake (that's the reorder), but
+        a quiesced stream still delivers everything eventually."""
+        if self._held_back is None:
+            return
+        self._backstop_rounds -= 1
+        if self._backstop_rounds > 0:
+            self.overlay.clock.post_action(self._reorder_backstop,
+                                           name="loopback-reorder-flush")
+        else:
+            self._flush_held()
+
     def _close_transport(self) -> None:
+        self._flush_held()
         if self.partner is not None and self.partner.state != Peer.CLOSING:
             partner, self.partner = self.partner, None
             partner.partner = None

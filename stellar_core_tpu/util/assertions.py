@@ -29,8 +29,5 @@ def release_assert_or_throw(cond: bool, exc_type=None,
     if not cond:
         raise (exc_type or ReleaseAssertError)(msg)
 
-
-def dbg_assert(cond: bool, msg: str = "") -> None:
-    """Strippable sanity check for hot loops — documents that the
-    condition is NOT consensus-critical."""
-    assert cond, msg
+# For strippable hot-loop sanity checks, use a plain `assert` statement at
+# the call site — a helper function cannot avoid evaluating the condition.

@@ -165,3 +165,29 @@ def test_wrong_length_inputs():
     got = ed.verify_batch([pk, pk[:31], pk], [sig[:63], sig, sig],
                           [b"z", b"z", b"z"], chunk_size=CHUNK)
     assert list(got) == [False, False, True]
+
+
+def test_verifier_shards_over_test_mesh():
+    """Under the suite's 8-virtual-device mesh the production verifier
+    must take the shard_map path (v5e-8 topology analog) and still agree
+    with libsodium."""
+    jax = pytest.importorskip("jax")
+    from stellar_core_tpu.accel.ed25519 import Ed25519BatchVerifier
+    from stellar_core_tpu.crypto import sodium
+
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device backend: no mesh to shard over")
+    v = Ed25519BatchVerifier(chunk_size=512, tail_floor=256)
+    assert v._mesh is not None and v._ndev == len(jax.devices())
+    pks, sigs, msgs = [], [], []
+    for i in range(40):
+        pk, sk = sodium.sign_seed_keypair(bytes([i % 5 + 1]) * 32)
+        m = bytes([i]) * 33
+        pks.append(pk)
+        sigs.append(sodium.sign_detached(m, sk))
+        msgs.append(m)
+    sigs[7] = sigs[7][:32] + bytes(32)  # one corrupted signature
+    out = v.verify(pks, sigs, msgs)
+    expected = [sodium.verify_detached(s, m, p)
+                for p, s, m in zip(pks, sigs, msgs)]
+    assert out.tolist() == expected

@@ -551,3 +551,16 @@ class TestLoopbackFaultInjection:
         pa.send_message(X.StellarMessage.getSCPLedgerSeq(2))
         _crank(clock)
         assert pb.state == pb.CLOSING or pa.state == pa.CLOSING
+
+    def test_reorder_held_frame_not_lost_when_stream_quiesces(self):
+        """A held-back frame with no successor must still arrive (reorder
+        must not degrade into drop)."""
+        clock, pa, pb = self._pair()
+        pa.reorder_probability = 1.0
+        from stellar_core_tpu import xdr as X
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(9))
+        pa.reorder_probability = 0.0
+        _crank(clock)
+        # the single (held) frame was flushed by the backstop and, being
+        # alone, arrives in order: connection stays healthy
+        assert pa.is_authenticated() and pb.is_authenticated()
