@@ -17,6 +17,7 @@ catchup work-unit into a single vmapped Ed25519 verify").
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from .. import xdr as X
@@ -157,6 +158,36 @@ def preverify_checkpoint_signatures(network_id: bytes,
     return {"total": total, "shipped": len(pks)}
 
 
+@dataclass
+class CatchupRange:
+    """Partition of a catchup target into a bucket-apply point and a
+    replay range (reference: src/catchup/CatchupRange.{h,cpp} — the
+    `--at X --count N` / CATCHUP_RECENT planning)."""
+    apply_buckets_at: Optional[int]   # checkpoint to assume; None = genesis
+    replay_to: int
+
+    @property
+    def replay_from(self) -> int:
+        return (self.apply_buckets_at + 1 if self.apply_buckets_at
+                else 2)
+
+
+def plan_catchup_range(target: int, count: Optional[int]) -> CatchupRange:
+    """Choose the newest published checkpoint boundary that still leaves
+    >= `count` ledgers to replay before `target` (reference:
+    CatchupRange's 'replayed range covers count, buckets cover the rest').
+    count=None (CATCHUP_COMPLETE) replays everything from genesis."""
+    from ..history.archive import CHECKPOINT_FREQUENCY
+    first_boundary = CHECKPOINT_FREQUENCY - 1   # 63
+    if count is None or target - count < first_boundary:
+        return CatchupRange(apply_buckets_at=None, replay_to=target)
+    boundary = ((target - count + 1) // CHECKPOINT_FREQUENCY
+                ) * CHECKPOINT_FREQUENCY - 1
+    if boundary < first_boundary:
+        return CatchupRange(apply_buckets_at=None, replay_to=target)
+    return CatchupRange(apply_buckets_at=boundary, replay_to=target)
+
+
 class CatchupManager:
     """Replay/assume-state driver (reference: CatchupManagerImpl +
     CatchupWork).  `accel=True` routes checkpoint signature verification
@@ -206,6 +237,17 @@ class CatchupManager:
 
         mgr = LedgerManager(self.network_id, invariant_manager=None)  # hot replay path: hash checks are the oracle
         mgr.start_new_ledger()
+        self._run_catchup_work(mgr, archive, target, clock, lookahead)
+        return mgr
+
+    def _run_catchup_work(self, mgr: LedgerManager,
+                          archive: FileHistoryArchive, target: int,
+                          clock=None, lookahead: int = 2) -> None:
+        """Crank a CatchupWork DAG from mgr's current LCL to `target`
+        (shared by complete and recent modes)."""
+        from ..historywork.works import CatchupWork
+        from ..util.clock import ClockMode, VirtualClock
+
         if clock is None:
             clock = VirtualClock(ClockMode.VIRTUAL_TIME)
         work = CatchupWork(clock, mgr, archive, target, self.network_id,
@@ -224,16 +266,50 @@ class CatchupManager:
             raise CatchupError(
                 f"catchup ended at {mgr.last_closed_ledger_seq}, "
                 f"target {target}")
-        return mgr
 
-    # -- minimal (assume state from buckets, no replay) ---------------------
-    def catchup_minimal(self, archive: FileHistoryArchive) -> LedgerManager:
-        """Assume the checkpoint state snapshot from bucket files
-        (reference: ApplyBucketsWork + BucketApplicator), verifying every
-        bucket hash and the reassembled bucket-list hash against the header."""
+    # -- recent (assume buckets at a boundary, replay the tail) -------------
+    def catchup_recent(self, archive: FileHistoryArchive, count: int,
+                       to_ledger: Optional[int] = None,
+                       clock=None, lookahead: int = 2) -> LedgerManager:
+        """CATCHUP_RECENT / `catchup --at X --count N`: assume the bucket
+        snapshot at the newest checkpoint leaving >= count ledgers to
+        replay, then replay the tail to the target (reference:
+        CatchupWork over a CatchupRange with both bucket-apply and replay
+        segments)."""
         has = archive.get_state()
         if has is None:
             raise CatchupError("archive has no HAS")
+        target = to_ledger if to_ledger is not None else has.current_ledger
+        rng = plan_catchup_range(target, count)
+        if rng.apply_buckets_at is None:
+            return self.catchup_complete(archive, to_ledger=target,
+                                         clock=clock, lookahead=lookahead)
+        log.info("catchup range: buckets at %d, replay %d..%d",
+                 rng.apply_buckets_at, rng.replay_from, rng.replay_to)
+        mgr = self.catchup_minimal(archive, checkpoint=rng.apply_buckets_at)
+        if mgr.last_closed_ledger_seq < target:
+            self._run_catchup_work(mgr, archive, target, clock, lookahead)
+        return mgr
+
+    # -- minimal (assume state from buckets, no replay) ---------------------
+    def catchup_minimal(self, archive: FileHistoryArchive,
+                        checkpoint: Optional[int] = None) -> LedgerManager:
+        """Assume a checkpoint's state snapshot from bucket files
+        (reference: ApplyBucketsWork + BucketApplicator), verifying every
+        bucket hash and the reassembled bucket-list hash against the
+        header.  `checkpoint` targets a specific published boundary (the
+        CatchupRange bucket-apply point); default = the archive tip."""
+        has = archive.get_state(checkpoint)
+        if has is None:
+            raise CatchupError(
+                "archive has no HAS" if checkpoint is None
+                else f"archive has no HAS for checkpoint {checkpoint}")
+        if checkpoint is not None and has.current_ledger != checkpoint:
+            # a mirror that serves the wrong HAS here would silently skip
+            # the whole replay tail of a CATCHUP_RECENT plan — fail-stop
+            raise CatchupError(
+                f"archive HAS for checkpoint {checkpoint} claims ledger "
+                f"{has.current_ledger}")
         checkpoint = has.current_ledger
         headers = self._read_headers(archive, checkpoint)
         verify_ledger_chain(headers)

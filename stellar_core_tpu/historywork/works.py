@@ -186,7 +186,11 @@ class CatchupWork(Work):
         super().on_reset()
         self._downloads = {}
         self._apply = None
-        self._apply_checkpoint = checkpoint_containing(2)
+        # resume from wherever the manager already is: complete catchup
+        # starts at genesis's checkpoint, CATCHUP_RECENT at the first
+        # checkpoint past the assumed bucket state (CatchupRange)
+        self._apply_checkpoint = checkpoint_containing(
+            max(2, self.mgr.last_closed_ledger_seq + 1))
         self._prev_tail = None
 
     def on_run(self) -> State:
@@ -212,9 +216,12 @@ class CatchupWork(Work):
             return State.FAILURE
         # cross-checkpoint chain continuity
         if self._apply is None:
-            if self._prev_tail is not None and dl.headers and \
-                    dl.headers[0].header.previousLedgerHash \
-                    != self._prev_tail.hash:
+            prev_hash = (self._prev_tail.hash if self._prev_tail is not None
+                         else self.mgr.lcl_hash)  # assumed-state anchor
+            if prev_hash is not None and dl.headers and \
+                    dl.headers[0].header.ledgerSeq \
+                    == self.mgr.last_closed_ledger_seq + 1 and \
+                    dl.headers[0].header.previousLedgerHash != prev_hash:
                 self.error_detail = f"chain broken across checkpoint {cp}"
                 log.error("catchup: %s", self.error_detail)
                 return State.FAILURE

@@ -109,6 +109,9 @@ class Application:
         if config.METADATA_OUTPUT_STREAM:
             self.lm.meta_stream = open(config.METADATA_OUTPUT_STREAM, "ab")
         self.herder.ledger_closed_hook = self._on_ledger_closed
+        # a node that falls behind pulls recent SCP state from its peers
+        # (reference: HerderImpl out-of-sync recovery → getMoreSCPState)
+        self.herder.out_of_sync_handler = self.overlay.request_scp_state
         self.catchup = CatchupManager(
             self.network_id, config.NETWORK_PASSPHRASE,
             accel=config.ACCEL == "tpu",

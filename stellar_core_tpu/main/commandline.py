@@ -94,10 +94,26 @@ def cmd_catchup(args) -> int:
     cm = CatchupManager(cfg.network_id(), cfg.NETWORK_PASSPHRASE,
                         accel=cfg.ACCEL == "tpu",
                         accel_chunk=cfg.ACCEL_CHUNK_SIZE)
+    at = None
+    if args.at and args.at != "current":
+        try:
+            at = int(args.at)
+        except ValueError:
+            print(f"--at must be a ledger number or 'current', "
+                  f"got {args.at!r}", file=sys.stderr)
+            return 1
+    if at is not None and args.to is not None and at != args.to:
+        print("--at and --to conflict; give one", file=sys.stderr)
+        return 1
+    at = at if at is not None else args.to
     if args.mode == "minimal":
-        lm = cm.catchup_minimal(archive)
+        lm = cm.catchup_minimal(archive, checkpoint=at)
+    elif args.count is not None:
+        # reference: `catchup --at X --count N` — buckets to the nearest
+        # boundary, replay the last N ledgers
+        lm = cm.catchup_recent(archive, count=args.count, to_ledger=at)
     else:
-        lm = cm.catchup_complete(archive, to_ledger=args.to)
+        lm = cm.catchup_complete(archive, to_ledger=at)
     print(f"caught up to ledger {lm.last_closed_ledger_seq} "
           f"hash {lm.lcl_hash.hex()}")
     if cfg.DATABASE:
@@ -473,7 +489,13 @@ def main(argv=None) -> int:
     s = sub.add_parser("catchup", help="catch up from a history archive")
     s.add_argument("--conf", required=True)
     s.add_argument("--archive", default="")
-    s.add_argument("--to", type=int, default=None)
+    s.add_argument("--to", type=int, default=None,
+                   help="alias of --at as a plain ledger number")
+    s.add_argument("--at", default="",
+                   help="target ledger, or 'current' for the archive tip")
+    s.add_argument("--count", type=int, default=None,
+                   help="replay only the last COUNT ledgers; buckets "
+                        "cover the rest (CATCHUP_RECENT)")
     s.add_argument("--mode", choices=["complete", "minimal"],
                    default="complete")
     s.set_defaults(fn=cmd_catchup)

@@ -200,6 +200,15 @@ class OverlayManager:
         else:
             peer.send_message(X.StellarMessage.qSetHash(h))
 
+    def request_scp_state(self) -> None:
+        """Ask every authenticated peer for recent SCP state (reference:
+        HerderImpl::getMoreSCPState → Peer::sendGetScpState) — the lagging
+        node's first recovery step; archive catchup takes over when the
+        gap exceeds the peers' slot memory."""
+        seq = max(0, self.herder.tracking_consensus_ledger_index() - 1)
+        for peer in self._auth_peer_list():
+            peer.send_message(X.StellarMessage.getSCPLedgerSeq(seq))
+
     def flush_adverts(self) -> None:
         self.adverts.flush_all()
 

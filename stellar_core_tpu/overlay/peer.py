@@ -348,6 +348,7 @@ class LoopbackPeer(Peer):
         import random as _random
         self.fault_rng = _random.Random(0)  # deterministic by default
         self._held_back: Optional[bytes] = None
+        self._backstop_gen = 0
 
     def _write_bytes(self, data: bytes) -> None:
         if self.partner is None or self.drop_outbound:
@@ -374,9 +375,7 @@ class LoopbackPeer(Peer):
                 # delivered behind the NEXT frame; a posted backstop keeps
                 # quiesced traffic from turning 'reorder' into 'drop'
                 self._held_back = data
-                self._backstop_rounds = 2
-                self.overlay.clock.post_action(self._reorder_backstop,
-                                               name="loopback-reorder-flush")
+                self._arm_backstop()
             else:
                 frames.append(data)
         if held is not None:
@@ -399,18 +398,25 @@ class LoopbackPeer(Peer):
                 lambda: partner.data_received(held),
                 name="loopback-delivery")
 
-    def _reorder_backstop(self) -> None:
+    def _arm_backstop(self) -> None:
         """Flush a still-held frame after a grace round — frames posted
         later in the same crank get to overtake (that's the reorder), but
-        a quiesced stream still delivers everything eventually."""
-        if self._held_back is None:
-            return
-        self._backstop_rounds -= 1
-        if self._backstop_rounds > 0:
-            self.overlay.clock.post_action(self._reorder_backstop,
-                                           name="loopback-reorder-flush")
-        else:
-            self._flush_held()
+        a quiesced stream still delivers everything eventually.  Each hold
+        gets its own generation so a stale backstop from an earlier hold
+        cannot shorten the current frame's grace period."""
+        self._backstop_gen += 1
+        gen = self._backstop_gen
+
+        def tick(rounds: int = 2) -> None:
+            if self._held_back is None or self._backstop_gen != gen:
+                return  # released by a later send, or superseded
+            if rounds > 1:
+                self.overlay.clock.post_action(
+                    lambda: tick(rounds - 1), name="loopback-reorder-flush")
+            else:
+                self._flush_held()
+
+        self.overlay.clock.post_action(tick, name="loopback-reorder-flush")
 
     def _close_transport(self) -> None:
         self._flush_held()

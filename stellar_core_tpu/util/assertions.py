@@ -4,7 +4,8 @@ releaseAssert / releaseAssertOrThrow).
 The reference never uses plain `assert` for consensus-critical conditions:
 release builds keep the checks (crash-only/fail-stop philosophy, SURVEY.md
 §5.2-5.3).  Python's `assert` disappears under ``-O`` — these don't.
-`dbg_assert` marks the checks that MAY be stripped (hot-loop sanity only).
+Plain `assert` statements remain the marker for strippable hot-loop
+sanity checks.
 """
 
 from __future__ import annotations

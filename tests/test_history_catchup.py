@@ -294,3 +294,47 @@ def test_command_template_archive_publish_and_catchup(tmp_path):
     cm = CatchupManager(NID, PASSPHRASE)
     fresh = cm.catchup_complete(archive)
     assert fresh.lcl_hash == mgr.lcl_hash
+
+
+def test_catchup_recent_assumes_boundary_and_replays_tail(tmp_path):
+    """CATCHUP_RECENT: bucket-apply at the newest boundary leaving >= count
+    ledgers, replay the tail, identical final hash (reference:
+    CatchupRange + CatchupWork with both segments)."""
+    from stellar_core_tpu.catchup.catchup import (CatchupManager,
+                                                  plan_catchup_range)
+
+    # two checkpoints: 63 and 127
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(str(tmp_path / "arc"))
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=21)
+    gen.create_accounts(20, per_ledger=10)
+    gen.payment_ledgers(100, txs_per_ledger=4)
+    gen.run_to_checkpoint_boundary()
+    assert mgr.last_closed_ledger_seq == 127
+    assert history.published_checkpoints == [63, 127]
+
+    rng = plan_catchup_range(127, count=10)
+    assert rng.apply_buckets_at == 63 and rng.replay_from == 64
+
+    cm = CatchupManager(NID, PASSPHRASE)
+    fresh = cm.catchup_recent(archive, count=10)
+    assert fresh.last_closed_ledger_seq == 127
+    assert fresh.lcl_hash == mgr.lcl_hash
+
+    # a count larger than the chain falls back to complete replay
+    assert plan_catchup_range(127, count=500).apply_buckets_at is None
+    fresh2 = cm.catchup_recent(archive, count=500)
+    assert fresh2.lcl_hash == mgr.lcl_hash
+
+
+def test_plan_catchup_range_boundaries():
+    from stellar_core_tpu.catchup.catchup import plan_catchup_range
+    assert plan_catchup_range(1000, None).apply_buckets_at is None
+    r = plan_catchup_range(1000, 100)
+    # newest boundary <= 900
+    assert r.apply_buckets_at == 895 and r.replay_from == 896
+    assert plan_catchup_range(1000, 100).replay_to == 1000
+    assert plan_catchup_range(64, 10).apply_buckets_at is None  # 54 < 63
+    assert plan_catchup_range(127, 64).apply_buckets_at == 63
