@@ -110,8 +110,10 @@ class Application:
             self.lm.meta_stream = open(config.METADATA_OUTPUT_STREAM, "ab")
         self.herder.ledger_closed_hook = self._on_ledger_closed
         # a node that falls behind pulls recent SCP state from its peers
-        # (reference: HerderImpl out-of-sync recovery → getMoreSCPState)
-        self.herder.out_of_sync_handler = self.overlay.request_scp_state
+        # (reference: HerderImpl out-of-sync recovery → getMoreSCPState);
+        # beyond the peers' slot memory, archive catchup takes over
+        self.herder.out_of_sync_handler = self._on_out_of_sync
+        self._catchup_work = None
         self.catchup = CatchupManager(
             self.network_id, config.NETWORK_PASSPHRASE,
             accel=config.ACCEL == "tpu",
@@ -134,6 +136,56 @@ class Application:
         self.history.ledger_closed(arts)
         self.overlay.clear_below(
             max(0, self.lm.last_closed_ledger_seq - 100))
+
+    def _on_out_of_sync(self) -> None:
+        self.overlay.request_scp_state()
+        self.maybe_start_archive_catchup()
+
+    def maybe_start_archive_catchup(self) -> None:
+        """In-place archive catchup when the gap exceeds what peers can
+        replay from SCP memory (reference: HerderImpl out-of-sync →
+        CatchupManager::startCatchup; the herder keeps buffering
+        externalized values meanwhile and _drain_buffered applies them
+        once the replay closes the gap — ApplyBufferedLedgersWork)."""
+        from ..herder.herder import MAX_SLOTS_TO_REMEMBER
+        if self._catchup_work is not None and not self._catchup_work.done:
+            return
+        if not self.history.archives:
+            return
+        has = self.history.archives[0].get_state()
+        if has is None:
+            return
+        gap = has.current_ledger - self.lm.last_closed_ledger_seq
+        if gap <= MAX_SLOTS_TO_REMEMBER:
+            return  # peers' SCP state covers it
+        from ..historywork.works import CatchupWork
+        log.info("starting in-place archive catchup: lcl=%d archive=%d",
+                 self.lm.last_closed_ledger_seq, has.current_ledger)
+        work = CatchupWork(self.clock, self.lm,
+                           self.history.archives[0], has.current_ledger,
+                           self.network_id,
+                           accel=self.config.ACCEL == "tpu",
+                           accel_chunk=self.config.ACCEL_CHUNK_SIZE,
+                           stats=self.catchup.stats)
+        self._catchup_work = work
+        work.start()
+        self._watch_catchup()
+
+    def _watch_catchup(self) -> None:
+        """Poll the catchup DAG from the crank loop; on completion, drain
+        any live ledgers the herder buffered during the replay."""
+        from ..util.clock import VirtualTimer
+        if not self._catchup_work.done:
+            t = VirtualTimer(self.clock)
+            self._catchup_watch_timer = t
+            t.expires_from_now(0.2, self._watch_catchup)
+            return
+        ok = self._catchup_work.succeeded
+        log.info("archive catchup %s at lcl=%d",
+                 "complete" if ok else "FAILED",
+                 self.lm.last_closed_ledger_seq)
+        self._catchup_work = None
+        self.herder._drain_buffered()
 
     def start(self) -> None:
         """Reference: ApplicationImpl::start — restore state, join

@@ -406,3 +406,47 @@ class TestNodeAdminSurface:
         app.herder.upgrades.set_parameters(None)
         assert app.herder.upgrades.pending_json()["basefee"] is None
         app.stop()
+
+
+class TestInPlaceArchiveCatchup:
+    def test_out_of_sync_node_catches_up_from_archive(self, tmp_path):
+        """A live node whose gap exceeds peers' SCP memory replays from
+        the configured archive IN PLACE (same LedgerManager), then drains
+        any buffered live ledgers (reference: out-of-sync ->
+        CatchupManager::startCatchup + ApplyBufferedLedgersWork)."""
+        from stellar_core_tpu.history.archive import FileHistoryArchive
+        from stellar_core_tpu.history.manager import HistoryManager
+        from stellar_core_tpu.ledger.manager import LedgerManager
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.simulation.loadgen import LoadGenerator
+        from stellar_core_tpu.testutils import network_id
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        passphrase = "inplace catchup net"
+        nid = network_id(passphrase)
+        src = LedgerManager(nid)
+        src.start_new_ledger()
+        archive = FileHistoryArchive(str(tmp_path / "arch"))
+        hist = HistoryManager(src, passphrase, [archive])
+        gen = LoadGenerator(src, hist, seed=31)
+        gen.create_accounts(16, per_ledger=8)
+        gen.payment_ledgers(50, txs_per_ledger=4)
+        gen.run_to_checkpoint_boundary()
+        tip = src.last_closed_ledger_seq
+
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": passphrase,
+            "PEER_PORT": 0,
+            "HISTORY": {"main": {"get": str(tmp_path / "arch")}},
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        app.start()
+        assert app.lm.last_closed_ledger_seq == 1
+        app.maybe_start_archive_catchup()
+        assert app._catchup_work is not None
+        ok = clock.crank_until(
+            lambda: app.lm.last_closed_ledger_seq >= tip, timeout=600)
+        assert ok
+        assert app.lm.lcl_hash == src.lcl_hash
+        app.stop()
