@@ -17,6 +17,7 @@ from __future__ import annotations
 import gzip
 import json
 import os
+import re
 import struct
 from typing import Iterator, List, Optional
 
@@ -83,7 +84,22 @@ def category_path(category: str, checkpoint: int, suffix: str = ".xdr.gz") -> st
     return f"{category}/{h[0:2]}/{h[2:4]}/{h[4:6]}/{category}-{h}{suffix}"
 
 
+_HEX256_RE = re.compile(r"[0-9a-f]{64}")
+
+
+def require_hex256(hash_hex: str) -> str:
+    """Strict SHA-256 hex validation (reference: hexToBin256).  HAS files
+    come from untrusted archives and their hashes are interpolated into
+    filesystem paths and shell command templates — anything that is not
+    exactly 64 lowercase hex chars is rejected before it gets near either.
+    """
+    if not isinstance(hash_hex, str) or _HEX256_RE.fullmatch(hash_hex) is None:
+        raise ValueError(f"invalid bucket hash in archive data: {hash_hex!r}")
+    return hash_hex
+
+
 def bucket_path(hash_hex: str) -> str:
+    require_hex256(hash_hex)
     return (f"bucket/{hash_hex[0:2]}/{hash_hex[2:4]}/{hash_hex[4:6]}/"
             f"bucket-{hash_hex}.xdr.gz")
 
@@ -145,7 +161,12 @@ class HistoryArchiveState:
             nxt = b.get("next")
             if nxt is not None and nxt.get("state", 0) == 0:
                 nxt = None
-            levels.append({"curr": b["curr"], "snap": b["snap"],
+            if nxt is not None:
+                for key in ("output", "curr", "snap"):
+                    if key in nxt and nxt[key] is not None:
+                        require_hex256(nxt[key])
+            levels.append({"curr": require_hex256(b["curr"]),
+                           "snap": require_hex256(b["snap"]),
                            "next": nxt})
         return HistoryArchiveState(
             current_ledger=d["currentLedger"],
@@ -319,6 +340,14 @@ class CommandHistoryArchive(HistoryArchiveBase):
         self._tmp = tempfile.mkdtemp(prefix="sctpu-archive-")
         self._made_dirs: set = set()
 
+    @staticmethod
+    def _q(path: str) -> str:
+        # Paths reaching the shell are archive-derived (category_path /
+        # bucket_path, both strictly validated) — quoting is defense in
+        # depth against any future caller passing raw remote data.
+        import shlex
+        return shlex.quote(path)
+
     def _run(self, cmdline: str) -> bool:
         import subprocess
         from ..util import logging as slog
@@ -335,7 +364,7 @@ class CommandHistoryArchive(HistoryArchiveBase):
         if d and d not in self._made_dirs:
             # cache only on success — a transient mkdir failure must be
             # retried by the next put, not poisoned into the cache
-            if self._run(self.mkdir_template.format(d)):
+            if self._run(self.mkdir_template.format(self._q(d))):
                 self._made_dirs.add(d)
 
     def put_bytes(self, rel: str, data: bytes) -> None:
@@ -345,7 +374,7 @@ class CommandHistoryArchive(HistoryArchiveBase):
         with open(local, "wb") as f:
             f.write(data)
         self._mkdir_remote(rel)
-        if not self._run(self.put_template.format(local, rel)):
+        if not self._run(self.put_template.format(self._q(local), self._q(rel))):
             raise RuntimeError(f"archive put failed for {rel}")
 
     def get_bytes(self, rel: str) -> Optional[bytes]:
@@ -356,7 +385,7 @@ class CommandHistoryArchive(HistoryArchiveBase):
             os.unlink(local)
         except FileNotFoundError:
             pass
-        if not self._run(self.get_template.format(rel, local)):
+        if not self._run(self.get_template.format(self._q(rel), self._q(local))):
             return None
         try:
             with open(local, "rb") as f:

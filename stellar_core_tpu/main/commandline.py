@@ -107,6 +107,12 @@ def cmd_catchup(args) -> int:
         return 1
     at = at if at is not None else args.to
     if args.mode == "minimal":
+        if args.count is not None:
+            # --count asks for CATCHUP_RECENT (bucket-apply + replay of the
+            # last N); an explicit minimal mode would silently drop it
+            print("--count conflicts with --mode minimal; omit --mode for "
+                  "recent-N catchup", file=sys.stderr)
+            return 1
         lm = cm.catchup_minimal(archive, checkpoint=at)
     elif args.count is not None:
         # reference: `catchup --at X --count N` — buckets to the nearest

@@ -135,8 +135,9 @@ class CommandHandler:
                         self._log_level(parse_qs(url.query))
                     elif url.path == "/logrotate":
                         from ..util import logging as slog2
-                        slog2.rotate()
-                        self._reply({"status": "rotated"})
+                        self._reply(handler_self._on_main(
+                            slog2.rotate, name="logrotate")
+                            or {"status": "rotated"})
                     elif url.path == "/manualclose":
                         self._reply(handler_self._on_main(
                             lambda: app.manual_close(), name="manualclose"))
@@ -176,9 +177,13 @@ class CommandHandler:
                             app.maintainer.perform_maintenance,
                             name="maintenance"))
                     elif url.path == "/clearmetrics":
+                        # marshalled: the registry is mutated by main-thread
+                        # metric insertion; clearing from the HTTP thread
+                        # would race snapshot/insert iteration
                         from ..util.metrics import registry
-                        registry().clear()
-                        self._reply({"status": "cleared"})
+                        self._reply(handler_self._on_main(
+                            lambda: registry().clear(), name="clearmetrics")
+                            or {"status": "cleared"})
                     elif url.path == "/self-check":
                         self._reply(handler_self._on_main(
                             app.self_check, name="self-check"))
@@ -217,6 +222,10 @@ class CommandHandler:
                 if level is None:
                     self._reply({"levels": slog2.current_levels()})
                     return
+                # direct call, deliberately NOT marshalled: setLevel is a
+                # thread-safe single attribute store, and /ll must keep
+                # working while the main loop is stalled — that is exactly
+                # when an operator reaches for it
                 slog2.set_level(level.upper(), partition)
                 self._reply({"status": "ok", "partition": partition or "all",
                              "level": level.upper()})

@@ -58,6 +58,15 @@ class SurveyManager:
         self._results: Dict[bytes, dict] = {}   # surveyed node id -> body
         self._bad_response_nodes: List[str] = []
         self._last_nonce: Optional[int] = None
+        # relay side: nonces of surveys seen via a valid start-collecting
+        # (nonce -> (surveyor node id, start ledgerNum)).  A relay node that
+        # could not adopt the collecting phase (e.g. another survey was
+        # live, or its own phase expired) must still forward requests for a
+        # known active survey — the reference relays on the nonce belonging
+        # to an active survey, not on local collecting state.  The surveyor
+        # binding prevents an unprivileged peer from riding a live nonce
+        # (relay amplification) or forging a stop that kills relaying.
+        self._known_nonces: Dict[int, tuple] = {}
 
     # -- signing helpers -----------------------------------------------------
     # Domain-separated: start/stop (and request/response) messages have
@@ -184,6 +193,9 @@ class SurveyManager:
                             signed.signature):
             return False
         self.maybe_expire()
+        # remember the nonce (bound to its surveyor) for request relaying
+        # even when we cannot adopt the collecting phase locally
+        self._known_nonces[msg.nonce] = (surveyor, msg.ledgerNum)
         if self.collecting is not None:
             # one survey at a time; a fresh START must not clobber a live
             # collecting phase (an abandoned one expires via maybe_expire)
@@ -196,9 +208,18 @@ class SurveyManager:
         if not self._verify(msg.surveyorID.value, self.TAG_STOP,
                             msg.to_xdr(), signed.signature):
             return False
+        # only the surveyor who started the survey may stop it — a stop
+        # self-signed by any other peer must neither clear the nonce nor
+        # be relayed
+        entry = self._known_nonces.get(msg.nonce)
+        known = entry is not None and entry[0] == msg.surveyorID.value
+        if known:
+            del self._known_nonces[msg.nonce]
         if self.collecting is None or self.collecting.nonce != msg.nonce \
                 or self.collecting.surveyor != msg.surveyorID.value:
-            return False
+            # still relay a stop for a known survey so it reaches
+            # collectors behind this node
+            return known
         self.collecting = None
         return True
 
@@ -210,11 +231,19 @@ class SurveyManager:
         if not self._verify(surveyor, self.TAG_REQUEST, req.to_xdr(),
                             signed.requestSignature):
             return False
-        if self.collecting is None or self.collecting.nonce != req.nonce \
-                or self.collecting.surveyor != surveyor:
-            return False  # not in this run's collecting phase
+        self.maybe_expire()
+        local = (self.collecting is not None
+                 and self.collecting.nonce == req.nonce
+                 and self.collecting.surveyor == surveyor)
         if inner.surveyedPeerID.value != self.overlay.node_id:
-            return True   # relay toward the surveyed node
+            # relay toward the surveyed node whenever the nonce belongs to
+            # a known active survey AND the request comes from the surveyor
+            # who started it, even if this node missed/expired the
+            # collecting phase — nodes behind us may still be collecting
+            entry = self._known_nonces.get(req.nonce)
+            return local or (entry is not None and entry[0] == surveyor)
+        if not local:
+            return False  # addressed to us but we are not in this run
         body = self._build_response_body()
         blob = box.seal(inner.encryptionKey.key, body.to_xdr())
         resp = X.TimeSlicedSurveyResponseMessage(
@@ -273,6 +302,11 @@ class SurveyManager:
         if self.collecting is not None and self._ledger_num() > \
                 self.collecting.start_ledger + MAX_COLLECTING_LEDGERS:
             self.collecting = None
+        now = self._ledger_num()
+        stale = [n for n, (_sv, start) in self._known_nonces.items()
+                 if now > start + MAX_COLLECTING_LEDGERS]
+        for n in stale:
+            del self._known_nonces[n]
 
     def _build_response_body(self) -> X.SurveyResponseBody:
         inbound, outbound = [], []

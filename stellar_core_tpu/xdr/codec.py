@@ -560,7 +560,10 @@ def xdr_union(name: str, switch_type, arms: Dict[Any, Tuple[str, Any]],
                     make.__name__ = self.arm_name
                     self._made = make
                 return make
-            if obj.switch != self.disc:
+            # match by arm NAME, not discriminant: several discriminants may
+            # share an arm name (e.g. SCError's SCE_VALUE/SCE_AUTH `code`),
+            # and instance access must work for all of them
+            if obj.arm != self.arm_name:
                 raise AttributeError(
                     f"{name} holds arm {obj.arm!r}, not {self.arm_name!r}")
             return obj.value

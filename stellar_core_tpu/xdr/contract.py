@@ -47,15 +47,19 @@ SCErrorCode = xdr_enum("SCErrorCode", {
     "SCEC_UNEXPECTED_SIZE": 9,
 })
 
+# Upstream Stellar-contract.x: only SCE_CONTRACT carries contractCode and
+# only SCE_VALUE / SCE_AUTH carry an SCErrorCode; the remaining arms are
+# void.  Distinct arm names per void arm — the union machinery installs one
+# constructor per name, so sharing "void" would pin it to the first arm.
 SCError = xdr_union("SCError", SCErrorType, {
     SCErrorType.SCE_CONTRACT: ("contractCode", Uint32),
-    SCErrorType.SCE_WASM_VM: ("code", SCErrorCode),
-    SCErrorType.SCE_CONTEXT: ("code", SCErrorCode),
-    SCErrorType.SCE_STORAGE: ("code", SCErrorCode),
-    SCErrorType.SCE_OBJECT: ("code", SCErrorCode),
-    SCErrorType.SCE_CRYPTO: ("code", SCErrorCode),
-    SCErrorType.SCE_EVENTS: ("code", SCErrorCode),
-    SCErrorType.SCE_BUDGET: ("code", SCErrorCode),
+    SCErrorType.SCE_WASM_VM: ("wasmVm", None),
+    SCErrorType.SCE_CONTEXT: ("context", None),
+    SCErrorType.SCE_STORAGE: ("storage", None),
+    SCErrorType.SCE_OBJECT: ("object", None),
+    SCErrorType.SCE_CRYPTO: ("crypto", None),
+    SCErrorType.SCE_EVENTS: ("events", None),
+    SCErrorType.SCE_BUDGET: ("budget", None),
     SCErrorType.SCE_VALUE: ("code", SCErrorCode),
     SCErrorType.SCE_AUTH: ("code", SCErrorCode),
 })

@@ -48,6 +48,40 @@ def test_xdr_stream_roundtrip():
         list(unpack_xdr_stream(b"\x80\x00\x00\x05ab"))  # truncated body
 
 
+def test_malicious_has_bucket_hashes_rejected():
+    # HAS files come from untrusted archives; anything but 64 lowercase hex
+    # must be rejected before it can reach shell templates or file paths
+    # (reference: hexToBin256 on every HAS hash)
+    import json
+
+    from stellar_core_tpu.history.archive import (HistoryArchiveState,
+                                                  bucket_path)
+    good = "ab" * 32
+    for evil in ("aa'; rm -rf ~ #", "../../../etc/passwd", "AB" * 32,
+                 "ab" * 31, "ab" * 33, "", None, 42):
+        doc = {"version": 1, "server": "x", "currentLedger": 63,
+               "networkPassphrase": "p",
+               "currentBuckets": [{"curr": evil, "snap": good,
+                                   "next": {"state": 0}}]}
+        with pytest.raises((ValueError, TypeError)):
+            HistoryArchiveState.from_json(json.dumps(doc))
+        if isinstance(evil, str):
+            with pytest.raises(ValueError):
+                bucket_path(evil)
+    # a pending-merge "next" with a poisoned output hash is equally rejected
+    doc = {"version": 1, "server": "x", "currentLedger": 63,
+           "networkPassphrase": "p",
+           "currentBuckets": [{"curr": good, "snap": good,
+                               "next": {"state": 1,
+                                        "output": "aa`touch /tmp/pwn`"}}]}
+    with pytest.raises(ValueError):
+        HistoryArchiveState.from_json(json.dumps(doc))
+    # the honest shape still parses
+    doc["currentBuckets"][0]["next"] = {"state": 1, "output": good}
+    has = HistoryArchiveState.from_json(json.dumps(doc))
+    assert has.bucket_hashes() == [good, good]
+
+
 def test_checkpoint_published_and_has_readable(published):
     archive, mgr, history = published
     has = archive.get_state()

@@ -439,6 +439,57 @@ class TestSurvey:
         _crank(clock)
         assert oa.survey.results()["topology"] == {}
 
+    def test_relay_forwards_request_when_not_collecting(self):
+        """A relay that missed/expired its own collecting phase must still
+        forward requests whose nonce belongs to the known active survey
+        (reference: relay keyed on active-survey nonce, not local state)."""
+        clock, sks, nodes = self._three_chain()
+        oa, ob, oc = nodes[0][1], nodes[1][1], nodes[2][1]
+        nonce = oa.survey.start_survey(nonce=11)
+        _crank(clock)
+        # B drops its local collecting state (e.g. expiry); the nonce stays
+        # known, so A's request still reaches C through B
+        ob.survey.collecting = None
+        oa.survey.send_request(sks[2].public_key.ed25519)
+        _crank(clock)
+        assert sks[2].public_key.ed25519.hex() in \
+            oa.survey.results()["topology"]
+
+    def test_nonce_rider_and_forged_stop_rejected(self):
+        """An unprivileged peer must not be able to ride a live survey
+        nonce (relay amplification) or kill relaying with a self-signed
+        stop — both are bound to the starting surveyor."""
+        clock, sks, nodes = self._three_chain()
+        oa, ob = nodes[0][1], nodes[1][1]
+        nonce = oa.survey.start_survey(nonce=12)
+        _crank(clock)
+        ob.survey.collecting = None   # relay-only state on B
+        from stellar_core_tpu import xdr as X
+        evil = SecretKey(b"\x66" * 32)
+        sm = ob.survey
+        # evil request riding the live nonce: signature verifies (it is
+        # self-signed) but the surveyor does not match the nonce's owner
+        req = X.TimeSlicedSurveyRequestMessage(
+            request=X.SurveyRequestMessage(
+                surveyorPeerID=X.NodeID.ed25519(evil.public_key.ed25519),
+                surveyedPeerID=X.NodeID.ed25519(b"\x07" * 32),
+                ledgerNum=1,
+                encryptionKey=X.Curve25519Public(key=b"\x01" * 32)),
+            nonce=nonce)
+        sr = X.SignedTimeSlicedSurveyRequestMessage(
+            requestSignature=evil.sign(sm.TAG_REQUEST + req.to_xdr()),
+            request=req)
+        assert sm.recv_request(None, sr) is False
+        # evil stop: must neither clear the known nonce nor be relayed
+        stop = X.TimeSlicedSurveyStopCollectingMessage(
+            surveyorID=X.NodeID.ed25519(evil.public_key.ed25519),
+            nonce=nonce, ledgerNum=1)
+        st = X.SignedTimeSlicedSurveyStopCollectingMessage(
+            signature=evil.sign(sm.TAG_STOP + stop.to_xdr()),
+            stopCollecting=stop)
+        assert sm.recv_stop_collecting(None, st) is False
+        assert nonce in sm._known_nonces
+
     def test_forged_start_collecting_rejected(self):
         clock, sks, nodes = self._three_chain()
         oc = nodes[2][1]

@@ -306,10 +306,31 @@ class TestContractXdr:
             X.SCVal.ledger_key_contract_instance(),
             X.SCVal.nonce_key(X.SCNonceKey(nonce=-9)),
             X.SCVal.error(X.SCError.contractCode(42)),
+            X.SCVal.error(X.SCError(X.SCErrorType.SCE_WASM_VM)),
+            X.SCVal.error(X.SCError(X.SCErrorType.SCE_VALUE,
+                                    X.SCErrorCode.SCEC_INVALID_INPUT)),
         ]
         for v in vals:
             blob = v.to_xdr()
             assert X.SCVal.from_xdr(blob).to_xdr() == blob, v
+
+    def test_scerror_void_arms(self):
+        # Upstream Stellar-contract.x: SCE_WASM_VM..SCE_BUDGET are void;
+        # only SCE_VALUE/SCE_AUTH carry an SCErrorCode, SCE_CONTRACT a u32.
+        for t in (X.SCErrorType.SCE_WASM_VM, X.SCErrorType.SCE_CONTEXT,
+                  X.SCErrorType.SCE_STORAGE, X.SCErrorType.SCE_OBJECT,
+                  X.SCErrorType.SCE_CRYPTO, X.SCErrorType.SCE_EVENTS,
+                  X.SCErrorType.SCE_BUDGET):
+            e = X.SCError(t)
+            blob = e.to_xdr()
+            # void arm: exactly the 4-byte discriminant, nothing after
+            assert blob == X.pack(X.SCErrorType, t), t
+            assert X.SCError.from_xdr(blob).to_xdr() == blob
+        for t in (X.SCErrorType.SCE_VALUE, X.SCErrorType.SCE_AUTH):
+            e = X.SCError(t, X.SCErrorCode.SCEC_INTERNAL_ERROR)
+            blob = e.to_xdr()
+            assert len(blob) == 8, t
+            assert X.SCError.from_xdr(blob).to_xdr() == blob
 
     def test_deeply_nested_scval(self):
         v = X.SCVal.u32(0)
