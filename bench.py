@@ -145,37 +145,50 @@ def bench_sigs():
     return tpu_rate, base_rate
 
 
-def bench_replay(nid, passphrase, archive, expected_hash):
-    """Configs #1 + #4: ledgers/sec CPU vs accel, identical hashes."""
+def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
+    """Configs #1 + #4: ledgers/sec CPU vs accel.  The rig's shared TPU
+    drifts 20-40% run to run, so passes are INTERLEAVED (cpu, accel) x
+    `rounds` and the medians reported; identical hashes asserted on every
+    pass.  The accel pass reports a per-phase breakdown
+    (dispatch host prep / collect sync-stall)."""
     from stellar_core_tpu.catchup.catchup import CatchupManager
     from stellar_core_tpu.crypto import keys
 
     has = archive.get_state()
     n_ledgers = has.current_ledger
 
-    _stage("replay: cpu pass...")
+    _stage("replay: accel warm pass (compiles)...")
     keys.clear_verify_cache()
-    cm_cpu = CatchupManager(nid, passphrase, accel=False)
-    t0 = time.perf_counter()
-    m = cm_cpu.catchup_complete(archive)
-    cpu_t = time.perf_counter() - t0
-    assert m.lcl_hash == expected_hash
-    cpu_rate = n_ledgers / cpu_t
+    cm_warm = CatchupManager(nid, passphrase, accel=True, accel_chunk=8192)
+    cm_warm.catchup_complete(archive, to_ledger=127)
 
-    _stage("replay: accel warm pass...")
-    # warm the accel jit cache on a prefix, then measure steady-state
-    keys.clear_verify_cache()
-    cm_warm = CatchupManager(nid, passphrase, accel=True, accel_chunk=2048)
-    cm_warm.catchup_complete(archive, to_ledger=63)
-    _stage("replay: accel timed pass...")
-    keys.clear_verify_cache()
-    cm_tpu = CatchupManager(nid, passphrase, accel=True, accel_chunk=2048)
-    t0 = time.perf_counter()
-    m2 = cm_tpu.catchup_complete(archive)
-    tpu_t = time.perf_counter() - t0
-    assert m2.lcl_hash == expected_hash, "accel replay diverged"
-    tpu_rate = n_ledgers / tpu_t
-    return cpu_rate, tpu_rate, cm_tpu.offload_hit_rate(), n_ledgers
+    cpu_rates, tpu_rates = [], []
+    phases = {}
+    hit_rate = 0.0
+    for r in range(rounds):
+        _stage(f"replay round {r + 1}/{rounds}: cpu...")
+        keys.clear_verify_cache()
+        cm_cpu = CatchupManager(nid, passphrase, accel=False)
+        t0 = time.perf_counter()
+        m = cm_cpu.catchup_complete(archive)
+        cpu_rates.append(n_ledgers / (time.perf_counter() - t0))
+        assert m.lcl_hash == expected_hash
+        _stage(f"replay round {r + 1}/{rounds}: accel...")
+        keys.clear_verify_cache()
+        cm_tpu = CatchupManager(nid, passphrase, accel=True,
+                                accel_chunk=8192)
+        t0 = time.perf_counter()
+        m2 = cm_tpu.catchup_complete(archive)
+        tpu_rates.append(n_ledgers / (time.perf_counter() - t0))
+        assert m2.lcl_hash == expected_hash, "accel replay diverged"
+        hit_rate = cm_tpu.offload_hit_rate()
+        phases = {k: round(v, 3) if isinstance(v, float) else v
+                  for k, v in cm_tpu.stats.items()}
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    phases["cpu_rates"] = [round(x, 1) for x in cpu_rates]
+    phases["accel_rates"] = [round(x, 1) for x in tpu_rates]
+    return med(cpu_rates), med(tpu_rates), hit_rate, n_ledgers, phases
 
 
 def tier1_quorum_map(n_orgs=9):
@@ -247,11 +260,16 @@ def main():
     tpu_sig_rate, cpu_sig_rate = bench_sigs()
 
     with tempfile.TemporaryDirectory() as d:
-        _stage("building archive...")
+        _stage("building archive (~18 checkpoints)...")
+        # BASELINE.json configs 1/4 call for thousands of pubnet ledgers;
+        # 1100 payment ledgers ≈ 1215 total ≈ 19 checkpoints keeps the
+        # steady-state pipeline visible while fitting the driver budget
+        # (VERDICT r2 weak #5: 127 ledgers was inside the drift noise).
         archive, mgr = build_archive(nid, passphrase,
-                                     os.path.join(d, "archive"))
+                                     os.path.join(d, "archive"),
+                                     n_payment_ledgers=1100)
         _stage("replay bench...")
-        cpu_rate, tpu_rate, hit_rate, n_ledgers = bench_replay(
+        cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
             nid, passphrase, archive, mgr.lcl_hash)
 
     _stage("quorum bench...")
@@ -276,6 +294,7 @@ def main():
             "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
             "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
             "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
+            "replay_phases": phases,
         },
     }))
 

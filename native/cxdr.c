@@ -854,6 +854,156 @@ cxdr_pack(PyObject *self, PyObject *args)
     return out;
 }
 
+/* ------------------------------------------------------------------ */
+/* deep_copy: generic structural copy of codec values (the LedgerTxn   */
+/* copy-out hot path — PROFILE.md round 3: deep_copy chains were the   */
+/* single largest replay cost block after the unpack mirror landed).   */
+/* Immutable leaves (int/enum/bool/bytes/str/None) are shared; lists   */
+/* are rebuilt; struct/union slot objects are tp_alloc'd and filled    */
+/* without descriptor or __init__ overhead.  Per-type field layouts    */
+/* are cached in a C-side dict: type -> tuple of interned names, or    */
+/* None for unions (copied via their fixed switch/value slots).        */
+/* ------------------------------------------------------------------ */
+
+static PyObject *deepcopy_layouts;   /* type -> tuple | None (union) */
+static PyObject *str_spec, *str_arms, *str_deep_copy;
+
+static PyObject *
+layout_for(PyObject *tp)
+{
+    PyObject *layout = PyDict_GetItem(deepcopy_layouts, tp); /* borrowed */
+    if (layout)
+        return layout;
+    if (PyObject_HasAttr(tp, str_arms)) {
+        layout = Py_None;
+    } else if (PyObject_HasAttr(tp, str_spec)) {
+        PyObject *spec = PyObject_GetAttr(tp, str_spec);
+        if (!spec)
+            return NULL;
+        PyObject *fast = PySequence_Fast(spec, "bad _spec");
+        Py_DECREF(spec);
+        if (!fast)
+            return NULL;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+        PyObject *names = PyTuple_New(n);
+        if (!names) {
+            Py_DECREF(fast);
+            return NULL;
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *pair = PySequence_Fast_GET_ITEM(fast, i);
+            PyObject *name = PySequence_GetItem(pair, 0);
+            if (!name) {
+                Py_DECREF(fast);
+                Py_DECREF(names);
+                return NULL;
+            }
+            PyUnicode_InternInPlace(&name);
+            PyTuple_SET_ITEM(names, i, name);
+        }
+        Py_DECREF(fast);
+        layout = names;
+        if (PyDict_SetItem(deepcopy_layouts, tp, layout) < 0) {
+            Py_DECREF(names);
+            return NULL;
+        }
+        Py_DECREF(names);
+        return PyDict_GetItem(deepcopy_layouts, tp);
+    } else {
+        layout = NULL;  /* unknown: fall back to the Python method */
+        return Py_NotImplemented;
+    }
+    if (PyDict_SetItem(deepcopy_layouts, tp, layout) < 0)
+        return NULL;
+    return layout;
+}
+
+static PyObject *
+deep_copy_c(PyObject *val, int depth)
+{
+    if (depth > 200) {
+        PyErr_SetString(CxdrError, "deep_copy too deep");
+        return NULL;
+    }
+    /* immutable leaves shared (PyLong covers bool + IntEnum members) */
+    if (val == Py_None || PyLong_Check(val) || PyBytes_Check(val) ||
+        PyUnicode_Check(val)) {
+        Py_INCREF(val);
+        return val;
+    }
+    if (PyList_Check(val)) {
+        Py_ssize_t n = PyList_GET_SIZE(val);
+        PyObject *lst = PyList_New(n);
+        if (!lst)
+            return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *v = deep_copy_c(PyList_GET_ITEM(val, i), depth + 1);
+            if (!v) {
+                Py_DECREF(lst);
+                return NULL;
+            }
+            PyList_SET_ITEM(lst, i, v);
+        }
+        return lst;
+    }
+    PyObject *tp = (PyObject *)Py_TYPE(val);
+    PyObject *layout = layout_for(tp);
+    if (!layout)
+        return NULL;
+    if (layout == Py_NotImplemented)   /* not a codec class */
+        return PyObject_CallMethodNoArgs(val, str_deep_copy);
+    PyObject *obj = alloc_instance(tp);
+    if (!obj)
+        return NULL;
+    if (layout == Py_None) {           /* union: switch shared, value copied */
+        PyObject *sw = PyObject_GetAttr(val, str_switch);
+        if (!sw || PyObject_SetAttr(obj, str_switch, sw) < 0) {
+            Py_XDECREF(sw);
+            Py_DECREF(obj);
+            return NULL;
+        }
+        Py_DECREF(sw);
+        PyObject *v = PyObject_GetAttr(val, str_value);
+        if (!v) {
+            Py_DECREF(obj);
+            return NULL;
+        }
+        PyObject *c = deep_copy_c(v, depth + 1);
+        Py_DECREF(v);
+        if (!c || PyObject_SetAttr(obj, str_value, c) < 0) {
+            Py_XDECREF(c);
+            Py_DECREF(obj);
+            return NULL;
+        }
+        Py_DECREF(c);
+        return obj;
+    }
+    Py_ssize_t nf = PyTuple_GET_SIZE(layout);
+    for (Py_ssize_t i = 0; i < nf; i++) {
+        PyObject *name = PyTuple_GET_ITEM(layout, i);
+        PyObject *v = PyObject_GetAttr(val, name);
+        if (!v) {
+            Py_DECREF(obj);
+            return NULL;
+        }
+        PyObject *c = deep_copy_c(v, depth + 1);
+        Py_DECREF(v);
+        if (!c || PyObject_SetAttr(obj, name, c) < 0) {
+            Py_XDECREF(c);
+            Py_DECREF(obj);
+            return NULL;
+        }
+        Py_DECREF(c);
+    }
+    return obj;
+}
+
+static PyObject *
+cxdr_deep_copy(PyObject *self, PyObject *val)
+{
+    return deep_copy_c(val, 0);
+}
+
 static PyMethodDef cxdr_methods[] = {
     {"pack", cxdr_pack, METH_VARARGS,
      "pack(program, value) -> bytes: serialize value per the program."},
@@ -861,6 +1011,8 @@ static PyMethodDef cxdr_methods[] = {
      "unpack(program, data) -> value: full-consumption deserialize."},
     {"unpack_from", cxdr_unpack_from, METH_VARARGS,
      "unpack_from(program, data, off=0) -> (value, new_off)."},
+    {"deep_copy", cxdr_deep_copy, METH_O,
+     "deep_copy(value) -> structural copy sharing immutable leaves."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -884,7 +1036,12 @@ PyInit__cxdr(void)
     }
     str_switch = PyUnicode_InternFromString("switch");
     str_value = PyUnicode_InternFromString("value");
-    if (!str_switch || !str_value) {
+    str_spec = PyUnicode_InternFromString("_spec");
+    str_arms = PyUnicode_InternFromString("_arms");
+    str_deep_copy = PyUnicode_InternFromString("deep_copy");
+    deepcopy_layouts = PyDict_New();
+    if (!str_switch || !str_value || !str_spec || !str_arms ||
+        !str_deep_copy || !deepcopy_layouts) {
         Py_DECREF(m);
         return NULL;
     }

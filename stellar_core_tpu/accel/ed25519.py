@@ -293,6 +293,17 @@ class Ed25519BatchVerifier:
 
     def verify(self, pks: Sequence[bytes], sigs: Sequence[bytes],
                msgs: Sequence[bytes]) -> np.ndarray:
+        return self.verify_async(pks, sigs, msgs)()
+
+    def verify_async(self, pks: Sequence[bytes], sigs: Sequence[bytes],
+                     msgs: Sequence[bytes]):
+        """Dispatch-only half: host prep + device kernel enqueue, NO sync.
+        Returns a collector callable; invoking it blocks on the device
+        results (np.asarray — block_until_ready is unreliable on this
+        backend) and returns the verdict array.  JAX's async dispatch makes
+        this the double-buffering seam: the caller can overlap the device
+        compute with host work (SURVEY §5.8: dispatch batch k+1 while the
+        CPU applies batch k)."""
         from . import tables as _tables
 
         n = len(pks)
@@ -451,20 +462,37 @@ class Ed25519BatchVerifier:
                     jnp.asarray(padded(rb)))
                 pending.append((idx[start:end], verdict, end - start))
 
-        for which, verdict, count in pending:
-            out[which] = np.asarray(verdict)[:count]
-        return out & ok
+        def collect() -> np.ndarray:
+            for which, verdict, count in pending:
+                out[which] = np.asarray(verdict)[:count]
+            return out & ok
+
+        return collect
 
 
 _verifiers: dict = {}  # (chunk, floor) -> verifier (pk caches + jit warm)
 
 
-def verify_batch(pks, sigs, msgs, chunk_size: int = 512,
-                 tail_floor: int = 256,
-                 hot_threshold: int = 4) -> np.ndarray:
+def _verifier_for(chunk_size: int, tail_floor: int,
+                  hot_threshold: int) -> Ed25519BatchVerifier:
     key = (chunk_size, tail_floor, hot_threshold)
     v = _verifiers.get(key)
     if v is None:
         v = _verifiers[key] = Ed25519BatchVerifier(
             chunk_size, tail_floor=tail_floor, hot_threshold=hot_threshold)
-    return v.verify(pks, sigs, msgs)
+    return v
+
+
+def verify_batch(pks, sigs, msgs, chunk_size: int = 512,
+                 tail_floor: int = 256,
+                 hot_threshold: int = 4) -> np.ndarray:
+    return _verifier_for(chunk_size, tail_floor,
+                         hot_threshold).verify(pks, sigs, msgs)
+
+
+def verify_batch_async(pks, sigs, msgs, chunk_size: int = 512,
+                       tail_floor: int = 256, hot_threshold: int = 4):
+    """Dispatch now, sync later: returns the collector callable (see
+    Ed25519BatchVerifier.verify_async)."""
+    return _verifier_for(chunk_size, tail_floor,
+                         hot_threshold).verify_async(pks, sigs, msgs)

@@ -63,99 +63,213 @@ def verify_ledger_chain(headers: Sequence[X.LedgerHeaderHistoryEntry],
         raise CatchupError("chain tail does not match trusted hash")
 
 
-def preverify_checkpoint_signatures(network_id: bytes,
-                                    tx_entries: Sequence[X.TransactionHistoryEntry],
-                                    chunk_size: int = 2048,
-                                    ledger_state=None) -> Dict[str, int]:
-    """Batch-verify all hint-pairable signatures of a checkpoint on the
-    accelerator and seed the verify cache.  Returns
-    {"total": ..., "shipped": ...} for offload hit-rate accounting.
+class PreverifyPipeline:
+    """Double-buffered TPU signature pre-verification (SURVEY §5.8:
+    dispatch checkpoint k+1's batch while the CPU applies checkpoint k;
+    reference pipelining shape: src/catchup/ — DownloadApplyTxsWork).
+
+    ``dispatch(groups, ledger_state)`` pairs every hint-pairable signature
+    of one or more checkpoints and enqueues the device kernels WITHOUT
+    syncing (accel verify_async); ``collect(checkpoint)`` blocks on the
+    verdicts of the group containing that checkpoint and seeds the process
+    verify cache.  Between the two calls the device computes while the host
+    applies earlier ledgers.
 
     Pairing candidates per signature: the tx/fee-bump/op source accounts'
-    master keys AND — when `ledger_state` (a LedgerTxnRoot-ish with
-    get_entry) is provided — every ed25519 signer of those accounts as of
-    the pre-checkpoint ledger state (reference hint semantics:
-    SignatureChecker::checkSignature tries every signer whose hint
-    matches).  Hint collisions pair against every matching candidate; a
-    wrong pairing just caches a negative verdict for a tuple nobody asks
-    about.  Unpaired signatures fall back to on-demand CPU verification —
-    verdicts never differ, only where they're computed."""
-    from ..accel.ed25519 import verify_batch
-    from ..transactions.utils import account_key
+    master keys, every ed25519 signer of those accounts in `ledger_state`
+    (reference hint semantics: SignatureChecker::checkSignature tries every
+    signer whose hint matches), plus every ed25519 signer harvested from
+    SetOptions operations of ANY checkpoint dispatched so far — dispatching
+    k+1 against pre-k state is exact as long as signers added between the
+    state snapshot and the tx's ledger are harvested, and in-order dispatch
+    guarantees that.  Hint collisions pair against every matching
+    candidate; a wrong pairing just caches a negative verdict for a tuple
+    nobody asks about.  Unpaired signatures fall back to on-demand CPU
+    verification — verdicts never differ, only where they're computed.
+    """
 
-    pks: List[bytes] = []
-    sigs: List[bytes] = []
-    msgs: List[bytes] = []
-    total = 0
-    signer_cache: Dict[bytes, List[bytes]] = {}
+    def __init__(self, network_id: bytes, chunk_size: int = 2048,
+                 stats: Optional[Dict[str, int]] = None):
+        self.network_id = network_id
+        self.chunk_size = chunk_size
+        self.stats = stats if stats is not None else {}
+        # The tunneled PJRT backend executes lazily: device work happens at
+        # materialization (np.asarray), NOT at kernel enqueue — JAX's async
+        # dispatch alone buys no overlap here (measured: a dispatched
+        # kernel sat idle through 2x its runtime of host busy-work, then
+        # took full device time to collect).  So the collector runs on ONE
+        # background thread, which blocks in the tunnel RPC with the GIL
+        # released while the main thread applies ledgers.  collect() then
+        # just joins the future.
+        self._executor = None
+        # hint (4 bytes) -> [pk, ...] of every SetOptions-added ed25519
+        # signer seen in any dispatched checkpoint (cumulative: covers
+        # signers added between the pairing state snapshot and apply)
+        self._harvested_hint: Dict[bytes, List[bytes]] = {}
+        self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
 
-    def signers_of(acc_id_val: bytes) -> List[bytes]:
-        if ledger_state is None:
-            return []
-        got = signer_cache.get(acc_id_val)
-        if got is not None:
-            return got
-        entry = ledger_state.get_entry(account_key(
-            X.AccountID.ed25519(acc_id_val)).to_xdr())
-        out: List[bytes] = []
-        if entry is not None:
-            for s in entry.data.value.signers:
-                if s.key.switch == X.SignerKeyType.SIGNER_KEY_TYPE_ED25519:
-                    out.append(s.key.value)
-        signer_cache[acc_id_val] = out
-        return out
+    def dispatched(self, checkpoint: int) -> bool:
+        return checkpoint in self._groups
 
-    frames: List[TransactionFrame] = []
-    # signers added by SetOptions WITHIN this checkpoint are not in the
-    # pre-checkpoint ledger state yet; harvest them as extra candidates so
-    # txs later in the same checkpoint signed by them still pair
-    harvested: List[bytes] = []
-    for entry in tx_entries:
-        for env in entry.txSet.txs:
-            frame = TransactionFrame.make_from_wire(network_id, env)
-            frames.append(frame)
+    def dispatch(self, entries_by_checkpoint: Dict[int, Sequence],
+                 ledger_state=None) -> None:
+        """Pair + enqueue one device batch covering every checkpoint in
+        `entries_by_checkpoint` (ascending order).  No device sync."""
+        import time as _time
+
+        from ..accel.ed25519 import verify_batch_async
+        from ..transactions.utils import account_key
+
+        t0 = _time.perf_counter()
+        cps = sorted(entries_by_checkpoint)
+        signer_cache: Dict[bytes, List[bytes]] = {}
+
+        def signers_of(acc_id_val: bytes) -> List[bytes]:
+            if ledger_state is None:
+                return []
+            got = signer_cache.get(acc_id_val)
+            if got is not None:
+                return got
+            entry = ledger_state.get_entry(account_key(
+                X.AccountID.ed25519(acc_id_val)).to_xdr())
+            out: List[bytes] = []
+            if entry is not None:
+                for s in entry.data.value.signers:
+                    if s.key.switch == X.SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+                        out.append(s.key.value)
+            signer_cache[acc_id_val] = out
+            return out
+
+        frames: List[TransactionFrame] = []
+        for cp in cps:
+            for entry in entries_by_checkpoint[cp]:
+                for env in entry.txSet.txs:
+                    frames.append(
+                        TransactionFrame.make_from_wire(self.network_id, env))
+        # harvest before pairing: a signer added late in the group still
+        # pairs a tx earlier in it (superset candidates are harmless)
+        harvested = self._harvested_hint
+        for frame in frames:
             for op in frame.operations:
                 if op.body.switch == X.OperationType.SET_OPTIONS:
                     signer = op.body.value.signer
                     if signer is not None and signer.key.switch == \
                             X.SignerKeyType.SIGNER_KEY_TYPE_ED25519:
-                        harvested.append(signer.key.value)
+                        pk = signer.key.value
+                        lst = harvested.setdefault(pk[28:32], [])
+                        if pk not in lst:
+                            lst.append(pk)
 
-    for frame in frames:
-        h = frame.content_hash()
-        account_ids = [frame.source_account_id().value]
-        if hasattr(frame, "inner"):
-            account_ids.append(frame.inner.source_account_id().value)
-        for op in frame.operations:
-            if op.sourceAccount is not None:
-                account_ids.append(
-                    X.muxed_to_account_id(op.sourceAccount).value)
-        candidates = list(account_ids)
-        for aid in account_ids:
-            candidates.extend(signers_of(aid))
-        candidates.extend(harvested)
-        total += len(frame.signatures)
-        for dsig in frame.signatures:
-            seen = set()
-            for pk in candidates:
-                if dsig.hint == pk[28:32] and pk not in seen:
-                    seen.add(pk)
-                    pks.append(pk)
-                    sigs.append(dsig.signature)
-                    msgs.append(h)
-    if pks:
-        # tail_floor=chunk_size: one compiled shape per path, amortized
-        # across every checkpoint of the catchup.  Per-key window tables
-        # are DISABLED here: at replay batch sizes their install dispatches
-        # cost more than they save (measured on the tunnel rig — see
-        # PROFILE.md); the generic path is a single kernel per chunk.
-        verdicts = verify_batch(pks, sigs, msgs, chunk_size=chunk_size,
-                                tail_floor=chunk_size,
-                                hot_threshold=1 << 62)
+        pks: List[bytes] = []
+        sigs: List[bytes] = []
+        msgs: List[bytes] = []
+        total = 0
+        for frame in frames:
+            h = frame.content_hash()
+            account_ids = [frame.source_account_id().value]
+            if hasattr(frame, "inner"):
+                account_ids.append(frame.inner.source_account_id().value)
+            for op in frame.operations:
+                if op.sourceAccount is not None:
+                    account_ids.append(
+                        X.muxed_to_account_id(op.sourceAccount).value)
+            candidates = list(account_ids)
+            for aid in account_ids:
+                candidates.extend(signers_of(aid))
+            total += len(frame.signatures)
+            for dsig in frame.signatures:
+                seen = set()
+                for pk in candidates:
+                    if dsig.hint == pk[28:32] and pk not in seen:
+                        seen.add(pk)
+                        pks.append(pk)
+                        sigs.append(dsig.signature)
+                        msgs.append(h)
+                for pk in harvested.get(dsig.hint, ()):
+                    if pk not in seen:
+                        seen.add(pk)
+                        pks.append(pk)
+                        sigs.append(dsig.signature)
+                        msgs.append(h)
+        self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + total
+        self.stats["sigs_shipped"] = \
+            self.stats.get("sigs_shipped", 0) + len(pks)
+        future = None
+        if pks:
+            # tail_floor=chunk_size: one compiled shape per path, amortized
+            # across every checkpoint of the catchup.  Per-key window
+            # tables are DISABLED here: at replay batch sizes their install
+            # dispatches cost more than they save (measured on the tunnel
+            # rig — see PROFILE.md); the generic path is a single kernel
+            # per chunk.
+            collector = verify_batch_async(
+                pks, sigs, msgs, chunk_size=self.chunk_size,
+                tail_floor=self.chunk_size, hot_threshold=1 << 62)
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="preverify")
+            future = self._executor.submit(collector)
+        group = {"future": future, "pks": pks, "sigs": sigs,
+                 "msgs": msgs, "checkpoints": cps}
+        for cp in cps:
+            self._groups[cp] = group
+        # phase accounting (bench per-phase breakdown): host prep + enqueue
+        self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) \
+            + (_time.perf_counter() - t0)
+        self.stats["dispatch_groups"] = \
+            self.stats.get("dispatch_groups", 0) + 1
+
+    def collect(self, checkpoint: int) -> None:
+        """Sync the verdicts of the group containing `checkpoint` (no-op if
+        never dispatched or already collected) and seed the verify cache.
+        Later checkpoints of an already-collected group stay registered in
+        `_groups` so dispatched() keeps answering True for them — popping
+        them all at first collect made the apply path re-dispatch each one
+        synchronously (measured: every coalesced group was followed by N-1
+        redundant singleton dispatches)."""
+        group = self._groups.pop(checkpoint, None)
+        if group is None or group.get("collected"):
+            return
+        group["collected"] = True
+        future = group["future"]
+        if future is None:
+            return
+        import time as _time
+        t0 = _time.perf_counter()
+        verdicts = future.result()
+        # sync stall: how long the apply cursor waited on the device —
+        # ~0 when double-buffering hid the compute under earlier applies
+        self.stats["collect_wait_s"] = self.stats.get("collect_wait_s", 0.0) \
+            + (_time.perf_counter() - t0)
+        pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
         keys.seed_verify_cache(
             (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
             for i in range(len(pks)))
-    return {"total": total, "shipped": len(pks)}
+
+    def close(self) -> None:
+        """Release the collector thread (a pipeline is per-catchup; a node
+        that resyncs repeatedly must not accumulate idle workers)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+
+def preverify_checkpoint_signatures(network_id: bytes,
+                                    tx_entries: Sequence[X.TransactionHistoryEntry],
+                                    chunk_size: int = 2048,
+                                    ledger_state=None) -> Dict[str, int]:
+    """Synchronous single-checkpoint wrapper over PreverifyPipeline
+    (dispatch + immediate collect) — kept for differential tests and
+    callers outside the pipelined catchup DAG."""
+    pipe = PreverifyPipeline(network_id, chunk_size)
+    try:
+        pipe.dispatch({0: list(tx_entries)}, ledger_state=ledger_state)
+        pipe.collect(0)
+    finally:
+        pipe.close()
+    return {"total": pipe.stats.get("sigs_total", 0),
+            "shipped": pipe.stats.get("sigs_shipped", 0)}
 
 
 @dataclass

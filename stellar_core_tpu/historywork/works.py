@@ -3,11 +3,17 @@
 Reference: src/historywork/{GetAndUnzipRemoteFileWork, BatchDownloadWork,
 VerifyLedgerChainWork}.cpp and src/catchup/{CatchupWork,
 DownloadApplyTxsWork, ApplyCheckpointWork}.cpp — catchup as a DAG of
-retryable work units, with checkpoint k+1's download/verify overlapping checkpoint k's
-apply (double-buffering, SURVEY.md §5.8).  The TPU pre-verify dispatch
-itself runs as the first crank of each checkpoint's apply work — i.e.
-sequentially after the previous apply — because its signer-set pairing
-reads the pre-checkpoint ledger state.
+retryable work units, with checkpoint k+1's download/verify overlapping
+checkpoint k's apply (double-buffering, SURVEY.md §5.8).  The TPU
+pre-verify is double-buffered the same way: as soon as a checkpoint's
+download completes, its signature batch is DISPATCHED (async, no device
+sync) while earlier checkpoints still apply; the verdicts are collected
+only when that checkpoint's own apply starts.  Small checkpoints are
+coalesced into one device batch (the tunnel's per-dispatch latency
+dominates below ~100k sigs — BASELINE.md).  Signer pairing against the
+then-current ledger state stays exact because SetOptions-added signers are
+harvested cumulatively across dispatched checkpoints
+(catchup.PreverifyPipeline).
 
 The archive reads are synchronous file IO here (no subprocess curl), but
 the unit boundaries, retry semantics and pipelining match the reference's
@@ -21,7 +27,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from .. import xdr as X
-from ..catchup.catchup import (CatchupError, preverify_checkpoint_signatures,
+from ..catchup.catchup import (CatchupError, PreverifyPipeline,
                                verify_ledger_chain)
 from ..crypto.sha import sha256
 from ..history.archive import (CATEGORY_LEDGER, CATEGORY_TRANSACTIONS,
@@ -82,26 +88,26 @@ class GetAndVerifyCheckpointWork(BasicWork):
 
 class ApplyCheckpointWork(BasicWork):
     """Apply one downloaded checkpoint's ledgers, a few per crank
-    (cooperative — downloads for later checkpoints interleave).  Runs the
-    TPU signature pre-verification for the whole checkpoint before the
-    first apply (reference: ApplyCheckpointWork; the accel dispatch is the
-    TPU seam)."""
+    (cooperative — downloads for later checkpoints interleave).  With
+    accel, the checkpoint's signature verdicts were dispatched earlier by
+    CatchupWork (possibly coalesced with neighbours); the first crank only
+    COLLECTS them — by then the device has had the previous checkpoints'
+    apply time to compute (reference: ApplyCheckpointWork; the async
+    collect is the TPU double-buffering seam)."""
 
     LEDGERS_PER_CRANK = 8
 
     def __init__(self, clock: VirtualClock, mgr,
                  download: GetAndVerifyCheckpointWork, target: int,
-                 network_id: bytes, accel: bool = False,
-                 accel_chunk: int = 8192, stats: Optional[dict] = None):
+                 network_id: bytes,
+                 pipeline: Optional[PreverifyPipeline] = None):
         super().__init__(clock, f"apply-{download.checkpoint:08x}",
                          max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.download = download
         self.target = target
         self.network_id = network_id
-        self.accel = accel
-        self.accel_chunk = accel_chunk
-        self.stats = stats if stats is not None else {}
+        self.pipeline = pipeline
         self._idx = 0
         self._preverified = False
         self.error_detail = None
@@ -114,15 +120,15 @@ class ApplyCheckpointWork(BasicWork):
     def on_run(self) -> State:
         mgr = self.mgr
         headers = self.download.headers
-        if self.accel and not self._preverified:
+        if self.pipeline is not None and not self._preverified:
             self._preverified = True
-            st = preverify_checkpoint_signatures(
-                self.network_id, list(self.download.txs.values()),
-                self.accel_chunk, ledger_state=mgr.root)
-            self.stats["sigs_total"] = \
-                self.stats.get("sigs_total", 0) + st["total"]
-            self.stats["sigs_shipped"] = \
-                self.stats.get("sigs_shipped", 0) + st["shipped"]
+            cp = self.download.checkpoint
+            if not self.pipeline.dispatched(cp):
+                # CatchupWork dispatches ahead; this is the standalone /
+                # degenerate path (e.g. the work used outside CatchupWork)
+                self.pipeline.dispatch({cp: list(self.download.txs.values())},
+                                       ledger_state=mgr.root)
+            self.pipeline.collect(cp)
             return State.RUNNING
         applied = 0
         while self._idx < len(headers) and applied < self.LEDGERS_PER_CRANK:
@@ -161,12 +167,16 @@ class CatchupWork(Work):
     """Pipelined complete-replay catchup: downloads run `lookahead`
     checkpoints ahead of the sequential apply cursor (reference:
     CatchupWork + DownloadApplyTxsWork's download-ahead of one checkpoint
-    while the previous applies)."""
+    while the previous applies).  With accel, completed downloads are
+    additionally PRE-DISPATCHED to the device in checkpoint order —
+    coalescing up to 2*`coalesce` checkpoints per device batch once
+    `coalesce` are ready, or immediately when the apply cursor is about to
+    need them — so device compute overlaps host apply (SURVEY §5.8)."""
 
     def __init__(self, clock: VirtualClock, mgr, archive: FileHistoryArchive,
                  target: int, network_id: bytes, accel: bool = False,
                  accel_chunk: int = 8192, lookahead: int = 2,
-                 stats: Optional[dict] = None):
+                 stats: Optional[dict] = None, coalesce: int = 4):
         super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.archive = archive
@@ -174,11 +184,19 @@ class CatchupWork(Work):
         self.network_id = network_id
         self.accel = accel
         self.accel_chunk = accel_chunk
-        self.lookahead = max(1, lookahead)
+        self.coalesce = max(1, coalesce)
+        # the download window must run ahead of the dispatch groups for
+        # coalescing to ever trigger
+        self.lookahead = max(1, lookahead,
+                             2 * self.coalesce if accel else 0)
         self.stats = stats if stats is not None else {}
+        self.pipeline = (PreverifyPipeline(network_id, accel_chunk,
+                                           self.stats)
+                         if accel else None)
         self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
         self._apply: Optional[ApplyCheckpointWork] = None
         self._apply_checkpoint = 0
+        self._next_dispatch = 0
         self._prev_tail: Optional[X.LedgerHeaderHistoryEntry] = None
         self.error_detail = None
 
@@ -191,10 +209,60 @@ class CatchupWork(Work):
         # checkpoint past the assumed bucket state (CatchupRange)
         self._apply_checkpoint = checkpoint_containing(
             max(2, self.mgr.last_closed_ledger_seq + 1))
+        self._next_dispatch = self._apply_checkpoint
         self._prev_tail = None
+
+    def _close_pipeline(self) -> None:
+        if self.pipeline is not None:
+            self.pipeline.close()
+
+    def on_failure_raise(self) -> None:
+        self._close_pipeline()
+
+    def on_aborted(self) -> None:
+        self._close_pipeline()
+
+    def _maybe_dispatch(self, last_cp: int) -> None:
+        """Feed the device: walk completed, not-yet-dispatched downloads in
+        checkpoint order (in-order dispatch keeps the cumulative SetOptions
+        harvest a superset of every signer the apply will try) and enqueue
+        them as one coalesced batch when enough are ready — or right away
+        when the apply cursor is within one checkpoint of the group, where
+        waiting would stall the pipeline."""
+        ready = []
+        c = self._next_dispatch
+        while c <= last_cp:
+            dl = self._downloads.get(c)
+            if dl is None or not dl.done or dl.failed:
+                break
+            ready.append(c)
+            c += CHECKPOINT_FREQUENCY
+        if not ready:
+            return
+        urgent = ready[0] <= self._apply_checkpoint + CHECKPOINT_FREQUENCY
+        if not urgent and len(ready) < self.coalesce:
+            return
+        # collect() blocks on a whole group's batch, so the group about to
+        # be awaited must be SMALL (1 checkpoint) while the lookahead tail
+        # coalesces into `coalesce`-sized batches that the device chews
+        # through during earlier applies
+        groups: List[List[int]] = []
+        i = 0
+        if urgent:
+            groups.append(ready[:1])
+            i = 1
+        while i < len(ready):
+            groups.append(ready[i:i + self.coalesce])
+            i += self.coalesce
+        for g in groups:
+            self.pipeline.dispatch(
+                {cp: list(self._downloads[cp].txs.values()) for cp in g},
+                ledger_state=self.mgr.root)
+        self._next_dispatch = ready[-1] + CHECKPOINT_FREQUENCY
 
     def on_run(self) -> State:
         if self.mgr.last_closed_ledger_seq >= self.target:
+            self._close_pipeline()
             return State.SUCCESS
         # keep the download window full (never past the target checkpoint)
         cp = self._apply_checkpoint
@@ -207,6 +275,8 @@ class CatchupWork(Work):
                 w = GetAndVerifyCheckpointWork(self.clock, self.archive, c)
                 self._downloads[c] = w
                 self.add_work(w)
+        if self.pipeline is not None:
+            self._maybe_dispatch(last_cp)
         dl = self._downloads.get(cp)
         if dl is None or not dl.done:
             return State.WAITING
@@ -227,7 +297,7 @@ class CatchupWork(Work):
                 return State.FAILURE
             self._apply = ApplyCheckpointWork(
                 self.clock, self.mgr, dl, self.target, self.network_id,
-                self.accel, self.accel_chunk, self.stats)
+                pipeline=self.pipeline)
             self.add_work(self._apply)
             return State.WAITING
         if not self._apply.done:
@@ -242,5 +312,6 @@ class CatchupWork(Work):
         self._apply = None
         self._apply_checkpoint = cp + CHECKPOINT_FREQUENCY
         if self.mgr.last_closed_ledger_seq >= self.target:
+            self._close_pipeline()
             return State.SUCCESS
         return State.RUNNING

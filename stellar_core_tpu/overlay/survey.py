@@ -28,6 +28,9 @@ log = slog.get("Overlay")
 # SurveyDataManager::MAX_PHASE_DURATION ~ 30 min; scaled to ledgers here)
 MAX_COLLECTING_LEDGERS = 120
 MAX_RESPONSE_PEERS = 25
+# relay-side nonce memory is attacker-writable (any permitted surveyor's
+# START registers one) — hard-cap it
+MAX_KNOWN_NONCES = 64
 
 
 class CollectingState:
@@ -194,13 +197,23 @@ class SurveyManager:
             return False
         self.maybe_expire()
         # remember the nonce (bound to its surveyor) for request relaying
-        # even when we cannot adopt the collecting phase locally
-        self._known_nonces[msg.nonce] = (surveyor, msg.ledgerNum)
+        # even when we cannot adopt the collecting phase locally.
+        # First-writer wins: a later START reusing a live nonce must not
+        # rebind it to a different surveyor (hijack).  The expiry basis is
+        # OUR ledger, not the message's claimed ledgerNum — an attacker-
+        # chosen ledgerNum far in the future would pin the entry forever.
+        if msg.nonce not in self._known_nonces \
+                and len(self._known_nonces) < MAX_KNOWN_NONCES:
+            self._known_nonces[msg.nonce] = (surveyor, self._ledger_num())
         if self.collecting is not None:
             # one survey at a time; a fresh START must not clobber a live
             # collecting phase (an abandoned one expires via maybe_expire)
             return False
-        self.collecting = CollectingState(surveyor, msg.nonce, msg.ledgerNum)
+        # clamp the phase start to OUR ledger: a claimed far-future
+        # ledgerNum would make the phase unexpirable and block every
+        # future survey on this node
+        self.collecting = CollectingState(
+            surveyor, msg.nonce, min(msg.ledgerNum, self._ledger_num()))
         return True
 
     def recv_stop_collecting(self, peer, signed) -> bool:

@@ -2,20 +2,22 @@
 
 Reference: src/simulation/Simulation.{h,cpp} — addNode, addPendingConnection,
 startAllNodes, crankUntil/crankForAtLeast, Topologies (src/simulation/
-Topologies.cpp — core, cycle, hierarchical); nodes wired over loopback.
+Topologies.cpp — core, cycle, hierarchical); nodes wired OVER_LOOPBACK.
 This is THE deterministic multi-node test pattern (SURVEY.md §4): no
 threads, no sockets, no wall clock — every message delivery is a posted
 clock action, every timeout is virtual.
 
-Until the TCP overlay lands, message transport is a direct loopback bus:
-broadcast posts delivery actions to every peer; hash-addressed item fetch
-(tx sets / qsets) asks peers' caches asynchronously, standing in for
-overlay ItemFetcher round-trips with the same observable semantics.
+Transport is the real overlay over LoopbackPeer pairs (reference:
+Simulation::OVER_LOOPBACK + LoopbackPeerConnection): every SimNode runs a
+full OverlayManager, so consensus traffic traverses the authenticated
+handshake, flow-control windows, pull-mode tx flooding (advert/demand) and
+hash-addressed item fetch — the same machinery production uses.
+Partitions sever the loopback connections; healing redials them.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import xdr as X
 from ..crypto.keys import SecretKey
@@ -23,6 +25,8 @@ from ..crypto.sha import sha256
 from ..herder.herder import Herder, HerderState
 from ..herder.upgrades import Upgrades
 from ..ledger.manager import LedgerManager
+from ..overlay.overlay_manager import OverlayManager
+from ..overlay.peer import make_loopback_pair
 from ..scp.quorum import qset_hash
 from ..util import logging as slog
 from ..util.clock import ClockMode, VirtualClock
@@ -33,7 +37,7 @@ log = slog.get("Herder")
 
 
 class SimNode:
-    """One in-process validator: ledger manager + herder (+ history later).
+    """One in-process validator: ledger manager + herder + overlay.
     Reference analog: a full Application instance inside Simulation."""
 
     def __init__(self, sim: "Simulation", secret: SecretKey, qset,
@@ -50,11 +54,11 @@ class SimNode:
             self.lm.start_new_ledger()
         self.herder = Herder(sim.clock, self.lm, secret, qset,
                              is_validator=is_validator, upgrades=upgrades)
-        self.herder.broadcast = self._broadcast
-        self.herder.tx_flood = self._tx_flood
-        self.herder.pending.fetch_qset = self._fetch_qset
-        self.herder.pending.fetch_txset = self._fetch_txset
-        self.partition = 0  # nodes only hear peers in the same partition
+        # the OverlayManager rewires herder.broadcast / tx_flood / fetch_*
+        # onto the real flood/fetch machinery
+        self.overlay = OverlayManager(sim.clock, self.herder, sim.network_id,
+                                      secret)
+        self.partition = 0   # connection-group tag (see partition_nodes)
         self.closed: Dict[int, bytes] = {}  # seq -> ledger hash
         self.herder.ledger_closed_hook = self._on_ledger_closed
         self.herder.out_of_sync_handler = self._on_out_of_sync
@@ -63,28 +67,10 @@ class SimNode:
         # pull recent SCP state from peers (reference: getMoreSCPState;
         # archive-based catchup takes over when the gap exceeds
         # MAX_SLOTS_TO_REMEMBER)
-        self.sim.request_scp_state(self)
+        self.overlay.request_scp_state()
 
     def _on_ledger_closed(self, arts) -> None:
         self.closed[arts.header_entry.header.ledgerSeq] = arts.header_entry.hash
-
-    # -- transport ---------------------------------------------------------
-    def _broadcast(self, env) -> None:
-        self.sim.broadcast_from(self, env)
-
-    def _tx_flood(self, frame) -> None:
-        # epidemic flooding with dedup: peers re-flood only on first sight
-        # (STATUS_PENDING), mirroring Floodgate semantics
-        for peer in self.sim._reachable(self):
-            self.sim.clock.post_action(
-                lambda p=peer, f=frame: p.herder.recv_transaction(f),
-                name="flood-tx")
-
-    def _fetch_qset(self, h: bytes) -> None:
-        self.sim.fetch_item(self, "qset", h)
-
-    def _fetch_txset(self, h: bytes) -> None:
-        self.sim.fetch_item(self, "txset", h)
 
     # -- convenience -------------------------------------------------------
     @property
@@ -108,7 +94,10 @@ class Simulation:
         self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
         self.nodes: List[SimNode] = []
         self.by_id: Dict[bytes, SimNode] = {}
-        self.dropped_messages = 0
+        # live loopback connections: frozenset({id_a, id_b}) -> (pa, pb)
+        self._connections: Dict[frozenset, Tuple] = {}
+        self.dropped_messages = 0  # legacy counter (overlay drops are
+        #                            visible in per-node overlay.stats)
 
     # -- topology ----------------------------------------------------------
     def add_node(self, secret: SecretKey, qset,
@@ -121,60 +110,66 @@ class Simulation:
         self.by_id[node.node_id] = node
         return node
 
+    def connect(self, a: SimNode, b: SimNode) -> None:
+        """Dial a loopback connection a->b (reference:
+        Simulation::addPendingConnection + LoopbackPeerConnection).  A pair
+        whose peers dropped THEMSELVES (overlay error paths, bans) counts
+        as absent — otherwise heal_partitions would silently no-op on it
+        and the mesh would stay severed while the sim believes it healed."""
+        from ..overlay.peer import Peer
+        if a is b:
+            return
+        key = frozenset((a.node_id, b.node_id))
+        pair = self._connections.get(key)
+        if pair is not None:
+            if pair[0].state != Peer.CLOSING and \
+                    pair[1].state != Peer.CLOSING:
+                return  # still live
+            del self._connections[key]
+        self._connections[key] = make_loopback_pair(a.overlay, b.overlay)
+
+    def disconnect(self, a: SimNode, b: SimNode) -> None:
+        key = frozenset((a.node_id, b.node_id))
+        pair = self._connections.pop(key, None)
+        if pair is not None:
+            pair[0].drop("sim disconnect")
+
     def start_all_nodes(self) -> None:
+        # default mesh: every node pair connected (the bus the herder sims
+        # assume); explicit connect() calls before start override nothing —
+        # connect() is idempotent per pair
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                self.connect(a, b)
+        # let the auth handshakes complete before consensus starts
+        self.clock.crank_for(0.1)
         for n in self.nodes:
             if n.herder.is_validator:
                 n.herder.bootstrap()
             else:
                 n.herder.start()
 
-    # -- transport ---------------------------------------------------------
-    def _reachable(self, src: SimNode) -> List[SimNode]:
-        return [n for n in self.nodes
-                if n is not src and n.partition == src.partition]
-
-    def broadcast_from(self, src: SimNode, env) -> None:
-        for peer in self._reachable(src):
-            self.clock.post_action(
-                lambda p=peer, e=env: p.herder.recv_scp_envelope(e),
-                name="deliver-scp")
-
-    def fetch_item(self, requester: SimNode, kind: str, h: bytes) -> None:
-        """Async hash-addressed fetch from any reachable peer (stands in
-        for overlay ItemFetcher; one posted round-trip of latency)."""
-        def attempt():
-            for peer in self._reachable(requester):
-                if kind == "qset":
-                    q = peer.herder.get_qset(h)
-                    if q is not None:
-                        requester.herder.recv_qset(q)
-                        return
-                else:
-                    got = peer.herder.pending.get_txset(h)
-                    if got is not None:
-                        requester.herder.recv_tx_set(h, got[0])
-                        return
-            self.dropped_messages += 1
-        self.clock.post_action(attempt, name=f"fetch-{kind}")
-
-    def request_scp_state(self, requester: SimNode) -> None:
-        """Deliver peers' remembered SCP envelopes for slots the requester
-        is missing (reference: GET_SCP_STATE overlay message)."""
-        def attempt():
-            for peer in self._reachable(requester):
-                for env in peer.herder.get_scp_state(requester.lcl + 1):
-                    requester.herder.recv_scp_envelope(env)
-        self.clock.post_action(attempt, name="fetch-scp-state")
-
     # -- partitions (fault injection) --------------------------------------
     def partition_nodes(self, groups: List[List[SimNode]]) -> None:
+        """Sever every loopback connection crossing group boundaries
+        (reference: Simulation::partitionNodes — connection-level cuts)."""
         for i, grp in enumerate(groups):
             for n in grp:
                 n.partition = i
+        for key in list(self._connections):
+            ids = list(key)
+            a, b = self.by_id[ids[0]], self.by_id[ids[1]]
+            if a.partition != b.partition:
+                self.disconnect(a, b)
 
     def heal_partitions(self) -> None:
+        """Redial the full mesh (reference: healing a Simulation
+        partition reconnects the pending connections)."""
         for n in self.nodes:
             n.partition = 0
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                self.connect(a, b)
 
     # -- cranking ----------------------------------------------------------
     def crank_until(self, pred: Callable[[], bool],

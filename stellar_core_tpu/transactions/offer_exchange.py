@@ -10,12 +10,18 @@ sheep-per-wheat as the rational n/d.  The taker receives wheat and sends
 sheep.  All amount math is exact integer arithmetic (python ints stand in
 for the reference's uint128 bigMultiply/bigDivide).
 
-Deliberate deviation, documented: the reference's
-applyPriceErrorThresholds refinement (cancels exchanges whose realized
-price deviates beyond small error bounds near dust scale) is reduced here
-to its dominant effect — an exchange that would round either leg to zero
-is cancelled.  Both the replay and live paths share this code, so chain
-consistency within this framework is unaffected.
+Price-error thresholds (reference: OfferExchange.cpp —
+applyPriceErrorThresholds + checkPriceErrorBound): integer rounding can
+make the realized price sheepSend/wheatReceive deviate from the offer
+price n/d; near dust scale the relative error can be large enough to
+betray one side.  The reference cancels an exchange whose relative price
+error exceeds 1%, with the upper bound waived when favoring the resting
+(wheat) offer cannot betray anyone (path strict-receive, where the path's
+sendMax bounds the sender's cost); path strict-send keeps the sent amount
+exact and is guarded by the path-level destMin check instead of a
+per-exchange bound.  Implemented exactly in integers in
+`check_price_error_bound` / `apply_price_error_thresholds` below
+(adversarially tested near dust in tests/test_offer_exchange.py).
 """
 
 from __future__ import annotations
@@ -73,6 +79,49 @@ class ExchangeResultV10:
     num_sheep_send: int
 
 
+def check_price_error_bound(price: X.Price, wheat_receive: int,
+                            sheep_send: int, can_favor_wheat: bool) -> bool:
+    """Relative price error of the realized exchange vs the offer price
+    must be within 1% (reference: OfferExchange.cpp —
+    checkPriceErrorBound, exact int128 cross-multiplication there, exact
+    python ints here).
+
+    With k = wheatReceive * n and v = sheepSend * d, the realized price
+    sheepSend/wheatReceive relative to n/d is v/k, so the bound
+    |v - k| <= k/100 is checked as 99*k <= 100*v <= 101*k.
+    can_favor_wheat waives the upper bound: overpaying the resting offer
+    betrays nobody when the caller bounds total cost elsewhere (path
+    strict-receive's sendMax)."""
+    k = wheat_receive * price.n
+    v = sheep_send * price.d
+    if 100 * v < 99 * k:
+        return False
+    if not can_favor_wheat and 100 * v > 101 * k:
+        return False
+    return True
+
+
+def apply_price_error_thresholds(price: X.Price, wheat_receive: int,
+                                 sheep_send: int, wheat_stays: bool,
+                                 rounding: int) -> ExchangeResultV10:
+    """Cancel an exchange whose realized price deviates beyond the error
+    bound, and never let one leg round to zero while the other pays
+    (reference: OfferExchange.cpp — applyPriceErrorThresholds).  Path
+    strict-send has no per-exchange bound: sheepSend is exact and the
+    path-level destMin check is the guard."""
+    if wheat_receive > 0 and sheep_send > 0:
+        if rounding == ROUND_NORMAL and not check_price_error_bound(
+                price, wheat_receive, sheep_send, can_favor_wheat=False):
+            wheat_receive = sheep_send = 0
+        elif rounding == ROUND_PATH_STRICT_RECEIVE and \
+                not check_price_error_bound(price, wheat_receive, sheep_send,
+                                            can_favor_wheat=True):
+            wheat_receive = sheep_send = 0
+    if wheat_receive == 0 or sheep_send == 0:
+        wheat_receive = sheep_send = 0
+    return ExchangeResultV10(wheat_stays, wheat_receive, sheep_send)
+
+
 def exchange_v10(price: X.Price, max_wheat_send: int, max_wheat_receive: int,
                  max_sheep_send: int, max_sheep_receive: int,
                  rounding: int) -> ExchangeResultV10:
@@ -115,13 +164,10 @@ def exchange_v10(price: X.Price, max_wheat_send: int, max_wheat_receive: int,
         wheat_receive = _div_round(wheat_value, price.n, round_up=False)
         sheep_send = _div_round(wheat_value, price.d, round_up=True)
 
-    # dust cancellation (applyPriceErrorThresholds' dominant effect): never
-    # take someone's sheep for zero wheat
-    if wheat_receive == 0:
-        sheep_send = 0
     assert wheat_receive <= min(max_wheat_send, max_wheat_receive)
     assert sheep_send <= max_sheep_send
-    return ExchangeResultV10(wheat_stays, wheat_receive, sheep_send)
+    return apply_price_error_thresholds(price, wheat_receive, sheep_send,
+                                        wheat_stays, rounding)
 
 
 def adjust_offer(price: X.Price, max_wheat_send: int,

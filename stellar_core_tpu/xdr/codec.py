@@ -413,24 +413,34 @@ def xdr_struct(name: str, fields: List[Tuple[str, Any]], defaults: Opt[Dict[str,
 
         def deep_copy(self):
             """Recursive structural copy, ~10x faster than the XDR
-            pack/unpack round-trip (the LedgerTxn copy-out hot path)."""
+            pack/unpack round-trip (the LedgerTxn copy-out hot path).
+            Runs natively when the extension is built."""
+            if _cxdr_deep_copy is not None:
+                return _cxdr_deep_copy(self)
             new = object.__new__(type(self))
             for f in field_names:
-                setattr(new, f, deep_copy_value(getattr(self, f)))
+                setattr(new, f, _deep_copy_py(getattr(self, f)))
             return new
 
     Struct.__name__ = Struct.__qualname__ = name
     return Struct
 
 
-def deep_copy_value(val):
-    """Deep copy of any XDR value: primitives are immutable and shared;
-    lists are rebuilt; structs/unions copy field-wise."""
+def _deep_copy_py(val):
+    """Pure-Python deep copy of any XDR value: primitives are immutable
+    and shared; lists are rebuilt; structs/unions copy field-wise."""
     if val is None or isinstance(val, (int, bytes, str, bool)):
         return val
     if isinstance(val, list):
-        return [deep_copy_value(v) for v in val]
+        return [_deep_copy_py(v) for v in val]
     return val.deep_copy()
+
+
+def deep_copy_value(val):
+    """Deep copy of any XDR value (native when the extension is built)."""
+    if _cxdr_deep_copy is not None:
+        return _cxdr_deep_copy(val)
+    return _deep_copy_py(val)
 
 
 class _UnionAdapter(XdrType):
@@ -520,9 +530,11 @@ def xdr_union(name: str, switch_type, arms: Dict[Any, Tuple[str, Any]],
             return f"{name}({self.switch!r}, {self.value!r})"
 
         def deep_copy(self):
+            if _cxdr_deep_copy is not None:
+                return _cxdr_deep_copy(self)
             new = object.__new__(type(self))
             new.switch = self.switch
-            new.value = deep_copy_value(self.value)
+            new.value = _deep_copy_py(self.value)
             return new
 
         @property
@@ -606,8 +618,9 @@ try:
 except ImportError:
     _cxdr = None
 
-# unpack arrived after pack; tolerate a stale built extension
+# unpack/deep_copy arrived after pack; tolerate a stale built extension
 _cxdr_unpack = getattr(_cxdr, "unpack", None)
+_cxdr_deep_copy = getattr(_cxdr, "deep_copy", None)
 
 
 def compile_program(t) -> tuple:

@@ -209,3 +209,39 @@ def test_hostile_array_length_rejected_without_allocation():
     blob = b"\x11" * 32 + struct.pack(">I", 0xFFFFFFF0)
     with pytest.raises(X.XdrError):
         adapter.unpack(blob)
+
+
+@pytest.mark.parametrize("val", list(_sample_values()),
+                         ids=lambda v: type(v).__name__)
+def test_deep_copy_identical_to_python(val):
+    """Native deep_copy must structurally equal the value and the pure-
+    Python copy, with full mutation isolation of the mutable spine."""
+    native = C._cxdr.deep_copy(val)
+    py = C._deep_copy_py(val)
+    adapter = type(val)._xdr_adapter()
+    assert adapter.pack(native) == adapter.pack(py) == adapter.pack(val)
+    assert native is not val
+
+
+def test_deep_copy_mutation_isolation():
+    qs = X.SCPQuorumSet(
+        threshold=2,
+        validators=[X.NodeID.ed25519(bytes([i]) * 32) for i in range(3)],
+        innerSets=[X.SCPQuorumSet(
+            threshold=1, validators=[X.NodeID.ed25519(b"\x09" * 32)])])
+    cp = C._cxdr.deep_copy(qs)
+    cp.threshold = 99
+    cp.validators.pop()
+    cp.innerSets[0].threshold = 42
+    assert qs.threshold == 2
+    assert len(qs.validators) == 3
+    assert qs.innerSets[0].threshold == 1
+
+
+def test_deep_copy_shares_immutable_leaves():
+    # bytes/enum leaves are immutable — sharing them is the point
+    key = X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(b"\x07" * 32)))
+    cp = C._cxdr.deep_copy(key)
+    assert cp.value.accountID.value is key.value.accountID.value
+    assert cp.switch is key.switch

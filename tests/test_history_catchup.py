@@ -297,6 +297,74 @@ def test_multisig_catchup_accel_pairs_all_signers(tmp_path):
     assert cm_cpu.catchup_complete(archive).lcl_hash == mgr.lcl_hash
 
 
+def test_coalesced_dispatch_pairs_cross_checkpoint_signers(tmp_path):
+    """Double-buffered accel catchup dispatches checkpoint k+1 (and
+    coalesces small checkpoints into one device batch) BEFORE checkpoint k
+    applies, so pairing runs against a stale ledger state.  Signers added
+    by SetOptions in checkpoint 1 and used to sign txs in checkpoint 2 must
+    still pair via the cumulative harvest — offload hit-rate stays 1.0 and
+    hashes identical (SURVEY §5.8 double-buffering)."""
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.testutils import (TestAccount, build_tx,
+                                            create_account_op,
+                                            native_payment_op)
+
+    nid = network_id("xcp accel net")
+    mgr = LedgerManager(nid, invariant_manager=None)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(str(tmp_path / "archive"))
+    history = HistoryManager(mgr, "xcp accel net", [archive])
+
+    root_sk = mgr.root_account_secret()
+    e = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(root_sk.public_key.ed25519))).to_xdr())
+    root = TestAccount(mgr, root_sk, e.data.value.seqNum)
+
+    ct = [1_700_000_000]
+
+    def close(frames):
+        ct[0] += 5
+        history.ledger_closed(mgr.close_ledger(frames, ct[0]))
+
+    sks = [SecretKey(bytes([0x90 + i]) * 32) for i in range(4)]
+    close([root.tx([create_account_op(
+        X.AccountID.ed25519(sk.public_key.ed25519), 10**11)
+        for sk in sks])])
+    accounts, extras = [], []
+    for i, sk in enumerate(sks):
+        entry = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        acct = TestAccount(mgr, sk, entry.data.value.seqNum)
+        extra = SecretKey(bytes([0xb0 + i]) * 32)
+        accounts.append(acct)
+        extras.append(extra)
+        close([acct.tx([X.Operation(body=X.OperationBody.setOptionsOp(
+            X.SetOptionsOp(signer=X.Signer(
+                key=X.SignerKey.ed25519(extra.public_key.ed25519),
+                weight=1))))])])
+    # run past the first checkpoint boundary: signer adds live in cp 63
+    while len(history.published_checkpoints) < 1:
+        close([])
+    # second checkpoint: payments signed ONLY by the extras added in cp 1
+    for round_ in range(4):
+        frames = []
+        for acct, extra in zip(accounts, extras):
+            frames.append(build_tx(
+                nid, acct.secret, acct.next_seq(),
+                [native_payment_op(root.account_id, 500 + round_)],
+                signers=[extra]))
+        close(frames)
+    while len(history.published_checkpoints) < 2:
+        close([])
+
+    keys.clear_verify_cache()
+    cm = CatchupManager(nid, "xcp accel net", accel=True, accel_chunk=256)
+    replayed = cm.catchup_complete(archive)
+    assert replayed.lcl_hash == mgr.lcl_hash
+    assert cm.stats["sigs_total"] >= 16
+    assert cm.offload_hit_rate() == 1.0, cm.stats
+
+
 def test_command_template_archive_publish_and_catchup(tmp_path):
     """Archive driven by get=/put=/mkdir= shell templates (reference:
     HistoryArchive command indirection; tests use cp/mkdir exactly like

@@ -78,6 +78,75 @@ def test_adjust_offer_drops_dust():
     assert adjust_offer(P(n=1, d=1), 1000, 10**10) == 1000
 
 
+def test_price_error_bound_exact_thresholds():
+    """checkPriceErrorBound boundary: 99*k <= 100*v <= 101*k (reference:
+    OfferExchange.cpp — checkPriceErrorBound, 1% relative error)."""
+    from stellar_core_tpu.transactions.offer_exchange import (
+        check_price_error_bound)
+    price = P(n=100, d=1)
+    # k = 100*100 = 10000, v = sheep_send; 9900 <= sheep_send <= 10100
+    assert check_price_error_bound(price, 100, 10100, False)
+    assert not check_price_error_bound(price, 100, 10101, False)
+    assert check_price_error_bound(price, 100, 9900, False)
+    assert not check_price_error_bound(price, 100, 9899, False)
+    # can_favor_wheat waives only the upper bound
+    assert check_price_error_bound(price, 100, 10101, True)
+    assert check_price_error_bound(price, 100, 10**15, True)
+    assert not check_price_error_bound(price, 100, 9899, True)
+
+
+def test_exchange_cancelled_when_maker_overpaid_beyond_bound():
+    """Near dust, rounding up the sheep leg can overpay the maker by far
+    more than 1% — NORMAL rounding must cancel the exchange (reference:
+    applyPriceErrorThresholds).  price 3/2, taker wants exactly 1 wheat:
+    sheep = ceil(3/2) = 2 -> realized price 2/1 = +33% over 3/2."""
+    r = exchange_v10(P(n=3, d=2), 10**6, 1, 10**10, 10**10, ROUND_NORMAL)
+    assert r.num_wheat_received == 0 and r.num_sheep_send == 0
+    # same exchange at a non-dust size is fine: 100 wheat -> 150 sheep exact
+    r = exchange_v10(P(n=3, d=2), 10**6, 100, 10**10, 10**10, ROUND_NORMAL)
+    assert r.num_wheat_received == 100 and r.num_sheep_send == 150
+
+
+def test_strict_receive_may_favor_wheat_beyond_bound():
+    """Path strict-receive waives the upper bound: sendMax at the path
+    level bounds the sender's cost, so overpaying the resting offer is
+    allowed (reference: applyPriceErrorThresholds canFavorWheat)."""
+    r = exchange_v10(P(n=3, d=2), 10**6, 1, 10**10, 10**10,
+                     ROUND_PATH_STRICT_RECEIVE)
+    assert r.num_wheat_received == 1
+    assert r.num_sheep_send == 2               # +33% but allowed
+
+
+def test_strict_send_no_per_exchange_bound_but_dust_cancels():
+    """Path strict-send keeps the send amount exact (destMin guards the
+    path), so a >1% deviation stands; but a send that buys zero wheat
+    still cancels both legs."""
+    # 5 sheep at price 3/2: wheat = floor(10/3) = 3, realized 5/3 = +11%
+    r = exchange_v10(P(n=3, d=2), 10**6, 10**10, 5, 10**10,
+                     ROUND_PATH_STRICT_SEND)
+    assert r.num_wheat_received == 3 and r.num_sheep_send == 5
+    # 1 sheep at price 3/1 buys 0 wheat -> both legs zero
+    r = exchange_v10(P(n=3, d=1), 10**6, 10**10, 1, 10**10,
+                     ROUND_PATH_STRICT_SEND)
+    assert r.num_wheat_received == 0 and r.num_sheep_send == 0
+
+
+def test_pool_swap_dust_rounding():
+    """Adversarial dust through the constant-product pool: zero-output
+    swaps and the reserve edge (reference: CAP-38 exact rounding)."""
+    # tiny input into a deep pool disburses zero (floor)
+    assert pool_swap_out_given_in(10**12, 10**12, 1) == 0
+    # requesting the whole reserve (or more) is unfillable
+    assert pool_swap_in_given_out(10**6, 10**6, 10**6) is None
+    assert pool_swap_in_given_out(10**6, 10**6, 10**6 + 1) is None
+    # one unit out of a deep pool costs at least one unit in (ceil)
+    cost = pool_swap_in_given_out(10**12, 10**12, 1)
+    assert cost >= 1
+    # round-trip never profits the taker: swapping cost back in returns
+    # at most the unit taken out
+    assert pool_swap_out_given_in(10**12 - 1, 10**12 + cost, 1) <= cost
+
+
 def test_pool_swap_formulas_round_trip():
     # CAP-38 30bp fee; depositing the strict-receive quote must actually
     # buy the requested amount per the strict-send formula
