@@ -41,21 +41,30 @@ class Bucket:
     """Immutable sorted bucket. entries EXCLUDE the meta entry; protocol
     version is carried separately and re-serialized as METAENTRY."""
 
-    __slots__ = ("entries", "protocol_version", "_hash", "_index")
+    __slots__ = ("entries", "protocol_version", "_hash", "_index", "_keys")
 
-    def __init__(self, entries: List[BucketEntry], protocol_version: int):
+    def __init__(self, entries: List[BucketEntry], protocol_version: int,
+                 keys: Optional[List[bytes]] = None):
         self.entries = entries
         self.protocol_version = protocol_version
         self._hash: Optional[bytes] = None
         self._index = None
+        self._keys = keys  # cached sort keys, aligned with entries
+
+    def sort_keys(self) -> List[bytes]:
+        """Per-entry sort keys, computed once per immutable bucket (the
+        merge path walks every level's keys each spill — recomputing the
+        key XDR per merge was a top replay cost)."""
+        if self._keys is None:
+            self._keys = [entry_sort_key(e) for e in self.entries]
+        return self._keys
 
     def index(self):
         """The bucket's point-lookup index, built lazily once per immutable
         bucket (reference: BucketManager::maybeBuildIndex)."""
         if self._index is None:
             from .index import BucketIndex
-            self._index = BucketIndex([entry_sort_key(e)
-                                       for e in self.entries])
+            self._index = BucketIndex(self.sort_keys())
         return self._index
 
     def find(self, key_bytes: bytes) -> Optional[BucketEntry]:
@@ -126,7 +135,8 @@ class Bucket:
             be = BucketEntry.deadEntry(k)
             tagged.append((entry_sort_key(be), be))
         tagged.sort(key=lambda t: t[0])
-        return Bucket([e for _, e in tagged], protocol_version)
+        return Bucket([e for _, e in tagged], protocol_version,
+                      keys=[k for k, _ in tagged])
 
     def __iter__(self):
         return iter(self.entries)
@@ -161,42 +171,46 @@ def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
     proto = protocol_version if protocol_version is not None else max(
         old.protocol_version, new.protocol_version)
     out: List[BucketEntry] = []
+    out_keys: List[bytes] = []
 
-    def emit(be: BucketEntry):
+    def emit(be: BucketEntry, key: bytes):
         if _is_dead(be):
             if keep_tombstones:
                 out.append(be)
+                out_keys.append(key)
         elif _is_init(be) and not keep_tombstones:
             out.append(BucketEntry.liveEntry(be.value))
+            out_keys.append(key)
         else:
             out.append(be)
+            out_keys.append(key)
 
     i = j = 0
     o, n = old.entries, new.entries
-    o_keys = [entry_sort_key(e) for e in o]
-    n_keys = [entry_sort_key(e) for e in n]
+    o_keys = old.sort_keys()
+    n_keys = new.sort_keys()
     while i < len(o) or j < len(n):
         if j >= len(n):
-            emit(o[i]); i += 1
+            emit(o[i], o_keys[i]); i += 1
             continue
         if i >= len(o):
-            emit(n[j]); j += 1
+            emit(n[j], n_keys[j]); j += 1
             continue
         ko, kn = o_keys[i], n_keys[j]
         if ko < kn:
-            emit(o[i]); i += 1
+            emit(o[i], ko); i += 1
         elif kn < ko:
-            emit(n[j]); j += 1
+            emit(n[j], kn); j += 1
         else:
             oe, ne = o[i], n[j]
             i += 1
             j += 1
             if _is_init(oe) and _is_live(ne):
-                emit(BucketEntry.initEntry(ne.value))
+                emit(BucketEntry.initEntry(ne.value), kn)
             elif _is_init(oe) and _is_dead(ne):
                 pass  # annihilated
             elif _is_dead(oe) and _is_init(ne):
-                emit(BucketEntry.liveEntry(ne.value))
+                emit(BucketEntry.liveEntry(ne.value), kn)
             else:
-                emit(ne)
-    return Bucket(out, proto)
+                emit(ne, kn)
+    return Bucket(out, proto, keys=out_keys)

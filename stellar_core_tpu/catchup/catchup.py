@@ -368,9 +368,14 @@ class CatchupManager:
                            accel=self.accel, accel_chunk=self.accel_chunk,
                            lookahead=lookahead, stats=self.stats)
         work.start()
-        while not work.done:
-            if clock.crank() == 0:
-                raise CatchupError("catchup work stalled")
+        try:
+            while not work.done:
+                if clock.crank() == 0:
+                    raise CatchupError("catchup work stalled")
+        finally:
+            # a stalled DAG never reaches the work's finish hooks — the
+            # collector thread must still be released
+            work._close_pipeline()
         if not work.succeeded:
             detail = work.error_detail or "unknown failure"
             raise CatchupError(

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..xdr import LedgerEntry, LedgerHeader, LedgerKey, ledger_entry_key
+from ..xdr import (LedgerEntry, LedgerHeader, LedgerKey, ledger_entry_key,
+                   ledger_entry_key_xdr)
 
 
 class LedgerTxnError(Exception):
@@ -143,8 +144,13 @@ class LedgerTxn(AbstractLedgerTxnParent):
     def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
         """Copy-out load (deep — struct .copy() is shallow); mutate the
         copy then put() it back."""
+        return self.load_by_bytes(key.to_xdr())
+
+    def load_by_bytes(self, key_bytes: bytes) -> Optional[LedgerEntry]:
+        """load() for callers that already hold the key's XDR bytes (the
+        account hot path memoizes them — xdr.account_key_xdr)."""
         self._assert_open_no_child()
-        e = self.get_entry(key.to_xdr())
+        e = self.get_entry(key_bytes)
         return e.deep_copy() if e is not None else None
 
     def exists(self, key: LedgerKey) -> bool:
@@ -153,7 +159,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
 
     def create(self, entry: LedgerEntry) -> None:
         self._assert_open_no_child()
-        kb = ledger_entry_key(entry).to_xdr()
+        kb = ledger_entry_key_xdr(entry)
         if self.get_entry(kb) is not None:
             raise LedgerTxnError("create: entry already exists")
         self._delta[kb] = entry
@@ -162,11 +168,11 @@ class LedgerTxn(AbstractLedgerTxnParent):
         """Create-or-update (reference: LedgerTxn::createWithoutLoading /
         updateWithoutLoading pair)."""
         self._assert_open_no_child()
-        self._delta[ledger_entry_key(entry).to_xdr()] = entry
+        self._delta[ledger_entry_key_xdr(entry)] = entry
 
     def update(self, entry: LedgerEntry) -> None:
         self._assert_open_no_child()
-        kb = ledger_entry_key(entry).to_xdr()
+        kb = ledger_entry_key_xdr(entry)
         if self.get_entry(kb) is None:
             raise LedgerTxnError("update: entry does not exist")
         self._delta[kb] = entry

@@ -96,8 +96,6 @@ class Simulation:
         self.by_id: Dict[bytes, SimNode] = {}
         # live loopback connections: frozenset({id_a, id_b}) -> (pa, pb)
         self._connections: Dict[frozenset, Tuple] = {}
-        self.dropped_messages = 0  # legacy counter (overlay drops are
-        #                            visible in per-node overlay.stats)
 
     # -- topology ----------------------------------------------------------
     def add_node(self, secret: SecretKey, qset,

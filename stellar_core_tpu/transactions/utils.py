@@ -44,7 +44,7 @@ def asset_to_trustline_asset(asset: X.Asset) -> X.TrustLineAsset:
 
 
 def load_account(ltx: LedgerTxn, account_id: X.AccountID) -> Optional[X.LedgerEntry]:
-    return ltx.load(account_key(account_id))
+    return ltx.load_by_bytes(X.account_key_xdr(account_id.value))
 
 
 def load_trustline(ltx: LedgerTxn, account_id: X.AccountID,

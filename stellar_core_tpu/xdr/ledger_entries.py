@@ -441,6 +441,31 @@ def ledger_entry_key(entry: "LedgerEntry") -> "LedgerKey":
     raise ValueError(f"no key for entry type {t}")
 
 
+# Account LedgerKey XDR memo: the replay loop derives an account's key
+# bytes on every load/update; the encoding is a pure function of the
+# 32-byte public key, so memoize it (bounded — pubnet has ~10M accounts,
+# a replay touches far fewer at once).
+_ACCOUNT_KEY_XDR: dict = {}
+
+
+def account_key_xdr(pk: bytes) -> bytes:
+    kb = _ACCOUNT_KEY_XDR.get(pk)
+    if kb is None:
+        kb = LedgerKey.account(_LKAccount(
+            accountID=AccountID.ed25519(pk))).to_xdr()
+        if len(_ACCOUNT_KEY_XDR) < 1_000_000:
+            _ACCOUNT_KEY_XDR[pk] = kb
+    return kb
+
+
+def ledger_entry_key_xdr(entry: "LedgerEntry") -> bytes:
+    """ledger_entry_key(entry).to_xdr() with the account fast path."""
+    d = entry.data
+    if d.switch == LedgerEntryType.ACCOUNT:
+        return account_key_xdr(d.value.accountID.value)
+    return ledger_entry_key(entry).to_xdr()
+
+
 # public aliases for the per-type LedgerKey structs (used by upper layers)
 LedgerKeyAccount = _LKAccount
 LedgerKeyTrustLine = _LKTrustLine
