@@ -227,6 +227,34 @@ def adversarial_quorum_map(n=16):
     return qmap
 
 
+def asym_org_map(n_orgs):
+    """Config #5's exponential class: org sizes cycle 3/4/5 (majority inner
+    thresholds) and each org's nodes carry a byte-distinct qset (org list
+    rotated per org), so the symmetric-org contraction cannot apply and the
+    exact checker must enumerate.  Measured growth per org: CPU ~58x, TPU
+    frontier ~13x (see BASELINE.md config 5 crossover table)."""
+    from stellar_core_tpu import xdr as X
+    sizes = [3 + (i % 3) for i in range(n_orgs)]
+    orgs = []
+    for o, sz in enumerate(sizes):
+        orgs.append([bytes([o + 1]) * 31 + bytes([v]) for v in range(sz)])
+
+    def inner(o):
+        return X.SCPQuorumSet(
+            threshold=sizes[o] // 2 + 1,
+            validators=[X.NodeID.ed25519(m) for m in orgs[o]],
+            innerSets=[])
+
+    qmap = {}
+    thr = (2 * n_orgs + 2) // 3
+    for o in range(n_orgs):
+        rotated = [inner((o + j) % n_orgs) for j in range(n_orgs)]
+        q = X.SCPQuorumSet(threshold=thr, validators=[], innerSets=rotated)
+        for m in orgs[o]:
+            qmap[m] = q
+    return qmap
+
+
 def bench_quorum():
     from stellar_core_tpu.herder.quorum_intersection import check_intersection
     from stellar_core_tpu.accel.quorum import check_intersection_tpu
@@ -247,7 +275,20 @@ def bench_quorum():
     tres = check_intersection_tpu(adv)
     t_tpu_adv = time.perf_counter() - t0
     assert bool(tres.intersects) == bool(res2.intersects)
-    return t_cpu_tier1, t_cpu_adv, t_tpu_adv
+
+    # config 5's exponential class at the largest size that fits the
+    # driver budget (orgs=5, 19 nodes); the 6/7-org crossover rows are
+    # measured offline and recorded in BASELINE.md (orgs=6: CPU 191.5s vs
+    # TPU 211.4s; growth per org CPU ~58x vs TPU ~13x)
+    asym = asym_org_map(5)
+    t0 = time.perf_counter()
+    ares_t = check_intersection_tpu(asym, batch_size=8192)
+    t_tpu_asym = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ares_c = check_intersection(asym)
+    t_cpu_asym = time.perf_counter() - t0
+    assert bool(ares_t.intersects) == bool(ares_c.intersects)
+    return t_cpu_tier1, t_cpu_adv, t_tpu_adv, t_cpu_asym, t_tpu_asym
 
 
 def main():
@@ -273,7 +314,8 @@ def main():
             nid, passphrase, archive, mgr.lcl_hash)
 
     _stage("quorum bench...")
-    t_cpu_tier1, t_cpu_adv, t_tpu_adv = bench_quorum()
+    (t_cpu_tier1, t_cpu_adv, t_tpu_adv,
+     t_cpu_asym, t_tpu_asym) = bench_quorum()
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
@@ -294,6 +336,8 @@ def main():
             "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
             "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
             "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
+            "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
+            "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
             "replay_phases": phases,
         },
     }))

@@ -133,10 +133,15 @@ def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
                                 inner_masks)
         # prune: committed not inside the max quorum of its perimeter
         dead = jnp.any(children & ~mq, axis=-1) | ~jnp.any(mq, axis=-1)
-        cq = _contract_fixpoint(children, top_thr, top_masks, inner_thr,
-                                inner_masks)
+        # committed IS a quorum iff every member's slice is satisfied
+        # within committed — a single _satisfied pass, no fixpoint (the
+        # fixpoint is only needed to find the GREATEST quorum inside a
+        # non-quorum set)
+        n_words = children.shape[-1]
+        sat = _pack_bits(_satisfied(children, top_thr, top_masks, inner_thr,
+                                    inner_masks), n_words)
         nonzero = jnp.any(children, axis=-1)
-        is_q = nonzero & jnp.all(cq == children, axis=-1)
+        is_q = nonzero & ~jnp.any(children & ~sat, axis=-1)
         alive = ~dead & ~is_q
         return alive, is_q
 
@@ -209,8 +214,17 @@ class TPUQuorumIntersectionChecker:
                 raise InterruptedError_()
             chunk = children[lo:lo + bs]
             n_real = len(chunk)
-            pad = (-n_real) % self._pad_to
+            # pad to a power-of-two bucket (min 256, multiple of the mesh):
+            # the frontier doubles every depth, and one jit compile per
+            # distinct batch shape costs ~20-40s on this backend — shape
+            # discipline is the whole ballgame (same lesson as the sig
+            # kernel's tail_floor)
+            width = max(256, 1 << (n_real - 1).bit_length())
+            width += (-width) % self._pad_to
+            pad = width - n_real
             if pad:
+                # padded rows are committed=0 perimeter=remaining — they
+                # compute a real (discarded) contraction, never an error
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad, self.n_words), dtype=np.uint32)])
             a, q = self._step(jnp.asarray(chunk), rem, self.top_thr,

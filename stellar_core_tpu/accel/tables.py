@@ -106,13 +106,17 @@ def point_add_precomp(p: PointBatch, entry) -> PointBatch:
 _build_jit = jax.jit(build_tables)
 
 
-def verify_tables_forward(s_raw, h_raw, slots, r_bytes, key_table, base_table):
-    """Table-path verify: R' = [s]B + [h](-A) via one 64-step scan doing two
-    precomputed-entry table adds per step (fused walks halve the scan-step
-    count — per-step dispatch overhead is material on this backend), then
-    canonical encode + byte compare.  All inputs device-resident;
+def verify_tables_forward(s_raw, h_raw, slots, r_bytes, key_table, base_table,
+                          unroll: int = 4):
+    """Table-path verify: R' = [s]B + [h](-A) via a (64/unroll)-step scan
+    doing 2*unroll precomputed-entry table adds per step, then canonical
+    encode + byte compare.  Fewer, fatter steps amortize the material
+    per-scan-step overhead of this backend (PROFILE.md round-3 A/B: ~0.4ms
+    per step; unroll=4 measured best of {1,2,4,8} — gains flatten once the
+    step body is ~64 field muls).  All inputs device-resident;
     s_raw/h_raw/r_bytes are (N, 32) uint8 byte matrices (cast on device —
     the host link is slow, so the wire format is bytes, not int32)."""
+    assert NWIN % unroll == 0
     s_raw = s_raw.astype(jnp.int32)
     h_raw = h_raw.astype(jnp.int32)
     n = s_raw.shape[0]
@@ -120,21 +124,26 @@ def verify_tables_forward(s_raw, h_raw, slots, r_bytes, key_table, base_table):
     r0 = PointBatch(zero, zero.at[:, 0].set(1), zero.at[:, 0].set(1), zero)
     digs_s = jnp.stack([_digits_le(s_raw, w) for w in range(NWIN)], axis=0)
     digs_h = jnp.stack([_digits_le(h_raw, w) for w in range(NWIN)], axis=0)
+    nstep = NWIN // unroll
 
     def step(carry, xs):
-        w, ds, dh = xs
+        ws, dss, dhs = xs   # each (unroll,) / (unroll, N)
         r = PointBatch.from_tree(carry)
-        r = point_add_precomp(r, base_table[w, ds])
-        r = point_add_precomp(r, key_table[slots, w, dh])
+        for j in range(unroll):
+            r = point_add_precomp(r, base_table[ws[j], dss[j]])
+            r = point_add_precomp(r, key_table[slots, ws[j], dhs[j]])
         return r.tree(), None
 
-    xs = (jnp.arange(NWIN, dtype=jnp.int32), digs_s, digs_h)
+    xs = (jnp.arange(NWIN, dtype=jnp.int32).reshape(nstep, unroll),
+          digs_s.reshape(nstep, unroll, n),
+          digs_h.reshape(nstep, unroll, n))
     final, _ = lax.scan(step, r0.tree(), xs)
     enc = point_encode(PointBatch.from_tree(final))
     return jnp.all(enc == r_bytes.astype(jnp.uint8), axis=-1)
 
 
-_verify_tables_jit = jax.jit(verify_tables_forward)
+_verify_tables_jit = jax.jit(verify_tables_forward,
+                             static_argnames=("unroll",))
 
 
 _base_table = None

@@ -75,6 +75,13 @@ class Invariant:
         """Return an error message, or None if the invariant holds."""
         raise NotImplementedError
 
+    def check_on_bucket_apply(self, entry: X.BucketEntry, level: int,
+                              header_seq: int) -> Optional[str]:
+        """Per-entry check while assuming state from bucket files
+        (reference: InvariantManagerImpl::checkOnBucketApply).  Default:
+        nothing to check."""
+        return None
+
 
 # ---------------------------------------------------------------------------
 
@@ -297,6 +304,39 @@ class LedgerEntryIsValid(Invariant):
                     return "non-positive offer amount/price"
         return None
 
+    def check_on_bucket_apply(self, entry: X.BucketEntry, level: int,
+                              header_seq: int) -> Optional[str]:
+        """Structural sanity of entries assumed from an archive's buckets
+        (reference: LedgerEntryIsValid under checkOnBucketApply): same
+        per-type checks, but lastModified may be any ledger <= the header
+        being assumed."""
+        if entry.switch in (X.BucketEntryType.DEADENTRY,
+                            X.BucketEntryType.METAENTRY):
+            return None
+        e = entry.value
+        where = f"level {level} bucket entry"
+        if e.lastModifiedLedgerSeq > header_seq:
+            return (f"{where}: lastModifiedLedgerSeq "
+                    f"{e.lastModifiedLedgerSeq} is after the assumed "
+                    f"header seq {header_seq}")
+        t = e.data.switch
+        if t == X.LedgerEntryType.ACCOUNT:
+            acc = e.data.value
+            if acc.balance < 0:
+                return f"{where}: negative account balance"
+            if acc.seqNum < 0:
+                return f"{where}: negative seqNum"
+        elif t == X.LedgerEntryType.TRUSTLINE:
+            tl = e.data.value
+            if tl.balance < 0 or tl.limit <= 0 or tl.balance > tl.limit:
+                return (f"{where}: trustline balance {tl.balance} outside "
+                        f"[0, {tl.limit}]")
+        elif t == X.LedgerEntryType.OFFER:
+            off = e.data.value
+            if off.amount <= 0 or off.price.n <= 0 or off.price.d <= 0:
+                return f"{where}: non-positive offer amount/price"
+        return None
+
 
 def _sponsorship_units(entry: Optional[X.LedgerEntry]
                        ) -> Optional[Tuple[bytes, int]]:
@@ -380,3 +420,20 @@ class InvariantManager:
             msg = inv.check_on_ledger_close(ctx)
             if msg is not None:
                 raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")
+
+    def check_on_bucket_apply(self, bucket, level: int,
+                              header_seq: int) -> None:
+        """Run per-entry bucket-apply checks over one assumed bucket
+        (reference: InvariantManagerImpl::checkOnBucketApply — catchup's
+        assume-state path; the hash chain detects corruption, the
+        invariant LOCALIZES it to an entry with a message).  Only
+        invariants that override the hook walk the entries — bucket lists
+        are millions of entries, base-class no-ops are not free."""
+        active = [inv for inv in self.invariants
+                  if type(inv).check_on_bucket_apply
+                  is not Invariant.check_on_bucket_apply]
+        for inv in active:
+            for be in bucket.entries:
+                msg = inv.check_on_bucket_apply(be, level, header_seq)
+                if msg is not None:
+                    raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")

@@ -50,7 +50,8 @@ class ClosedLedgerArtifacts:
 
 
 def assume_bucket_state(bucket_list, header: X.LedgerHeader,
-                        bucket_source, next_source=None) -> LedgerTxnRoot:
+                        bucket_source, next_source=None,
+                        invariant_manager=None) -> LedgerTxnRoot:
     """Fill `bucket_list`'s levels from `bucket_source(hex_hash) -> Bucket`
     and derive the authoritative entry store newest-first (first record per
     key wins; DEADENTRY shadows older versions).  Verifies the reassembled
@@ -74,6 +75,11 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
             if bucket is None:
                 raise RuntimeError("missing bucket for level %d %s"
                                    % (i, attr))
+            if invariant_manager is not None:
+                # localize archive corruption to an entry + message
+                # (reference: InvariantManagerImpl::checkOnBucketApply)
+                invariant_manager.check_on_bucket_apply(
+                    bucket, i, header.ledgerSeq)
             setattr(bucket_list.levels[i], attr, bucket)
             for be in bucket.entries:
                 if be.switch == X.BucketEntryType.DEADENTRY:

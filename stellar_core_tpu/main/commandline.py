@@ -91,9 +91,13 @@ def cmd_catchup(args) -> int:
         print("no archive configured or given", file=sys.stderr)
         return 1
     from ..catchup.catchup import CatchupManager
+    from ..invariant.invariants import InvariantManager
+    inv = (InvariantManager.from_patterns(cfg.INVARIANT_CHECKS)
+           if cfg.INVARIANT_CHECKS else None)
     cm = CatchupManager(cfg.network_id(), cfg.NETWORK_PASSPHRASE,
                         accel=cfg.ACCEL == "tpu",
-                        accel_chunk=cfg.ACCEL_CHUNK_SIZE)
+                        accel_chunk=cfg.ACCEL_CHUNK_SIZE,
+                        invariant_manager=inv)
     at = None
     if args.at and args.at != "current":
         try:
@@ -318,6 +322,44 @@ def cmd_dump_xdr(args) -> int:
         print(json.dumps(_xdr_to_jsonable(val)))
         n += 1
     print(f"# {n} records", file=sys.stderr)
+    return 0
+
+
+def cmd_diag_bucket_stats(args) -> int:
+    """Per-level bucket statistics (reference: `stellar-core
+    diag-bucket-stats` — entry counts by type and size per level)."""
+    cfg = _load_config(args)
+    from .. import xdr as X
+    from .application import Application
+    app = Application(cfg, listen=False)
+    bl = app.lm.bucket_list
+    bl.resolve_all_merges()
+    out = []
+    totals = {"entries": 0, "bytes": 0}
+    for i, lvl in enumerate(bl.levels):
+        row = {"level": i}
+        for attr in ("curr", "snap"):
+            b = getattr(lvl, attr)
+            by_type: dict = {}
+            for be in b.entries:
+                if be.switch == X.BucketEntryType.DEADENTRY:
+                    name = "DEAD"
+                else:
+                    name = be.value.data.switch.name
+                by_type[name] = by_type.get(name, 0) + 1
+            blob = b.serialize()
+            row[attr] = {
+                "hash": b.hash().hex(),
+                "entries": len(b.entries),
+                "bytes": len(blob),
+                "by_type": by_type,
+            }
+            totals["entries"] += len(b.entries)
+            totals["bytes"] += len(blob)
+        out.append(row)
+    print(json.dumps({"ledger": app.lm.last_closed_ledger_seq,
+                      "levels": out, "totals": totals}, indent=2))
+    app.stop()
     return 0
 
 
@@ -555,6 +597,11 @@ def main(argv=None) -> int:
     s.add_argument("--conf", required=True)
     s.add_argument("--limit", type=int, default=0)
     s.set_defaults(fn=cmd_dump_ledger)
+
+    s = sub.add_parser("diag-bucket-stats",
+                       help="per-level bucket entry/size statistics")
+    s.add_argument("--conf", required=True)
+    s.set_defaults(fn=cmd_diag_bucket_stats)
 
     s = sub.add_parser("encode-asset", help="print an asset's XDR")
     s.add_argument("--code", default=None)

@@ -191,3 +191,90 @@ def test_verifier_shards_over_test_mesh():
     expected = [sodium.verify_detached(s, m, p)
                 for p, s, m in zip(pks, sigs, msgs)]
     assert out.tolist() == expected
+
+
+def test_sharded_kernel_under_load_counts_dispatches():
+    """Scaling-shape test (VERDICT r2 next #9): a batch many times the
+    per-dispatch width must stream through the sharded kernel in multiple
+    uniform-width dispatches (each a multiple of the device count, so
+    shard_map splits evenly), with verdicts equal to libsodium's."""
+    jax = pytest.importorskip("jax")
+    from stellar_core_tpu.accel import ed25519 as ed
+    from stellar_core_tpu.crypto import sodium
+
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device backend: no mesh to shard over")
+    ndev = len(jax.devices())
+    v = ed.Ed25519BatchVerifier(chunk_size=64, tail_floor=64,
+                                hot_threshold=1 << 62)  # generic path only
+    shapes = []
+    inner = v._kernel_raw
+
+    def spy(s_raw, hh, kidx, ucx, ucy, uct, rb):
+        shapes.append(int(s_raw.shape[0]))
+        return inner(s_raw, hh, kidx, ucx, ucy, uct, rb)
+
+    v._kernel_raw = spy
+    n = 600   # >> 8x chunk width
+    keys = [sodium.sign_seed_keypair(bytes([i + 1]) * 32) for i in range(6)]
+    pks, sigs, msgs = [], [], []
+    for i in range(n):
+        pk, sk = keys[i % len(keys)]
+        m = i.to_bytes(4, "big") * 8
+        pks.append(pk)
+        sigs.append(sodium.sign_detached(m, sk))
+        msgs.append(m)
+    sigs[13] = bytes([sigs[13][0] ^ 1]) + sigs[13][1:]
+    out = v.verify(pks, sigs, msgs)
+    assert len(shapes) == (n + 63) // 64
+    assert all(w % ndev == 0 for w in shapes), shapes
+    assert int(out.sum()) == n - 1 and not out[13]
+    assert v.stats["generic_sigs"] == n
+
+
+def test_sharded_quorum_frontier_spills_multiple_prune_steps():
+    """The sharded quorum enumerator must stay exact when the frontier
+    exceeds one device batch, i.e. a single depth's pruning spills over
+    several sharded dispatches (VERDICT r2 next #9)."""
+    jax = pytest.importorskip("jax")
+    from jax.sharding import Mesh
+    import numpy as np
+
+    from stellar_core_tpu.accel import quorum as AQ
+    from stellar_core_tpu.xdr import scp as SX
+    from stellar_core_tpu.xdr import types as XT
+
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device backend: no mesh to shard over")
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def qnid(i):
+        return bytes([i]) + bytes(31)
+
+    orgs = [[qnid(10 * o + i) for i in range(3)] for o in range(6)]
+
+    def mk(thr):
+        return SX.SCPQuorumSet(
+            threshold=thr, validators=[],
+            innerSets=[SX.SCPQuorumSet(
+                threshold=2,
+                validators=[XT.node_id(v) for v in org],
+                innerSets=[]) for org in orgs])
+
+    for thr, expect in ((4, True), (3, False)):
+        qmap = {v: mk(thr) for org in orgs for v in org}
+        checker = AQ.TPUQuorumIntersectionChecker(
+            qmap, batch_size=2 * len(jax.devices()), mesh=mesh)
+        calls = []
+        orig_prune = checker._prune
+
+        def spy(children, rem, _orig=orig_prune, _calls=calls):
+            _calls.append(len(children))
+            return _orig(children, rem)
+
+        checker._prune = spy
+        r = checker.check()
+        assert r.intersects is expect
+        # at least one depth's children set exceeded the batch width, so
+        # _prune chunked it into several sharded dispatches
+        assert any(c > checker.batch_size for c in calls), calls

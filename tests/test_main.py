@@ -106,6 +106,20 @@ class TestCli:
         assert "genesis ledger 1" in r.stdout
         assert (tmp_path / "node.db").exists()
 
+    def test_diag_bucket_stats(self, tmp_path):
+        conf = tmp_path / "n.cfg"
+        conf.write_text(f'DATABASE = "{tmp_path}/node.db"\n')
+        assert self._run("new-db", "--conf", str(conf)).returncode == 0
+        r = self._run("diag-bucket-stats", "--conf", str(conf))
+        assert r.returncode == 0, r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["ledger"] >= 1
+        assert len(doc["levels"]) == 11
+        assert doc["totals"]["entries"] >= 1   # at least the root account
+        lvl0 = doc["levels"][0]["curr"]
+        assert len(lvl0["hash"]) == 64
+        assert sum(lvl0["by_type"].values()) == lvl0["entries"]
+
     def test_check_quorum_intersection(self, tmp_path):
         ids = [SecretKey(bytes([i + 1]) * 32).public_key.to_strkey()
                for i in range(4)]
