@@ -107,15 +107,19 @@ _build_jit = jax.jit(build_tables)
 
 
 def verify_tables_forward(s_raw, h_raw, slots, r_bytes, key_table, base_table,
-                          unroll: int = 4):
+                          unroll: int = 1):
     """Table-path verify: R' = [s]B + [h](-A) via a (64/unroll)-step scan
     doing 2*unroll precomputed-entry table adds per step, then canonical
-    encode + byte compare.  Fewer, fatter steps amortize the material
-    per-scan-step overhead of this backend (PROFILE.md round-3 A/B: ~0.4ms
-    per step; unroll=4 measured best of {1,2,4,8} — gains flatten once the
-    step body is ~64 field muls).  All inputs device-resident;
-    s_raw/h_raw/r_bytes are (N, 32) uint8 byte matrices (cast on device —
-    the host link is slow, so the wire format is bytes, not int32)."""
+    encode + byte compare.  The unroll knob exists because r2's profile
+    blamed per-scan-step overhead; the round-3 interleaved A/B refuted
+    that: u1/u2/u4/u8 measured 34.3/34.1/33.6/33.3k sigs/s at batch 8192
+    and u1 also won at 32k/64k — XLA already pipelines the scan, so the
+    default stays 1.  What actually moves the kernel is BATCH WIDTH
+    (34k @ 8192 -> 54k @ 32768 -> 58k @ 65536 sigs/s): per-dispatch cost
+    amortizes across wider batches (see PROFILE.md round 3).  All inputs
+    device-resident; s_raw/h_raw/r_bytes are (N, 32) uint8 byte matrices
+    (cast on device — the host link is slow, so the wire format is bytes,
+    not int32)."""
     assert NWIN % unroll == 0
     s_raw = s_raw.astype(jnp.int32)
     h_raw = h_raw.astype(jnp.int32)

@@ -97,19 +97,69 @@ class PreverifyPipeline:
         # materialization (np.asarray), NOT at kernel enqueue — JAX's async
         # dispatch alone buys no overlap here (measured: a dispatched
         # kernel sat idle through 2x its runtime of host busy-work, then
-        # took full device time to collect).  So the collector runs on ONE
-        # background thread, which blocks in the tunnel RPC with the GIL
-        # released while the main thread applies ledgers.  collect() then
-        # just joins the future.
-        self._executor = None
+        # took full device time to collect).  So ALL device interaction for
+        # a group — enqueue AND materialize — runs on ONE background daemon
+        # worker, which blocks in the tunnel RPC with the GIL released
+        # while the main thread applies ledgers.  Keeping enqueue off the
+        # main thread also serializes every tunnel call: concurrent
+        # main-thread enqueue + worker materialize wedged the tunnel
+        # client intermittently (observed: a bench pass frozen mid-RPC
+        # with zero CPU advance).  collect() waits with a timeout and
+        # falls back to on-demand CPU verification if the tunnel wedges —
+        # verdicts are then computed by libsodium instead of seeded, so
+        # behavior degrades to CPU speed, never to a hang; the daemon
+        # worker cannot block interpreter exit.
+        self._worker = None
+        self._jobs = None
         # hint (4 bytes) -> [pk, ...] of every SetOptions-added ed25519
         # signer seen in any dispatched checkpoint (cumulative: covers
         # signers added between the pairing state snapshot and apply)
         self._harvested_hint: Dict[bytes, List[bytes]] = {}
         self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
 
+    # a wedged tunnel RPC must degrade to CPU-speed verification, not hang
+    # the catchup; generous enough for a cold compile (~60s observed)
+    COLLECT_TIMEOUT_S = 180.0
+
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
+
+    def _submit(self, fn):
+        """Run fn on the single daemon device-worker; returns (box, event).
+        box["result"]/box["error"] is set before event fires."""
+        import queue
+        import threading
+        if self._worker is None:
+            jobs = queue.Queue()
+            self._jobs = jobs
+
+            def run(jobs=jobs):
+                # the worker serves ONLY its own generation's queue: an
+                # abandoned (wedged) worker that later un-wedges must not
+                # rebind to a successor's queue — two workers draining one
+                # queue would reintroduce the concurrent tunnel calls this
+                # design exists to prevent
+                while True:
+                    item = jobs.get()
+                    if item is None:
+                        return
+                    jfn, jbox, jev = item
+                    try:
+                        jbox["result"] = jfn()
+                    except BaseException as e:  # surfaced at collect()
+                        jbox["error"] = e
+                    jev.set()
+
+            self._worker = threading.Thread(target=run, daemon=True,
+                                            name="preverify-device")
+            self._worker.start()
+        box: dict = {}
+        ev = threading.Event()
+        self._jobs.put((fn, box, ev))
+        # the queue ref tags the job's worker generation: after a wedge the
+        # stale queue's remaining jobs will never run, and collect() must
+        # fall back immediately instead of waiting out a timeout per group
+        return box, ev, self._jobs
 
     def dispatch(self, entries_by_checkpoint: Dict[int, Sequence],
                  ledger_state=None) -> None:
@@ -194,7 +244,7 @@ class PreverifyPipeline:
         self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + total
         self.stats["sigs_shipped"] = \
             self.stats.get("sigs_shipped", 0) + len(pks)
-        future = None
+        job = None
         if pks:
             # tail_floor=chunk_size: one compiled shape per path, amortized
             # across every checkpoint of the catchup.  Per-key window
@@ -202,15 +252,15 @@ class PreverifyPipeline:
             # dispatches cost more than they save (measured on the tunnel
             # rig — see PROFILE.md); the generic path is a single kernel
             # per chunk.
-            collector = verify_batch_async(
-                pks, sigs, msgs, chunk_size=self.chunk_size,
-                tail_floor=self.chunk_size, hot_threshold=1 << 62)
-            if self._executor is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._executor = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="preverify")
-            future = self._executor.submit(collector)
-        group = {"future": future, "pks": pks, "sigs": sigs,
+            chunk = self.chunk_size
+
+            def device_job(pks=pks, sigs=sigs, msgs=msgs):
+                return verify_batch_async(
+                    pks, sigs, msgs, chunk_size=chunk,
+                    tail_floor=chunk, hot_threshold=1 << 62)()
+
+            job = self._submit(device_job)
+        group = {"job": job, "pks": pks, "sigs": sigs,
                  "msgs": msgs, "checkpoints": cps}
         for cp in cps:
             self._groups[cp] = group
@@ -232,27 +282,55 @@ class PreverifyPipeline:
         if group is None or group.get("collected"):
             return
         group["collected"] = True
-        future = group["future"]
-        if future is None:
+        job = group["job"]
+        if job is None:
             return
         import time as _time
+        box, ev, q = job
         t0 = _time.perf_counter()
-        verdicts = future.result()
+        stale = q is not self._jobs and not ev.is_set()
+        if stale:
+            done = False   # stale worker generation: never going to finish
+        else:
+            done = ev.wait(self.COLLECT_TIMEOUT_S)
         # sync stall: how long the apply cursor waited on the device —
         # ~0 when double-buffering hid the compute under earlier applies
         self.stats["collect_wait_s"] = self.stats.get("collect_wait_s", 0.0) \
             + (_time.perf_counter() - t0)
+        if not done or "error" in box:
+            # tunnel wedge or device fault: fall back to on-demand CPU
+            # verification for this group (verdicts identical, just not
+            # prefetched).  The daemon worker stays blocked in its RPC
+            # harmlessly; drop it so later groups get a fresh worker.
+            log.warning(
+                "preverify collect %s for checkpoints %s — falling back to "
+                "on-demand CPU verification",
+                "timed out" if not done else f"failed: {box.get('error')}",
+                group["checkpoints"])
+            self.stats["collect_fallbacks"] = \
+                self.stats.get("collect_fallbacks", 0) + 1
+            if not done and not stale:
+                # a genuine wedge: abandon this worker generation (the
+                # daemon thread stays blocked harmlessly); a stale job's
+                # current worker is healthy and keeps serving
+                self._worker = None
+                self._jobs = None
+            return
+        verdicts = box["result"]
         pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
         keys.seed_verify_cache(
             (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
             for i in range(len(pks)))
 
     def close(self) -> None:
-        """Release the collector thread (a pipeline is per-catchup; a node
-        that resyncs repeatedly must not accumulate idle workers)."""
-        if self._executor is not None:
-            self._executor.shutdown(wait=False)
-            self._executor = None
+        """Release the device worker (a pipeline is per-catchup; a node
+        that resyncs repeatedly must not accumulate idle workers).  A
+        healthy worker exits on the None sentinel; a wedged one is daemon
+        and dies with the process."""
+        if self._jobs is not None:
+            self._jobs.put(None)
+        self._worker = None
+        self._jobs = None
 
 
 def preverify_checkpoint_signatures(network_id: bytes,

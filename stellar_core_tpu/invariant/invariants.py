@@ -278,6 +278,28 @@ class LedgerEntryIsValid(Invariant):
     amounts, balance <= limit, lastModified == closing seq)."""
     NAME = "LedgerEntryIsValid"
 
+    @staticmethod
+    def _entry_struct_error(e: X.LedgerEntry) -> Optional[str]:
+        """Shared per-type structural checks (one source of truth for the
+        ledger-close and bucket-apply hooks — the two paths must never
+        diverge on what a valid entry is)."""
+        t = e.data.switch
+        if t == X.LedgerEntryType.ACCOUNT:
+            acc = e.data.value
+            if acc.balance < 0:
+                return "negative account balance"
+            if acc.seqNum < 0:
+                return "negative seqNum"
+        elif t == X.LedgerEntryType.TRUSTLINE:
+            tl = e.data.value
+            if tl.balance < 0 or tl.limit <= 0 or tl.balance > tl.limit:
+                return f"trustline balance {tl.balance} outside [0, {tl.limit}]"
+        elif t == X.LedgerEntryType.OFFER:
+            off = e.data.value
+            if off.amount <= 0 or off.price.n <= 0 or off.price.d <= 0:
+                return "non-positive offer amount/price"
+        return None
+
     def check_on_ledger_close(self, ctx: LedgerCloseContext) -> Optional[str]:
         seq = ctx.post_header.ledgerSeq
         for kb, e in ctx.post.items():
@@ -286,22 +308,9 @@ class LedgerEntryIsValid(Invariant):
             if e.lastModifiedLedgerSeq != seq:
                 return (f"lastModifiedLedgerSeq {e.lastModifiedLedgerSeq} != "
                         f"closing seq {seq} for {kb.hex()[:16]}")
-            t = e.data.switch
-            if t == X.LedgerEntryType.ACCOUNT:
-                acc = e.data.value
-                if acc.balance < 0:
-                    return "negative account balance"
-                if acc.seqNum < 0:
-                    return "negative seqNum"
-            elif t == X.LedgerEntryType.TRUSTLINE:
-                tl = e.data.value
-                if tl.balance < 0 or tl.limit <= 0 or tl.balance > tl.limit:
-                    return (f"trustline balance {tl.balance} outside "
-                            f"[0, {tl.limit}]")
-            elif t == X.LedgerEntryType.OFFER:
-                off = e.data.value
-                if off.amount <= 0 or off.price.n <= 0 or off.price.d <= 0:
-                    return "non-positive offer amount/price"
+            msg = self._entry_struct_error(e)
+            if msg is not None:
+                return msg
         return None
 
     def check_on_bucket_apply(self, entry: X.BucketEntry, level: int,
@@ -319,22 +328,9 @@ class LedgerEntryIsValid(Invariant):
             return (f"{where}: lastModifiedLedgerSeq "
                     f"{e.lastModifiedLedgerSeq} is after the assumed "
                     f"header seq {header_seq}")
-        t = e.data.switch
-        if t == X.LedgerEntryType.ACCOUNT:
-            acc = e.data.value
-            if acc.balance < 0:
-                return f"{where}: negative account balance"
-            if acc.seqNum < 0:
-                return f"{where}: negative seqNum"
-        elif t == X.LedgerEntryType.TRUSTLINE:
-            tl = e.data.value
-            if tl.balance < 0 or tl.limit <= 0 or tl.balance > tl.limit:
-                return (f"{where}: trustline balance {tl.balance} outside "
-                        f"[0, {tl.limit}]")
-        elif t == X.LedgerEntryType.OFFER:
-            off = e.data.value
-            if off.amount <= 0 or off.price.n <= 0 or off.price.d <= 0:
-                return f"{where}: non-positive offer amount/price"
+        msg = self._entry_struct_error(e)
+        if msg is not None:
+            return f"{where}: {msg}"
         return None
 
 

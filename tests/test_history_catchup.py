@@ -425,8 +425,24 @@ def test_coalesced_dispatch_pairs_cross_checkpoint_signers(tmp_path):
 
     keys.clear_verify_cache()
     cm = CatchupManager(nid, "xcp accel net", accel=True, accel_chunk=256)
-    replayed = cm.catchup_complete(archive)
+    # regression guard: every checkpoint must be device-dispatched exactly
+    # once (a collect() bug once dropped a whole coalesced group from the
+    # registry, silently re-dispatching each member synchronously)
+    from stellar_core_tpu.catchup.catchup import PreverifyPipeline
+    dispatched_cps = []
+    orig_dispatch = PreverifyPipeline.dispatch
+
+    def spy(self, entries, ledger_state=None):
+        dispatched_cps.extend(entries)
+        return orig_dispatch(self, entries, ledger_state=ledger_state)
+
+    PreverifyPipeline.dispatch = spy
+    try:
+        replayed = cm.catchup_complete(archive)
+    finally:
+        PreverifyPipeline.dispatch = orig_dispatch
     assert replayed.lcl_hash == mgr.lcl_hash
+    assert sorted(dispatched_cps) == [63, 127], dispatched_cps
     assert cm.stats["sigs_total"] >= 16
     assert cm.offload_hit_rate() == 1.0, cm.stats
 

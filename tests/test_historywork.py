@@ -120,3 +120,55 @@ def test_partial_target_inside_checkpoint(archive2cp):
     cm = CatchupManager(NID, PASSPHRASE)
     out = cm.catchup_complete(archive, to_ledger=70)
     assert out.last_closed_ledger_seq == 70
+
+
+def test_preverify_collect_timeout_falls_back_to_cpu():
+    """A wedged device job must degrade to on-demand CPU verification
+    (no cache seeding, loud warning, fresh worker for later groups) —
+    never hang the apply cursor (the shared tunnel wedges for real)."""
+    import threading
+    import time
+
+    from stellar_core_tpu.catchup.catchup import PreverifyPipeline
+    from stellar_core_tpu.testutils import network_id
+
+    pipe = PreverifyPipeline(network_id("wedge net"), 256)
+    pipe.COLLECT_TIMEOUT_S = 0.05
+
+    # genuine wedge: a REAL submitted job that blocks past the timeout —
+    # exercises the ev.wait timeout branch and the worker-generation drop
+    release = threading.Event()
+    job = pipe._submit(lambda: release.wait(30.0))
+    wedged_jobs = pipe._jobs
+    pipe._groups[63] = {"job": job, "pks": [], "sigs": [],
+                        "msgs": [], "checkpoints": [63]}
+    t0 = time.perf_counter()
+    pipe.collect(63)           # must return promptly, not block
+    assert time.perf_counter() - t0 < 5.0
+    assert pipe.stats.get("collect_fallbacks") == 1
+    assert pipe._jobs is None and pipe._worker is None  # generation dropped
+    # a later healthy dispatch gets a FRESH worker and completes
+    done = pipe._submit(lambda: 42)
+    assert pipe._jobs is not wedged_jobs
+    assert done[1].wait(5.0) and done[0]["result"] == 42
+    # a job stranded on the wedged generation's queue: immediate fallback
+    # without waiting out the (now long) timeout
+    stale_ev = threading.Event()
+    pipe._groups[127] = {"job": ({}, stale_ev, wedged_jobs), "pks": [],
+                         "sigs": [], "msgs": [], "checkpoints": [127]}
+    pipe.COLLECT_TIMEOUT_S = 60.0
+    t0 = time.perf_counter()
+    pipe.collect(127)
+    assert time.perf_counter() - t0 < 1.0   # did NOT wait out the timeout
+    assert pipe.stats["collect_fallbacks"] == 2
+    # the healthy current worker survived the stale fallback
+    ok = pipe._submit(lambda: 7)
+    assert ok[1].wait(5.0) and ok[0]["result"] == 7
+    # un-wedge the gen-1 worker: it must NOT rebind to the new queue (a
+    # revived worker draining the successor's queue would reintroduce
+    # concurrent tunnel calls)
+    release.set()
+    time.sleep(0.1)
+    probe = pipe._submit(lambda: 9)
+    assert probe[1].wait(5.0) and probe[0]["result"] == 9
+    pipe.close()
