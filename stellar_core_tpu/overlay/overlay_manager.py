@@ -50,7 +50,8 @@ class OverlayManager:
         self.ban_manager = BanManager(database)
         self.survey = SurveyManager(self, node_secret)
         herder.lost_sync_hook = self.survey.record_lost_sync
-        self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0}
+        self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0,
+              "txsets_served": 0, "qsets_served": 0}
 
         # herder wiring (same seams the in-process simulation uses)
         herder.broadcast = self.broadcast_scp_envelope
@@ -332,6 +333,7 @@ class OverlayManager:
     def _serve_txset(self, peer: Peer, h: bytes) -> None:
         got = self.herder.pending.get_txset(h)
         if got is not None:
+            self.stats["txsets_served"] += 1
             peer.send_message(X.StellarMessage.txSet(got[0]))
         else:
             peer.send_message(X.StellarMessage.dontHave(X.DontHave(
@@ -340,6 +342,7 @@ class OverlayManager:
     def _serve_qset(self, peer: Peer, h: bytes) -> None:
         qs = self.herder.pending.get_qset(h)
         if qs is not None:
+            self.stats["qsets_served"] += 1
             peer.send_message(X.StellarMessage.qSet(qs))
         else:
             peer.send_message(X.StellarMessage.dontHave(X.DontHave(

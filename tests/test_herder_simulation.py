@@ -73,6 +73,44 @@ class TestTransactionFlow:
             assert entry.data.value.balance == 50_000_000_000
         assert sim.hashes_agree()
 
+    def test_pull_mode_fetch_serves_missing_txset_during_consensus(self):
+        """Consensus over the real overlay uses hash-addressed item fetch:
+        a validator that hears ballots for a txset it never saw must pull
+        it from a peer (reference: Simulation OVER_LOOPBACK exercising
+        ItemFetcher/TxSetFrame fetch — VERDICT r2 next #6)."""
+        sim = make_running_sim(3)
+        node = sim.nodes[0]
+        root_sk = node.lm.root_account_secret()
+        root_entry = node.lm.root.get_entry(
+            X.LedgerKey.account(X.LedgerKeyAccount(
+                accountID=X.AccountID.ed25519(
+                    root_sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(node.lm, root_sk, root_entry.data.value.seqNum)
+        # submit directly into node 0's herder WITHOUT flooding, so the
+        # txset node 0 proposes is unknown to nodes 1 and 2 until their
+        # herders demand it by hash during the SCP round
+        dest_pk = SecretKey(b"\x79" * 32).public_key.ed25519
+        frame = root.tx([create_account_op(
+            X.AccountID.ed25519(dest_pk), 10_000_000_000)])
+        saved_flood, node.herder.tx_flood = node.herder.tx_flood, \
+            (lambda f: None)
+        key = X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(dest_pk))).to_xdr()
+        try:
+            res = node.submit(frame)
+            assert res.code == AddResult.STATUS_PENDING
+            # the tx externalizes whenever node 0's nomination wins a
+            # round — crank until it lands everywhere (not a fixed count)
+            assert sim.crank_until(
+                lambda: all(n.lm.root.get_entry(key) is not None
+                            for n in sim.nodes), timeout=240)
+        finally:
+            node.herder.tx_flood = saved_flood
+        assert sim.hashes_agree()
+        served = sum(n.overlay.stats.get("txsets_served", 0)
+                     for n in sim.nodes)
+        assert served >= 1, [n.overlay.stats for n in sim.nodes]
+
     def test_duplicate_submission_rejected(self):
         sim = make_running_sim(3)
         node = sim.nodes[0]

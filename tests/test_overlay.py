@@ -490,6 +490,36 @@ class TestSurvey:
         assert sm.recv_stop_collecting(None, st) is False
         assert nonce in sm._known_nonces
 
+    def test_nonce_memory_bounded_and_first_writer_wins(self):
+        """Relay nonce memory is attacker-writable: it must be hard-capped,
+        expire on OUR ledger clock (not the message's claimed ledgerNum),
+        and never rebind a live nonce to a different surveyor."""
+        from stellar_core_tpu.overlay.survey import MAX_KNOWN_NONCES
+        clock, sks, nodes = self._three_chain()
+        ob = nodes[1][1]
+        sm = ob.survey
+        surveyor_sk = sks[0]
+
+        def start(nonce, ledger_num, sk=surveyor_sk):
+            msg = X.TimeSlicedSurveyStartCollectingMessage(
+                surveyorID=X.NodeID.ed25519(sk.public_key.ed25519),
+                nonce=nonce, ledgerNum=ledger_num)
+            return X.SignedTimeSlicedSurveyStartCollectingMessage(
+                signature=sk.sign(sm.TAG_START + msg.to_xdr()),
+                startCollecting=msg)
+
+        # claimed far-future ledgerNum must not pin entries: expiry uses
+        # the local ledger
+        sm.recv_start_collecting(None, start(1, 2**31 - 1))
+        assert sm._known_nonces[1][1] <= sm._ledger_num()
+        # a reused live nonce keeps its first surveyor binding
+        sm.recv_start_collecting(None, start(1, 5, sk=sks[2]))
+        assert sm._known_nonces[1][0] == surveyor_sk.public_key.ed25519
+        # the memory is hard-capped
+        for n in range(2, MAX_KNOWN_NONCES + 50):
+            sm.recv_start_collecting(None, start(n, 5))
+        assert len(sm._known_nonces) <= MAX_KNOWN_NONCES
+
     def test_forged_start_collecting_rejected(self):
         clock, sks, nodes = self._three_chain()
         oc = nodes[2][1]
