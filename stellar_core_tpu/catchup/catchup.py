@@ -111,6 +111,8 @@ class PreverifyPipeline:
         # worker cannot block interpreter exit.
         self._worker = None
         self._jobs = None
+        self._consecutive_wedges = 0
+        self._disabled = False
         # hint (4 bytes) -> [pk, ...] of every SetOptions-added ed25519
         # signer seen in any dispatched checkpoint (cumulative: covers
         # signers added between the pairing state snapshot and apply)
@@ -120,6 +122,11 @@ class PreverifyPipeline:
     # a wedged tunnel RPC must degrade to CPU-speed verification, not hang
     # the catchup; generous enough for a cold compile (~60s observed)
     COLLECT_TIMEOUT_S = 180.0
+    # after this many consecutive genuine wedges the device is presumed
+    # dead and the pipeline disables itself — otherwise a long catchup
+    # would pay the full timeout once per group (observed: the tunnel can
+    # go down for an hour+)
+    MAX_CONSECUTIVE_WEDGES = 2
 
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
@@ -165,6 +172,20 @@ class PreverifyPipeline:
                  ledger_state=None) -> None:
         """Pair + enqueue one device batch covering every checkpoint in
         `entries_by_checkpoint` (ascending order).  No device sync."""
+        if self._disabled:
+            # device presumed dead: pure CPU verification.  Still count
+            # the signatures so offload_hit_rate() honestly reflects the
+            # un-offloaded remainder instead of freezing at ~1.0.
+            total = 0
+            for cp in entries_by_checkpoint:
+                for entry in entries_by_checkpoint[cp]:
+                    for env in entry.txSet.txs:
+                        frame = TransactionFrame.make_from_wire(
+                            self.network_id, env)
+                        total += len(frame.signatures)
+            self.stats["sigs_total"] = \
+                self.stats.get("sigs_total", 0) + total
+            return
         import time as _time
 
         from ..accel.ed25519 import verify_batch_async
@@ -315,7 +336,15 @@ class PreverifyPipeline:
                 # current worker is healthy and keeps serving
                 self._worker = None
                 self._jobs = None
+                self._consecutive_wedges += 1
+                if self._consecutive_wedges >= self.MAX_CONSECUTIVE_WEDGES:
+                    self._disabled = True
+                    log.warning(
+                        "preverify pipeline DISABLED after %d consecutive "
+                        "device wedges — remaining catchup verifies on CPU",
+                        self._consecutive_wedges)
             return
+        self._consecutive_wedges = 0
         verdicts = box["result"]
         pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
         keys.seed_verify_cache(

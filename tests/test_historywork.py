@@ -172,3 +172,31 @@ def test_preverify_collect_timeout_falls_back_to_cpu():
     probe = pipe._submit(lambda: 9)
     assert probe[1].wait(5.0) and probe[0]["result"] == 9
     pipe.close()
+
+
+def test_preverify_disables_after_consecutive_wedges():
+    """A permanently dead device must not cost one full timeout per group
+    (a long catchup has dozens): after MAX_CONSECUTIVE_WEDGES genuine
+    timeouts the pipeline disables itself and later dispatches no-op."""
+    import threading
+
+    from stellar_core_tpu.catchup.catchup import PreverifyPipeline
+    from stellar_core_tpu.testutils import network_id
+
+    pipe = PreverifyPipeline(network_id("dead net"), 256)
+    pipe.COLLECT_TIMEOUT_S = 0.05
+    for i, cp in enumerate((63, 127)):
+        job = pipe._submit(lambda: threading.Event().wait(30.0))  # wedge
+        pipe._groups[cp] = {"job": job, "pks": [], "sigs": [],
+                            "msgs": [], "checkpoints": [cp]}
+        pipe.collect(cp)
+    assert pipe._disabled
+    assert pipe.stats["collect_fallbacks"] == 2
+    # disabled: dispatch is a no-op device-wise (still counts sigs for
+    # honest hit-rate accounting), collect of undispatched cp is a no-op
+    pipe.dispatch({191: []})
+    assert not pipe.dispatched(191)
+    pipe.collect(191)
+    assert pipe.stats.get("sigs_total", 0) == 0   # empty entries: 0 sigs
+    assert pipe.stats.get("sigs_shipped", 0) == 0
+    pipe.close()
