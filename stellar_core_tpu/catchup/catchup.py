@@ -175,16 +175,22 @@ class PreverifyPipeline:
         if self._disabled:
             # device presumed dead: pure CPU verification.  Still count
             # the signatures so offload_hit_rate() honestly reflects the
-            # un-offloaded remainder instead of freezing at ~1.0.
+            # un-offloaded remainder instead of freezing at ~1.0 (every
+            # envelope arm exposes .signatures — no frame construction),
+            # and register a no-op collected group so the apply path sees
+            # dispatched()==True and does not re-dispatch/double-count.
             total = 0
             for cp in entries_by_checkpoint:
                 for entry in entries_by_checkpoint[cp]:
                     for env in entry.txSet.txs:
-                        frame = TransactionFrame.make_from_wire(
-                            self.network_id, env)
-                        total += len(frame.signatures)
+                        total += len(env.value.signatures)
             self.stats["sigs_total"] = \
                 self.stats.get("sigs_total", 0) + total
+            cps = sorted(entries_by_checkpoint)
+            group = {"job": None, "pks": [], "sigs": [], "msgs": [],
+                     "checkpoints": cps, "collected": True}
+            for cp in cps:
+                self._groups[cp] = group
             return
         import time as _time
 
@@ -263,8 +269,8 @@ class PreverifyPipeline:
                         sigs.append(dsig.signature)
                         msgs.append(h)
         self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + total
-        self.stats["sigs_shipped"] = \
-            self.stats.get("sigs_shipped", 0) + len(pks)
+        # sigs_shipped is counted at COLLECT time (successful seeding
+        # only): a group that wedges and falls back to CPU never shipped
         job = None
         if pks:
             # tail_floor=chunk_size: one compiled shape per path, amortized
@@ -350,6 +356,8 @@ class PreverifyPipeline:
         keys.seed_verify_cache(
             (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
             for i in range(len(pks)))
+        self.stats["sigs_shipped"] = \
+            self.stats.get("sigs_shipped", 0) + len(pks)
 
     def close(self) -> None:
         """Release the device worker (a pipeline is per-catchup; a node

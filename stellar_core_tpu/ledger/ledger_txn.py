@@ -17,8 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..xdr import (LedgerEntry, LedgerHeader, LedgerKey, ledger_entry_key,
-                   ledger_entry_key_xdr)
+from ..xdr import (LedgerEntry, LedgerHeader, LedgerKey, deep_copy_value,
+                   ledger_entry_key, ledger_entry_key_xdr)
 
 
 class LedgerTxnError(Exception):
@@ -151,7 +151,9 @@ class LedgerTxn(AbstractLedgerTxnParent):
         account hot path memoizes them — xdr.account_key_xdr)."""
         self._assert_open_no_child()
         e = self.get_entry(key_bytes)
-        return e.deep_copy() if e is not None else None
+        # deep_copy_value dispatches straight to the native copier,
+        # skipping the per-entry Python method wrapper (hot path)
+        return deep_copy_value(e) if e is not None else None
 
     def exists(self, key: LedgerKey) -> bool:
         self._assert_open_no_child()

@@ -38,6 +38,7 @@ class TransactionFrame:
         self.network_id = network_id
         self.envelope = envelope
         self._hash: Optional[bytes] = None
+        self._src_id: Optional[X.AccountID] = None
 
     # -- wire/creation ------------------------------------------------------
     @staticmethod
@@ -61,9 +62,15 @@ class TransactionFrame:
         return self.envelope.value.signatures
 
     def source_account_id(self) -> X.AccountID:
-        if self.is_v0:
-            return X.AccountID.ed25519(self.tx.sourceAccountEd25519)
-        return X.muxed_to_account_id(self.tx.sourceAccount)
+        # memoized like _hash: called several times per apply (fee, seq,
+        # signature, op phases) and the envelope is immutable once framed
+        if self._src_id is None:
+            if self.is_v0:
+                self._src_id = X.AccountID.ed25519(
+                    self.tx.sourceAccountEd25519)
+            else:
+                self._src_id = X.muxed_to_account_id(self.tx.sourceAccount)
+        return self._src_id
 
     @property
     def operations(self) -> List[X.Operation]:

@@ -6,7 +6,8 @@ the types are declared directly in Python combinators (codec.py).
 
 from .codec import (Bool, FixedArray, Int32, Int64, Opaque, Optional, Uint32,
                     Uint64, VarArray, VarOpaque, Void, XdrError, XdrString,
-                    pack, unpack, xdr_enum, xdr_struct, xdr_union)
+                    deep_copy_value, pack, unpack, xdr_enum, xdr_struct,
+                    xdr_union)
 from .types import *      # noqa: F401,F403
 from .contract import *        # noqa: F401,F403
 from .ledger_entries import *  # noqa: F401,F403

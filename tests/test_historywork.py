@@ -192,10 +192,11 @@ def test_preverify_disables_after_consecutive_wedges():
         pipe.collect(cp)
     assert pipe._disabled
     assert pipe.stats["collect_fallbacks"] == 2
-    # disabled: dispatch is a no-op device-wise (still counts sigs for
-    # honest hit-rate accounting), collect of undispatched cp is a no-op
+    # disabled: dispatch registers a collected no-op group (so the apply
+    # path does not re-dispatch) and still counts sigs for honest hit-rate
+    # accounting; collect is then a no-op
     pipe.dispatch({191: []})
-    assert not pipe.dispatched(191)
+    assert pipe.dispatched(191)
     pipe.collect(191)
     assert pipe.stats.get("sigs_total", 0) == 0   # empty entries: 0 sigs
     assert pipe.stats.get("sigs_shipped", 0) == 0
