@@ -318,6 +318,42 @@ def probe_device(timeout_s: float = 120.0, attempts: int = 3) -> bool:
     return False
 
 
+_watchdog_cancel = None
+
+
+def _arm_watchdog(deadline_s: float = 2100.0):
+    """The tunnel can wedge MID-bench (after a healthy probe): a daemon
+    watchdog prints the degraded JSON line and hard-exits rather than
+    hanging the driver forever.  Normal full runs finish in ~12-18 min;
+    the deadline leaves slack.  Returns a cancel() callable; re-arming
+    (the retry path) cancels the previous timer first."""
+    import threading
+    global _watchdog_cancel
+    if _watchdog_cancel is not None:
+        _watchdog_cancel()
+
+    def fire():
+        _stage(f"WATCHDOG: bench exceeded {deadline_s}s — device presumed "
+               "wedged mid-run; emitting degraded report")
+        print(json.dumps({
+            "metric": "ed25519_batch_verify_throughput",
+            "value": 0.0,
+            "unit": "sigs/s",
+            "vs_baseline": 0.0,
+            "extra": {"accel_unavailable": True,
+                      "detail": f"bench watchdog fired after {deadline_s}s "
+                                "(tunnel wedged mid-run); see BASELINE.md "
+                                "for the last good run"},
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+    _watchdog_cancel = t.cancel
+    return t.cancel
+
+
 def main():
     from stellar_core_tpu.testutils import network_id
 
@@ -339,6 +375,8 @@ def main():
                                 "see BASELINE.md for the last good run"},
         }))
         return
+
+    cancel_watchdog = _arm_watchdog()
 
     _stage("sig bench...")
     tpu_sig_rate, cpu_sig_rate = bench_sigs()
@@ -384,6 +422,7 @@ def main():
             "replay_phases": phases,
         },
     }))
+    cancel_watchdog()
 
 
 if __name__ == "__main__":
