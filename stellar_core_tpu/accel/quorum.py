@@ -117,6 +117,27 @@ def _contract_fixpoint(S, top_thr, top_masks, inner_thr, inner_masks):
     return out
 
 
+def _child_flags(children, remaining, top_thr, top_masks, inner_thr,
+                 inner_masks):
+    """Shared prune predicate: (dead [B], is_q [B]) for candidate
+    committed-masks `children` against the depth's remaining-mask."""
+    perimeter = children | remaining[None, :]
+    mq = _contract_fixpoint(perimeter, top_thr, top_masks, inner_thr,
+                            inner_masks)
+    # prune: committed not inside the max quorum of its perimeter
+    dead = jnp.any(children & ~mq, axis=-1) | ~jnp.any(mq, axis=-1)
+    # committed IS a quorum iff every member's slice is satisfied
+    # within committed — a single _satisfied pass, no fixpoint (the
+    # fixpoint is only needed to find the GREATEST quorum inside a
+    # non-quorum set)
+    n_words = children.shape[-1]
+    sat = _pack_bits(_satisfied(children, top_thr, top_masks, inner_thr,
+                                inner_masks), n_words)
+    nonzero = jnp.any(children, axis=-1)
+    is_q = nonzero & ~jnp.any(children & ~sat, axis=-1)
+    return dead, is_q
+
+
 @partial(jax.jit, static_argnames=("mesh_size",))
 def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
                 inner_masks, mesh_size=None):
@@ -127,25 +148,97 @@ def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
     Returns (alive [B] bool — survives pruning and is not itself a quorum,
              is_quorum [B] bool — contract(committed)==committed != 0).
     """
-    def step(children):
-        perimeter = children | remaining[None, :]
-        mq = _contract_fixpoint(perimeter, top_thr, top_masks, inner_thr,
-                                inner_masks)
-        # prune: committed not inside the max quorum of its perimeter
-        dead = jnp.any(children & ~mq, axis=-1) | ~jnp.any(mq, axis=-1)
-        # committed IS a quorum iff every member's slice is satisfied
-        # within committed — a single _satisfied pass, no fixpoint (the
-        # fixpoint is only needed to find the GREATEST quorum inside a
-        # non-quorum set)
-        n_words = children.shape[-1]
-        sat = _pack_bits(_satisfied(children, top_thr, top_masks, inner_thr,
-                                    inner_masks), n_words)
-        nonzero = jnp.any(children, axis=-1)
-        is_q = nonzero & ~jnp.any(children & ~sat, axis=-1)
-        alive = ~dead & ~is_q
-        return alive, is_q
+    dead, is_q = _child_flags(children, remaining, top_thr, top_masks,
+                              inner_thr, inner_masks)
+    return ~dead & ~is_q, is_q
 
-    return step(children)
+
+# Depths fused per device dispatch on the resident-frontier path.  Fixed
+# (inactive tail depths pass through via lax.cond) so the only compiled-
+# shape axis is the frontier capacity bucket — one compile costs 20-40s on
+# this backend, so the shape space must stay tiny (PROFILE.md round 3).
+SEG_DEPTHS = 4
+# Per-depth capacity of the found-quorum output buffer.  Quorum hits are
+# rare events handled by the CPU oracle; a depth that finds more than this
+# many falls back to the host-chunked path (counted, correct, slower).
+QROWS_CAP = 256
+
+
+@jax.jit
+def _segment_step(frontier, count, bits_seq, rems_seq, active_seq, top_thr,
+                  top_masks, inner_thr, inner_masks):
+    """SEG_DEPTHS frontier depths in ONE dispatch, frontier resident on
+    device (VERDICT r3 weak #4: the old path round-tripped every batch
+    host<->device once per chunk per depth on a ~0.3 s/dispatch tunnel).
+
+    frontier [capacity, W] uint32 (rows >= count are padding);
+    count      int32 — live frontier rows;
+    bits_seq   [SEG_DEPTHS, W] — the split bit of each depth;
+    rems_seq   [SEG_DEPTHS, W] — remaining-mask BELOW each depth;
+    active_seq [SEG_DEPTHS] bool — False = padding depth (pass-through).
+
+    Returns (frontier', meta [SEG_DEPTHS+2] int32, q_rows [SEG_DEPTHS,
+    QROWS_CAP, W]) where meta = per-depth quorum counts ++ [count',
+    ovf_depth] — ONE packed array so the host's segment sync is a single
+    device->host transfer (each materialization is its own ~0.3 s RPC on
+    the tunneled backend).  ovf_depth is the first depth index whose
+    compacted frontier exceeded capacity (or whose quorum hits exceeded
+    QROWS_CAP), -1 if none; state stops advancing at the overflow depth so
+    the host can finish that depth with the chunked fallback path.
+    """
+    C = frontier.shape[0]
+    W = frontier.shape[1]
+
+    def depth(carry, xs):
+        fr, cnt, ovf, didx = carry
+        bit, rem, is_active = xs
+
+        def run(args):
+            fr, cnt = args
+            children = jnp.concatenate([fr, fr | bit[None, :]])   # [2C, W]
+            valid = jnp.concatenate([jnp.arange(C) < cnt,
+                                     jnp.arange(C) < cnt])
+            dead, is_q = _child_flags(children, rem, top_thr, top_masks,
+                                      inner_thr, inner_masks)
+            alive = ~dead & ~is_q & valid
+            is_q = is_q & valid
+            # device-side compaction: stable argsort moves alive rows to
+            # the front in order (exclude-branch children first, matching
+            # the host path's concatenation order)
+            order = jnp.argsort(~alive)
+            new_fr = children[order][:C]
+            new_cnt = jnp.sum(alive).astype(jnp.int32)
+            q_order = jnp.argsort(~is_q)
+            q_rows = children[q_order][:QROWS_CAP]
+            if q_rows.shape[0] < QROWS_CAP:   # static: 2C < QROWS_CAP
+                q_rows = jnp.pad(q_rows,
+                                 ((0, QROWS_CAP - q_rows.shape[0]), (0, 0)))
+            q_cnt = jnp.sum(is_q).astype(jnp.int32)
+            did_ovf = (new_cnt > C) | (q_cnt > QROWS_CAP)
+            return new_fr, new_cnt, q_rows, q_cnt, did_ovf
+
+        def skip(args):
+            fr, cnt = args
+            return (fr, cnt, jnp.zeros((QROWS_CAP, W), jnp.uint32),
+                    jnp.int32(0), jnp.bool_(False))
+
+        live = is_active & (ovf < 0)
+        new_fr, new_cnt, q_rows, q_cnt, did_ovf = jax.lax.cond(
+            live, run, skip, (fr, cnt))
+        # overflow: freeze the PRE-step state for the host to resume from
+        new_fr = jnp.where(did_ovf, fr, new_fr)
+        new_cnt = jnp.where(did_ovf, cnt, new_cnt)
+        q_rows = jnp.where(did_ovf, jnp.zeros_like(q_rows), q_rows)
+        q_cnt = jnp.where(did_ovf, 0, q_cnt)
+        new_ovf = jnp.where((ovf < 0) & did_ovf, didx, ovf)
+        return ((new_fr, new_cnt, new_ovf, didx + 1),
+                (q_rows, q_cnt))
+
+    (fr, cnt, ovf, _), (q_rows, q_counts) = jax.lax.scan(
+        depth, (frontier, count, jnp.int32(-1), jnp.int32(0)),
+        (bits_seq, rems_seq, active_seq))
+    meta = jnp.concatenate([q_counts, jnp.stack([cnt, ovf])])
+    return fr, meta, q_rows
 
 
 class TPUQuorumIntersectionChecker:
@@ -271,35 +364,161 @@ class TPUQuorumIntersectionChecker:
         for d in range(len(order) - 1, -1, -1):
             depth_remaining[d] = depth_remaining[d + 1] | (1 << order[d])
 
-        max_q = 0
-        frontier = np.zeros((1, self.n_words), dtype=np.uint32)  # committed=0
-        for d in range(len(order)):
-            if len(frontier) == 0:
-                break
-            bit_words = _masks_to_words([1 << order[d]], self.n_words)[0]
-            # children: exclude-branch keeps committed, include-branch adds
-            # the split bit; both advance to depth d+1
-            children = np.concatenate([frontier, frontier | bit_words])
-            rem_words = _masks_to_words([depth_remaining[d + 1]],
-                                        self.n_words)[0]
-            alive, is_q = self._prune(children, rem_words)
-            # rare path: exact minimality + disjoint-complement on CPU
-            for idx in np.nonzero(is_q)[0]:
-                committed = _words_to_mask(children[idx])
-                max_q += 1
-                if oracle.is_minimal_quorum(committed):
-                    disjoint = oracle.contract_to_max_quorum(scc & ~committed)
-                    if disjoint:
-                        return QuorumIntersectionResult(
-                            False,
-                            split=(oracle._names(committed),
-                                   oracle._names(disjoint)),
-                            node_count=n, main_scc_size=scc.bit_count(),
-                            max_quorums_found=max_q)
-            frontier = children[alive]
+        D = len(order)
+        bits_all = np.stack([_masks_to_words([1 << order[d]], self.n_words)[0]
+                             for d in range(D)])
+        rems_all = np.stack(
+            [_masks_to_words([depth_remaining[d + 1]], self.n_words)[0]
+             for d in range(D)])
+
+        self._quorum_hits = 0
+
+        def process_quorum(words) -> Optional[QuorumIntersectionResult]:
+            """Rare path: exact minimality + disjoint-complement on CPU."""
+            committed = _words_to_mask(words)
+            self._quorum_hits += 1
+            if oracle.is_minimal_quorum(committed):
+                disjoint = oracle.contract_to_max_quorum(scc & ~committed)
+                if disjoint:
+                    return QuorumIntersectionResult(
+                        False,
+                        split=(oracle._names(committed),
+                               oracle._names(disjoint)),
+                        node_count=n, main_scc_size=scc.bit_count(),
+                        max_quorums_found=self._quorum_hits)
+            return None
+
+        if self.mesh is None:
+            res = self._run_resident(bits_all, rems_all, process_quorum)
+        else:
+            # the sharded multi-chip path keeps the per-depth chunked step
+            # (device-side argsort compaction is shard-local under
+            # shard_map; cross-shard compaction would need a gather that
+            # defeats the residency win)
+            res = self._run_chunked(bits_all, rems_all, process_quorum)
+        if res is not None:
+            return res
         return QuorumIntersectionResult(
             True, node_count=n, main_scc_size=scc.bit_count(),
-            max_quorums_found=max_q)
+            max_quorums_found=self._quorum_hits)
+
+    def _run_chunked(self, bits_all, rems_all, process_quorum
+                     ) -> Optional[QuorumIntersectionResult]:
+        """Per-depth host-chunked frontier walk (the round-3 path; still
+        used under a mesh and as the overflow fallback)."""
+        frontier = np.zeros((1, self.n_words), dtype=np.uint32)  # committed=0
+        for d in range(len(bits_all)):
+            if len(frontier) == 0:
+                break
+            frontier, res = self._chunked_depth(frontier, bits_all[d],
+                                                rems_all[d], process_quorum)
+            if res is not None:
+                return res
+        return None
+
+    def _chunked_depth(self, frontier, bit_words, rem_words, process_quorum):
+        """Expand + prune ONE depth on the host-chunked path; returns
+        (new_frontier, early_result_or_None)."""
+        children = np.concatenate([frontier, frontier | bit_words])
+        alive, is_q = self._prune(children, rem_words)
+        for idx in np.nonzero(is_q)[0]:
+            res = process_quorum(children[idx])
+            if res is not None:
+                return children[alive], res
+        return children[alive], None
+
+    # capacity buckets for the device-resident frontier: pow4-spaced —
+    # coarse enough that jit compiles stay few (one compile per bucket
+    # costs 20-40s on this backend), fine enough that padded rows stay
+    # within ~4x of the worst-case segment need
+    CAPACITY_BUCKETS = (1024, 4096, 16384, 65536)
+
+    def _run_resident(self, bits_all, rems_all, process_quorum
+                      ) -> Optional[QuorumIntersectionResult]:
+        """Device-resident frontier walk: SEG_DEPTHS depths per dispatch,
+        compaction on device; per segment the host syncs scalars, the rare
+        found-quorum rows, and the frontier array only when the capacity
+        bucket changes (VERDICT r3 weak #4: the old path shipped every
+        chunk host<->device once per depth)."""
+        D = len(bits_all)
+        fr_host = np.zeros((1, self.n_words), dtype=np.uint32)
+        fr_dev = None        # device-resident [cur_cap, W] when not None
+        cur_cap = None
+        count = 1
+        d = 0
+
+        def to_host(n):
+            return (np.asarray(fr_dev)[:n] if fr_dev is not None
+                    else fr_host[:n])
+
+        while d < D and count > 0:
+            if self.interrupt():
+                raise InterruptedError_()
+            # worst case the frontier doubles every depth of the segment;
+            # bucket >= need means in-segment overflow is only possible at
+            # the largest bucket
+            need = count << SEG_DEPTHS
+            cap = next((c for c in self.CAPACITY_BUCKETS if c >= need),
+                       self.CAPACITY_BUCKETS[-1])
+            if count * 2 > cap:
+                # frontier too wide even for the largest bucket: finish
+                # this depth host-chunked, then retry residency
+                fr_host, res = self._chunked_depth(
+                    to_host(count), bits_all[d], rems_all[d],
+                    process_quorum)
+                fr_dev = None
+                if res is not None:
+                    return res
+                count = len(fr_host)
+                d += 1
+                continue
+            k = min(SEG_DEPTHS, D - d)
+            bits = np.zeros((SEG_DEPTHS, self.n_words), dtype=np.uint32)
+            rems = np.zeros((SEG_DEPTHS, self.n_words), dtype=np.uint32)
+            active = np.zeros(SEG_DEPTHS, dtype=bool)
+            bits[:k] = bits_all[d:d + k]
+            rems[:k] = rems_all[d:d + k]
+            active[:k] = True
+            if fr_dev is None or cur_cap != cap:
+                pad = np.zeros((cap, self.n_words), dtype=np.uint32)
+                pad[:count] = to_host(count)
+                fr_in = jnp.asarray(pad)
+            else:
+                fr_in = fr_dev   # already device-resident at this capacity
+            fr, meta, q_rows = _segment_step(
+                fr_in, jnp.int32(count), jnp.asarray(bits),
+                jnp.asarray(rems), jnp.asarray(active), self.top_thr,
+                self.top_masks, self.inner_thr, self.inner_masks)
+            # ONE sync per segment: the packed meta array carries the
+            # per-depth quorum counts + count' + ovf in a single transfer
+            # (materialization is what executes on this lazy backend)
+            meta = np.asarray(meta)
+            q_counts = meta[:SEG_DEPTHS]
+            count = int(meta[SEG_DEPTHS])
+            ovf = int(meta[SEG_DEPTHS + 1])
+            fr_dev, cur_cap = fr, cap
+            done_depths = k if ovf < 0 else min(ovf, k)
+            if q_counts[:done_depths].any():
+                rows = np.asarray(q_rows)
+                for j in range(done_depths):
+                    for r in range(int(q_counts[j])):
+                        res = process_quorum(rows[j, r])
+                        if res is not None:
+                            return res
+            if ovf >= 0:
+                # the overflow depth never ran: state froze at its input —
+                # finish that depth host-chunked and continue
+                fr_host, res = self._chunked_depth(
+                    to_host(count), bits_all[d + ovf], rems_all[d + ovf],
+                    process_quorum)
+                fr_dev = None
+                if res is not None:
+                    return res
+                count = len(fr_host)
+                d += ovf + 1
+            else:
+                d += k
+        return None
 
 
 def check_intersection_tpu(qmap, interrupt=None, mesh=None,

@@ -234,3 +234,33 @@ def for_all_versions(network_id: bytes, body, versions=None) -> None:
         mgr = LedgerManager(network_id)
         mgr.start_new_ledger(protocol_version=version)
         body(mgr, version)
+
+
+# --- quorum map generators (shared by bench.py config 5 and the accel
+# quorum differential tests — one definition so the bench and the tests
+# always exercise the same contraction-proof family)
+
+def asym_org_qmap(n_orgs: int):
+    """Config 5's exponential class: org sizes cycle 3/4/5 (majority inner
+    thresholds) and each org's nodes carry a byte-distinct qset (org list
+    rotated per org), so the symmetric-org contraction cannot apply and the
+    exact checker must enumerate."""
+    sizes = [3 + (i % 3) for i in range(n_orgs)]
+    orgs = []
+    for o, sz in enumerate(sizes):
+        orgs.append([bytes([o + 1]) * 31 + bytes([v]) for v in range(sz)])
+
+    def inner(o):
+        return X.SCPQuorumSet(
+            threshold=sizes[o] // 2 + 1,
+            validators=[X.NodeID.ed25519(m) for m in orgs[o]],
+            innerSets=[])
+
+    qmap = {}
+    thr = (2 * n_orgs + 2) // 3
+    for o in range(n_orgs):
+        rotated = [inner((o + j) % n_orgs) for j in range(n_orgs)]
+        q = X.SCPQuorumSet(threshold=thr, validators=[], innerSets=rotated)
+        for m in orgs[o]:
+            qmap[m] = q
+    return qmap

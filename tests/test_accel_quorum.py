@@ -114,6 +114,48 @@ class TestMeshSharded:
         assert not res.intersects
 
 
+# contraction-proof exponential family — ONE definition shared with
+# bench.py config 5 (stellar_core_tpu.testutils.asym_org_qmap)
+from stellar_core_tpu.testutils import asym_org_qmap
+
+
+class TestResidentFrontier:
+    """The device-resident segmented path (SEG_DEPTHS per dispatch,
+    on-device compaction, overflow ladders) vs the CPU oracle."""
+
+    def test_asym_org_maps_match_oracle(self):
+        for n_orgs in (3, 4):
+            qmap = asym_org_qmap(n_orgs)
+            cpu = check_intersection(qmap)
+            tpu = check_intersection_tpu(qmap)
+            assert cpu.intersects == tpu.intersects, n_orgs
+
+    def test_tiny_buckets_force_overflow_ladders(self, monkeypatch):
+        """Capacity buckets far below the real frontier exercise BOTH
+        fallbacks: count*2 > top bucket (host-chunked depth before the
+        segment) and in-segment overflow (freeze + host-chunked resume).
+        Verdict must stay oracle-identical either way."""
+        monkeypatch.setattr(TPUQuorumIntersectionChecker,
+                            "CAPACITY_BUCKETS", (8, 16))
+        for qmap in (org_qmap(5, 3, 3, 2),      # intersects
+                     org_qmap(4, 3, 2, 2),      # splits
+                     asym_org_qmap(4)):
+            cpu = check_intersection(qmap)
+            tpu = check_intersection_tpu(qmap)
+            assert cpu.intersects == tpu.intersects
+
+    def test_split_found_inside_segment(self, monkeypatch):
+        """A split whose witness quorum is found mid-segment must surface
+        through the q_rows buffer (not just via the chunked path)."""
+        monkeypatch.setattr(TPUQuorumIntersectionChecker,
+                            "CAPACITY_BUCKETS", (4096,))
+        qmap = org_qmap(4, 3, 2, 2)
+        tpu = check_intersection_tpu(qmap)
+        assert not tpu.intersects
+        a, b = tpu.split
+        assert set(a) & set(b) == set()
+
+
 class TestBigMap:
     def test_tier1_shape_21_nodes(self):
         # 7 orgs x 3 validators, 5-of-7 top: the pubnet tier-1 shape
