@@ -168,25 +168,27 @@ class PreverifyPipeline:
         # fall back immediately instead of waiting out a timeout per group
         return box, ev, self._jobs
 
-    def dispatch(self, entries_by_checkpoint: Dict[int, Sequence],
+    def dispatch(self, frames_by_checkpoint: Dict[int, Sequence[TransactionFrame]],
                  ledger_state=None) -> None:
         """Pair + enqueue one device batch covering every checkpoint in
-        `entries_by_checkpoint` (ascending order).  No device sync."""
+        `frames_by_checkpoint` (ascending order).  No device sync.
+
+        Takes DECODED frames — the same objects the apply will execute
+        (decoded once at download, content_hash memoized per frame), so the
+        accel pass never re-decodes the replay stream (VERDICT r3 weak #2)."""
         if self._disabled:
             # device presumed dead: pure CPU verification.  Still count
             # the signatures so offload_hit_rate() honestly reflects the
-            # un-offloaded remainder instead of freezing at ~1.0 (every
-            # envelope arm exposes .signatures — no frame construction),
-            # and register a no-op collected group so the apply path sees
+            # un-offloaded remainder instead of freezing at ~1.0, and
+            # register a no-op collected group so the apply path sees
             # dispatched()==True and does not re-dispatch/double-count.
             total = 0
-            for cp in entries_by_checkpoint:
-                for entry in entries_by_checkpoint[cp]:
-                    for env in entry.txSet.txs:
-                        total += len(env.value.signatures)
+            for cp in frames_by_checkpoint:
+                for frame in frames_by_checkpoint[cp]:
+                    total += len(frame.signatures)
             self.stats["sigs_total"] = \
                 self.stats.get("sigs_total", 0) + total
-            cps = sorted(entries_by_checkpoint)
+            cps = sorted(frames_by_checkpoint)
             group = {"job": None, "pks": [], "sigs": [], "msgs": [],
                      "checkpoints": cps, "collected": True}
             for cp in cps:
@@ -198,7 +200,7 @@ class PreverifyPipeline:
         from ..transactions.utils import account_key
 
         t0 = _time.perf_counter()
-        cps = sorted(entries_by_checkpoint)
+        cps = sorted(frames_by_checkpoint)
         signer_cache: Dict[bytes, List[bytes]] = {}
 
         def signers_of(acc_id_val: bytes) -> List[bytes]:
@@ -219,10 +221,7 @@ class PreverifyPipeline:
 
         frames: List[TransactionFrame] = []
         for cp in cps:
-            for entry in entries_by_checkpoint[cp]:
-                for env in entry.txSet.txs:
-                    frames.append(
-                        TransactionFrame.make_from_wire(self.network_id, env))
+            frames.extend(frames_by_checkpoint[cp])
         # harvest before pairing: a signer added late in the group still
         # pairs a tx earlier in it (superset candidates are harmless)
         harvested = self._harvested_hint
@@ -377,9 +376,11 @@ def preverify_checkpoint_signatures(network_id: bytes,
     """Synchronous single-checkpoint wrapper over PreverifyPipeline
     (dispatch + immediate collect) — kept for differential tests and
     callers outside the pipelined catchup DAG."""
+    frames = [TransactionFrame.make_from_wire(network_id, env)
+              for entry in tx_entries for env in entry.txSet.txs]
     pipe = PreverifyPipeline(network_id, chunk_size)
     try:
-        pipe.dispatch({0: list(tx_entries)}, ledger_state=ledger_state)
+        pipe.dispatch({0: frames}, ledger_state=ledger_state)
         pipe.collect(0)
     finally:
         pipe.close()
