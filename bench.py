@@ -24,6 +24,78 @@ def _stage(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Last-good result cache (VERDICT r3 weak #1): the shared tunnel has died
+# mid-session twice, erasing a whole round's perf record at driver time.
+# Every successful on-chip sub-result is persisted the moment it is
+# measured; a degraded run emits the cached numbers with their age and a
+# stale flag instead of bare zeros.
+# ---------------------------------------------------------------------------
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_CACHE.json")
+
+
+def _cache_load() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _cache_put(section: str, values: dict, source: str = "bench.py on-chip run"):
+    try:
+        cache = _cache_load()
+        cache[section] = {
+            "measured_at_unix": round(time.time(), 1),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "source": source,
+            "values": values,
+        }
+        tmp = CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=2)
+        os.replace(tmp, CACHE_PATH)
+        _stage(f"cached last-good '{section}' -> {CACHE_PATH}")
+    except OSError as e:   # a cache write must never fail a healthy bench
+        _stage(f"cache write failed (non-fatal): {e}")
+
+
+def _degraded_report(detail: str) -> dict:
+    """Build the one-line JSON for a run that could not (fully) measure on
+    chip: last-good cached numbers, each with its age, stale-flagged —
+    never bare zeros while evidence exists."""
+    cache = _cache_load()
+    now = time.time()
+    extra = {"accel_unavailable": True, "stale": True, "detail": detail}
+    value = 0.0
+    vs = 0.0
+    sig = cache.get("sigs")
+    if sig:
+        value = sig["values"].get("ed25519_tpu_sigs_per_sec", 0.0)
+        base = sig["values"].get("ed25519_libsodium_1core_sigs_per_sec", 0.0)
+        vs = round(value / base, 2) if base else 0.0
+    for section in ("sigs", "replay", "quorum"):
+        got = cache.get(section)
+        if not got:
+            continue
+        extra.update({(f"{section}_{k}" if k == "note" else k): v
+                      for k, v in got["values"].items()})
+        extra[f"{section}_measured_at"] = got["measured_at"]
+        extra[f"{section}_age_hours"] = round(
+            (now - got["measured_at_unix"]) / 3600.0, 1)
+        extra[f"{section}_source"] = got["source"]
+    if not any(cache.get(s) for s in ("sigs", "replay", "quorum")):
+        extra["detail"] += " (no BENCH_CACHE.json last-good entries exist)"
+    return {
+        "metric": "ed25519_batch_verify_throughput",
+        "value": value,
+        "unit": "sigs/s",
+        "vs_baseline": vs,
+        "extra": extra,
+    }
+
+
 def build_archive(nid, passphrase, path, n_payment_ledgers=110,
                   txs_per_ledger=40, multisig_every=4):
     """Synthetic pubnet-shaped history: account creation burst, then
@@ -230,31 +302,12 @@ def adversarial_quorum_map(n=16):
 
 
 def asym_org_map(n_orgs):
-    """Config #5's exponential class: org sizes cycle 3/4/5 (majority inner
-    thresholds) and each org's nodes carry a byte-distinct qset (org list
-    rotated per org), so the symmetric-org contraction cannot apply and the
-    exact checker must enumerate.  Measured growth per org: CPU ~58x, TPU
-    frontier ~13x (see BASELINE.md config 5 crossover table)."""
-    from stellar_core_tpu import xdr as X
-    sizes = [3 + (i % 3) for i in range(n_orgs)]
-    orgs = []
-    for o, sz in enumerate(sizes):
-        orgs.append([bytes([o + 1]) * 31 + bytes([v]) for v in range(sz)])
-
-    def inner(o):
-        return X.SCPQuorumSet(
-            threshold=sizes[o] // 2 + 1,
-            validators=[X.NodeID.ed25519(m) for m in orgs[o]],
-            innerSets=[])
-
-    qmap = {}
-    thr = (2 * n_orgs + 2) // 3
-    for o in range(n_orgs):
-        rotated = [inner((o + j) % n_orgs) for j in range(n_orgs)]
-        q = X.SCPQuorumSet(threshold=thr, validators=[], innerSets=rotated)
-        for m in orgs[o]:
-            qmap[m] = q
-    return qmap
+    """Config #5's exponential class (single definition shared with the
+    differential tests: stellar_core_tpu.testutils.asym_org_qmap).
+    Measured growth per org: CPU ~58x, TPU frontier ~13x (see BASELINE.md
+    config 5 crossover table)."""
+    from stellar_core_tpu.testutils import asym_org_qmap
+    return asym_org_qmap(n_orgs)
 
 
 def bench_quorum():
@@ -335,16 +388,10 @@ def _arm_watchdog(deadline_s: float = 2100.0):
     def fire():
         _stage(f"WATCHDOG: bench exceeded {deadline_s}s — device presumed "
                "wedged mid-run; emitting degraded report")
-        print(json.dumps({
-            "metric": "ed25519_batch_verify_throughput",
-            "value": 0.0,
-            "unit": "sigs/s",
-            "vs_baseline": 0.0,
-            "extra": {"accel_unavailable": True,
-                      "detail": f"bench watchdog fired after {deadline_s}s "
-                                "(tunnel wedged mid-run); see BASELINE.md "
-                                "for the last good run"},
-        }), flush=True)
+        print(json.dumps(_degraded_report(
+            f"bench watchdog fired after {deadline_s}s (tunnel wedged "
+            "mid-run); numbers below are the last good on-chip results, "
+            "stale-flagged with their age")), flush=True)
         os._exit(3)
 
     t = threading.Timer(deadline_s, fire)
@@ -361,25 +408,37 @@ def main():
     nid = network_id(passphrase)
 
     _stage("probing device health...")
-    if not probe_device():
-        # CPU-only degraded report: the accel metrics are unmeasurable
-        # with the tunnel down; say so rather than hang
-        _stage("DEVICE UNREACHABLE — emitting cpu-only degraded report")
-        print(json.dumps({
-            "metric": "ed25519_batch_verify_throughput",
-            "value": 0.0,
-            "unit": "sigs/s",
-            "vs_baseline": 0.0,
-            "extra": {"accel_unavailable": True,
-                      "detail": "TPU tunnel unreachable (probe timed out); "
-                                "see BASELINE.md for the last good run"},
-        }))
+    # the tunnel has come back mid-window after outages before: retry the
+    # probe a couple of times across the bench window before giving up
+    up = False
+    for round_ in range(2):
+        if probe_device():
+            up = True
+            break
+        if round_ == 0:
+            _stage("device unreachable — waiting 120s and re-probing once")
+            time.sleep(120)
+    if not up:
+        # degraded report: the accel metrics are unmeasurable with the
+        # tunnel down — emit the last good on-chip numbers, aged and
+        # stale-flagged, rather than zeros (VERDICT r3 weak #1)
+        _stage("DEVICE UNREACHABLE — emitting stale last-good report")
+        print(json.dumps(_degraded_report(
+            "TPU tunnel unreachable (probes timed out across the bench "
+            "window); numbers below are the last good on-chip results, "
+            "stale-flagged with their age")))
         return
 
     cancel_watchdog = _arm_watchdog()
 
     _stage("sig bench...")
     tpu_sig_rate, cpu_sig_rate = bench_sigs()
+    _cache_put("sigs", {
+        "ed25519_tpu_sigs_per_sec": round(tpu_sig_rate, 1),
+        "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
+        "ed25519_speedup_1chip_vs_1core":
+            round(tpu_sig_rate / cpu_sig_rate, 2),
+    })
 
     with tempfile.TemporaryDirectory() as d:
         _stage("building archive (~18 checkpoints)...")
@@ -393,10 +452,26 @@ def main():
         _stage("replay bench...")
         cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
             nid, passphrase, archive, mgr.lcl_hash)
+    _cache_put("replay", {
+        "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
+        "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
+        "replay_ledgers": n_ledgers,
+        "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
+        "replay_hashes_identical": True,
+        "sig_offload_hit_rate": round(hit_rate, 3),
+        "replay_phases": phases,
+    })
 
     _stage("quorum bench...")
     (t_cpu_tier1, t_cpu_adv, t_tpu_adv,
      t_cpu_asym, t_tpu_asym) = bench_quorum()
+    _cache_put("quorum", {
+        "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
+        "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
+        "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
+        "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
+        "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
+    })
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
