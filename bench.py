@@ -57,7 +57,9 @@ def _cache_put(section: str, values: dict, source: str = "bench.py on-chip run")
             json.dump(cache, f, indent=2)
         os.replace(tmp, CACHE_PATH)
         _stage(f"cached last-good '{section}' -> {CACHE_PATH}")
-    except OSError as e:   # a cache write must never fail a healthy bench
+    except (OSError, TypeError, ValueError) as e:
+        # a cache write must never fail a healthy bench (IO errors, or a
+        # non-JSON-serializable value sneaking into a stats dict)
         _stage(f"cache write failed (non-fatal): {e}")
 
 

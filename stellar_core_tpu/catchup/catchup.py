@@ -418,6 +418,14 @@ def plan_catchup_range(target: int, count: Optional[int]) -> CatchupRange:
     return CatchupRange(apply_buckets_at=boundary, replay_to=target)
 
 
+def _archive_state(archive: FileHistoryArchive, checkpoint=None):
+    """get_state with hostile-HAS errors localized to CatchupError."""
+    try:
+        return archive.get_state(checkpoint)
+    except (ValueError, OSError) as e:
+        raise CatchupError(f"malformed archive HAS: {e}") from e
+
+
 class CatchupManager:
     """Replay/assume-state driver (reference: CatchupManagerImpl +
     CatchupWork).  `accel=True` routes checkpoint signature verification
@@ -445,12 +453,16 @@ class CatchupManager:
     # -- archive readers ----------------------------------------------------
     def _read_headers(self, archive: FileHistoryArchive,
                       checkpoint: int) -> List[X.LedgerHeaderHistoryEntry]:
-        recs = archive.get_xdr_file(category_path(CATEGORY_LEDGER, checkpoint))
-        if recs is None:
-            raise CatchupError(f"missing ledger file for checkpoint {checkpoint}")
         try:
+            recs = archive.get_xdr_file(
+                category_path(CATEGORY_LEDGER, checkpoint))
+            if recs is None:
+                raise CatchupError(
+                    f"missing ledger file for checkpoint {checkpoint}")
             return [_LHHE.unpack(r) for r in recs]
-        except X.XdrError as e:
+        except (X.XdrError, ValueError, OSError) as e:
+            # hostile/corrupt stream: bad gzip, truncated record, inflate
+            # cap exceeded, XDR decode failure — one localized error class
             raise CatchupError(
                 f"corrupt ledger file at checkpoint {checkpoint}: {e}") from e
 
@@ -466,7 +478,7 @@ class CatchupManager:
         from ..historywork.works import CatchupWork
         from ..util.clock import ClockMode, VirtualClock
 
-        has = archive.get_state()
+        has = _archive_state(archive)
         if has is None:
             raise CatchupError("archive has no HAS")
         target = to_ledger if to_ledger is not None else has.current_ledger
@@ -518,7 +530,7 @@ class CatchupManager:
         replay, then replay the tail to the target (reference:
         CatchupWork over a CatchupRange with both bucket-apply and replay
         segments)."""
-        has = archive.get_state()
+        has = _archive_state(archive)
         if has is None:
             raise CatchupError("archive has no HAS")
         target = to_ledger if to_ledger is not None else has.current_ledger
@@ -541,7 +553,7 @@ class CatchupManager:
         bucket hash and the reassembled bucket-list hash against the
         header.  `checkpoint` targets a specific published boundary (the
         CatchupRange bucket-apply point); default = the archive tip."""
-        has = archive.get_state(checkpoint)
+        has = _archive_state(archive, checkpoint)
         if has is None:
             raise CatchupError(
                 "archive has no HAS" if checkpoint is None
@@ -572,7 +584,11 @@ class CatchupManager:
             hh = hashes[idx]
             if hh == empty:
                 return Bucket.empty()
-            b = archive.get_bucket(hh)
+            try:
+                b = archive.get_bucket(hh)
+            except (ValueError, OSError) as e:
+                # content-hash mismatch / hostile gzip: localized fail-stop
+                raise CatchupError(f"corrupt bucket {hh}: {e}") from e
             if b is None:
                 raise CatchupError(f"missing bucket {hh}")
             return b

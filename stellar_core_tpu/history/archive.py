@@ -155,24 +155,30 @@ class HistoryArchiveState:
 
     @staticmethod
     def from_json(text: str) -> "HistoryArchiveState":
-        d = json.loads(text)
-        levels = []
-        for b in d["currentBuckets"]:
-            nxt = b.get("next")
-            if nxt is not None and nxt.get("state", 0) == 0:
-                nxt = None
-            if nxt is not None:
-                for key in ("output", "curr", "snap"):
-                    if key in nxt and nxt[key] is not None:
-                        require_hex256(nxt[key])
-            levels.append({"curr": require_hex256(b["curr"]),
-                           "snap": require_hex256(b["snap"]),
-                           "next": nxt})
-        return HistoryArchiveState(
-            current_ledger=d["currentLedger"],
-            network_passphrase=d.get("networkPassphrase", ""),
-            level_hashes=levels,
-            server=d.get("server", ""))
+        """Parse an UNTRUSTED archive's HAS; every malformation (bad json,
+        missing keys, wrong types, invalid hashes) raises ValueError so
+        callers fail-stop with one localized error class."""
+        try:
+            d = json.loads(text)
+            levels = []
+            for b in d["currentBuckets"]:
+                nxt = b.get("next")
+                if nxt is not None and nxt.get("state", 0) == 0:
+                    nxt = None
+                if nxt is not None:
+                    for key in ("output", "curr", "snap"):
+                        if key in nxt and nxt[key] is not None:
+                            require_hex256(nxt[key])
+                levels.append({"curr": require_hex256(b["curr"]),
+                               "snap": require_hex256(b["snap"]),
+                               "next": nxt})
+            return HistoryArchiveState(
+                current_ledger=int(d["currentLedger"]),
+                network_passphrase=d.get("networkPassphrase", ""),
+                level_hashes=levels,
+                server=d.get("server", ""))
+        except (KeyError, TypeError, AttributeError) as e:
+            raise ValueError(f"malformed HAS json: {e!r}") from e
 
     def bucket_hashes(self) -> List[str]:
         """curr/snap hashes, 2 per level (positional: level*2 + {0,1})."""
@@ -201,18 +207,42 @@ class HistoryArchiveState:
         def load(hh: str) -> Bucket:
             if hh == "0" * 64:
                 return Bucket.empty()
-            b = bucket_source(hh)
+            try:
+                b = bucket_source(hh)
+            except (ValueError, OSError) as e:   # hash mismatch / hostile
+                raise RuntimeError(str(e)) from e   # gzip / file IO fault
             if b is None:
                 raise RuntimeError(f"missing bucket {hh}")
             return b
 
-        if nxt["state"] == 1:
-            return FutureBucket.from_output(load(nxt["output"]))
+        # the HAS comes from an untrusted archive: a `next` record that
+        # lies about its own shape (unknown state, missing/garbage fields)
+        # must fail-stop as a localized archive error, not a KeyError
+        try:
+            state = int(nxt["state"])
+            if state == 1:
+                spec = ("output",)
+            elif state == 2:
+                spec = ("curr", "snap", "keepTombstones", "outputProtocol")
+            else:
+                raise RuntimeError(
+                    f"HAS level {level} next has invalid state {state}")
+            fields = {k: nxt[k] for k in spec}
+            for k in spec:
+                if k in ("output", "curr", "snap"):
+                    require_hex256(fields[k])
+            if state == 2:
+                fields["outputProtocol"] = int(fields["outputProtocol"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise RuntimeError(
+                f"HAS level {level} next record malformed: {e!r}") from e
+        if state == 1:
+            return FutureBucket.from_output(load(fields["output"]))
         # state 2: re-run the merge from inputs (synchronously — restart
         # is not the hot path)
-        return FutureBucket(load(nxt["curr"]), load(nxt["snap"]),
-                            bool(nxt["keepTombstones"]),
-                            int(nxt["outputProtocol"]))
+        return FutureBucket(load(fields["curr"]), load(fields["snap"]),
+                            bool(fields["keepTombstones"]),
+                            fields["outputProtocol"])
 
     def all_bucket_hashes(self) -> List[str]:
         """Every referenced bucket incl. next outputs/inputs (what catchup
@@ -247,6 +277,39 @@ class HistoryArchiveBase:
     def exists(self, rel: str) -> bool:
         return self.get_bytes(rel) is not None
 
+    # Memory bound for one decompressed history object (checkpoint files
+    # are a few MB in practice; a hostile archive can serve a tiny .gz
+    # that inflates without limit — decompression is CAPPED so parsing
+    # stays memory-bound, reference fail-stop discipline SURVEY §5.3)
+    MAX_DECOMPRESSED_BYTES = 256 * 1024 * 1024
+
+    @classmethod
+    def _bounded_gunzip(cls, raw: bytes, what: str) -> bytes:
+        import zlib
+        try:
+            d = zlib.decompressobj(wbits=31)   # gzip container
+            out = d.decompress(raw, cls.MAX_DECOMPRESSED_BYTES)
+            if d.unconsumed_tail:
+                raise ValueError(
+                    f"{what} inflates past the "
+                    f"{cls.MAX_DECOMPRESSED_BYTES}-byte cap")
+            out += d.flush()
+            if len(out) > cls.MAX_DECOMPRESSED_BYTES:
+                raise ValueError(
+                    f"{what} inflates past the "
+                    f"{cls.MAX_DECOMPRESSED_BYTES}-byte cap")
+            if not d.eof:
+                # a stream cut at a deflate-block boundary decompresses
+                # without error but never reaches the gzip trailer (CRC) —
+                # gzip.decompress rejected this and so must we
+                raise ValueError(f"{what} is a truncated gzip stream")
+            if d.unused_data:
+                raise ValueError(f"{what} has trailing data after the "
+                                 "gzip stream")
+            return out
+        except zlib.error as e:
+            raise ValueError(f"{what} is not valid gzip data: {e}") from e
+
     # gzip'd XDR streams
     def put_xdr_file(self, rel: str, records: List[bytes]) -> None:
         self.put_bytes(rel, gzip.compress(pack_xdr_stream(records)))
@@ -255,7 +318,7 @@ class HistoryArchiveBase:
         raw = self.get_bytes(rel)
         if raw is None:
             return None
-        return list(unpack_xdr_stream(gzip.decompress(raw)))
+        return list(unpack_xdr_stream(self._bounded_gunzip(raw, rel)))
 
     # HAS
     def put_state(self, has: HistoryArchiveState) -> None:
@@ -284,7 +347,8 @@ class HistoryArchiveBase:
         raw = self.get_bytes(bucket_path(hash_hex))
         if raw is None:
             return None
-        b = Bucket.deserialize(gzip.decompress(raw))
+        b = Bucket.deserialize(
+            self._bounded_gunzip(raw, f"bucket {hash_hex}"))
         if b.hash().hex() != hash_hex:
             raise ValueError(f"bucket hash mismatch for {hash_hex}")
         return b

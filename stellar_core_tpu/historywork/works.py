@@ -82,12 +82,12 @@ class GetAndVerifyCheckpointWork(BasicWork):
         return out
 
     def on_run(self) -> State:
-        recs = self.archive.get_xdr_file(
-            category_path(CATEGORY_LEDGER, self.checkpoint))
-        if recs is None:
-            log.warning("%s: ledger file missing", self.name)
-            return State.FAILURE
         try:
+            recs = self.archive.get_xdr_file(
+                category_path(CATEGORY_LEDGER, self.checkpoint))
+            if recs is None:
+                log.warning("%s: ledger file missing", self.name)
+                return State.FAILURE
             headers = [_LHHE.unpack(r) for r in recs]
             verify_ledger_chain(headers)
             txs: Dict[int, X.TransactionHistoryEntry] = {}
@@ -101,7 +101,10 @@ class GetAndVerifyCheckpointWork(BasicWork):
                     frames[e.ledgerSeq] = [
                         TransactionFrame.make_from_wire(self.network_id, env)
                         for env in e.txSet.txs]
-        except (X.XdrError, CatchupError) as e:
+        except (X.XdrError, CatchupError, ValueError, OSError) as e:
+            # corrupt OR hostile archive data (bad gzip, truncated record
+            # mark/body, inflate-cap bomb, XDR decode failure): retry with
+            # backoff, then the catchup fails with a localized error
             log.warning("%s: %s", self.name, e)
             return State.FAILURE
         self.headers = headers
