@@ -41,15 +41,27 @@ class Bucket:
     """Immutable sorted bucket. entries EXCLUDE the meta entry; protocol
     version is carried separately and re-serialized as METAENTRY."""
 
-    __slots__ = ("entries", "protocol_version", "_hash", "_index", "_keys")
+    __slots__ = ("entries", "protocol_version", "_hash", "_index", "_keys",
+                 "_packed")
 
     def __init__(self, entries: List[BucketEntry], protocol_version: int,
-                 keys: Optional[List[bytes]] = None):
+                 keys: Optional[List[bytes]] = None,
+                 packed: Optional[List[Optional[bytes]]] = None):
         self.entries = entries
         self.protocol_version = protocol_version
         self._hash: Optional[bytes] = None
         self._index = None
         self._keys = keys  # cached sort keys, aligned with entries
+        # cached BucketEntry XDR bytes, aligned with entries (None holes
+        # fill lazily).  Entries are shared objects across a merge chain,
+        # so propagating the packed bytes through merges means each entry
+        # is packed ONCE per lifetime instead of once per bucket hash —
+        # bucket.hash() re-packs were the top pack call site in the replay
+        # profile (25k packs / 191 ledgers).  Memory: the ~150-300 B XDR
+        # slice per entry is a fraction of the decoded entry's Python
+        # object graph, and the bytes are SHARED across the merge chain
+        # (not one copy per bucket).
+        self._packed = packed
 
     def sort_keys(self) -> List[bytes]:
         """Per-entry sort keys, computed once per immutable bucket (the
@@ -58,6 +70,18 @@ class Bucket:
         if self._keys is None:
             self._keys = [entry_sort_key(e) for e in self.entries]
         return self._keys
+
+    def packed_entries(self) -> List[bytes]:
+        """Per-entry serialized XDR, computed once per entry lifetime
+        (propagated through merges; deserialize captures wire slices)."""
+        if self._packed is None:
+            self._packed = [_BE.pack(e) for e in self.entries]
+        else:
+            pk = self._packed
+            for i, p in enumerate(pk):
+                if p is None:
+                    pk[i] = _BE.pack(self.entries[i])
+        return self._packed
 
     def index(self):
         """The bucket's point-lookup index, built lazily once per immutable
@@ -90,32 +114,33 @@ class Bucket:
                 h = SHA256()
                 h.add(_BE.pack(BucketEntry.metaEntry(
                     BucketMetadata(ledgerVersion=self.protocol_version))))
-                for e in self.entries:
-                    h.add(_BE.pack(e))
+                for p in self.packed_entries():
+                    h.add(p)
                 self._hash = h.finish()
         return self._hash
 
     def serialize(self) -> bytes:
-        out = bytearray()
-        if self.entries:
-            out += _BE.pack(BucketEntry.metaEntry(
-                BucketMetadata(ledgerVersion=self.protocol_version)))
-            for e in self.entries:
-                out += _BE.pack(e)
-        return bytes(out)
+        if not self.entries:
+            return b""
+        meta = _BE.pack(BucketEntry.metaEntry(
+            BucketMetadata(ledgerVersion=self.protocol_version)))
+        return meta + b"".join(self.packed_entries())
 
     @staticmethod
     def deserialize(data: bytes) -> "Bucket":
         entries: List[BucketEntry] = []
+        packed: List[Optional[bytes]] = []
         off = 0
         protocol = 0
         while off < len(data):
+            start = off
             e, off = _BE.unpack_from_fast(data, off)
             if e.switch == BucketEntryType.METAENTRY:
                 protocol = e.value.ledgerVersion
             else:
                 entries.append(e)
-        return Bucket(entries, protocol)
+                packed.append(data[start:off])   # wire slice: free cache
+        return Bucket(entries, protocol, packed=packed)
 
     @staticmethod
     def fresh(protocol_version: int, init_entries: Iterable[LedgerEntry],
@@ -172,37 +197,54 @@ def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
         old.protocol_version, new.protocol_version)
     out: List[BucketEntry] = []
     out_keys: List[bytes] = []
+    out_packed: List[Optional[bytes]] = []
 
-    def emit(be: BucketEntry, key: bytes):
+    def emit(be: BucketEntry, key: bytes, pb: Optional[bytes] = None):
+        """pb: the entry's cached XDR bytes when it passes through
+        UNCHANGED from an input bucket (None for merge-constructed
+        entries — packed lazily if/when the output is hashed)."""
         if _is_dead(be):
             if keep_tombstones:
                 out.append(be)
                 out_keys.append(key)
+                out_packed.append(pb)
         elif _is_init(be) and not keep_tombstones:
             out.append(BucketEntry.liveEntry(be.value))
             out_keys.append(key)
+            out_packed.append(None)   # re-tagged: bytes differ
         else:
             out.append(be)
             out_keys.append(key)
+            out_packed.append(pb)
 
     i = j = 0
     o, n = old.entries, new.entries
     o_keys = old.sort_keys()
     n_keys = new.sort_keys()
+    o_pk = old._packed
+    n_pk = new._packed
+
+    def opb(i):
+        return o_pk[i] if o_pk is not None else None
+
+    def npb(j):
+        return n_pk[j] if n_pk is not None else None
+
     while i < len(o) or j < len(n):
         if j >= len(n):
-            emit(o[i], o_keys[i]); i += 1
+            emit(o[i], o_keys[i], opb(i)); i += 1
             continue
         if i >= len(o):
-            emit(n[j], n_keys[j]); j += 1
+            emit(n[j], n_keys[j], npb(j)); j += 1
             continue
         ko, kn = o_keys[i], n_keys[j]
         if ko < kn:
-            emit(o[i], ko); i += 1
+            emit(o[i], ko, opb(i)); i += 1
         elif kn < ko:
-            emit(n[j], kn); j += 1
+            emit(n[j], kn, npb(j)); j += 1
         else:
             oe, ne = o[i], n[j]
+            pb = npb(j)
             i += 1
             j += 1
             if _is_init(oe) and _is_live(ne):
@@ -212,5 +254,5 @@ def merge_buckets(old: Bucket, new: Bucket, keep_tombstones: bool = True,
             elif _is_dead(oe) and _is_init(ne):
                 emit(BucketEntry.liveEntry(ne.value), kn)
             else:
-                emit(ne, kn)
-    return Bucket(out, proto, keys=out_keys)
+                emit(ne, kn, pb)
+    return Bucket(out, proto, keys=out_keys, packed=out_packed)

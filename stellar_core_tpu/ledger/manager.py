@@ -187,7 +187,7 @@ class LedgerManager:
         import heapq
         by_src: dict = {}
         for f in frames:
-            by_src.setdefault(f.source_account_id().to_xdr(), []).append(f)
+            by_src.setdefault(f.source_account_id().value, []).append(f)
         for q in by_src.values():
             q.sort(key=lambda f: f.seq_num)
         heads = [(q[0].content_hash(), src) for src, q in by_src.items()]

@@ -24,7 +24,7 @@ from ..ledger.ledger_txn import LedgerTxn
 from .signature_checker import SignatureChecker
 from . import utils
 from .utils import (THRESHOLD_HIGH, THRESHOLD_LOW, THRESHOLD_MED,
-                    account_key, load_account)
+                    load_account)
 
 MAX_SEQ_NUM = 2 ** 63 - 1
 
@@ -160,7 +160,8 @@ class TransactionFrame:
             return C.txINSUFFICIENT_FEE
         if self.seq_num < 0 or self.seq_num > MAX_SEQ_NUM:
             return C.txBAD_SEQ
-        acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
+        acc_entry = ltx.get_entry(
+            X.account_key_xdr(self.source_account_id().value))
         if acc_entry is None:
             return C.txNO_ACCOUNT
         acc = acc_entry.data.value
@@ -320,11 +321,11 @@ class TransactionFrame:
     def _remove_used_one_time_signers(self, ltx: LedgerTxn) -> None:
         """Drop preauth-tx signers matching this tx's hash from every source
         account (reference: removeOneTimeSignerFromAllSourceAccounts)."""
-        ids = {self.source_account_id().to_xdr(): self.source_account_id()}
+        ids = {self.source_account_id().value: self.source_account_id()}
         for op in self.operations:
             if op.sourceAccount is not None:
                 a = X.muxed_to_account_id(op.sourceAccount)
-                ids[a.to_xdr()] = a
+                ids[a.value] = a
         for acc_id in ids.values():
             acc_e = load_account(ltx, acc_id)
             if acc_e is None:
@@ -427,7 +428,8 @@ class FeeBumpTransactionFrame(TransactionFrame):
             return _tx_result(fee, C.txNOT_SUPPORTED)
         if self.fee_bid < self.min_fee(header):
             return _tx_result(fee, C.txINSUFFICIENT_FEE)
-        acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
+        acc_entry = ltx.get_entry(
+            X.account_key_xdr(self.source_account_id().value))
         if acc_entry is None:
             return _tx_result(fee, C.txNO_ACCOUNT)
         checker = SignatureChecker(header.ledgerVersion, self.content_hash(),

@@ -23,7 +23,7 @@ from ..ledger.ledger_txn import LedgerTxn
 from . import utils
 from .signature_checker import SignatureChecker
 from .utils import (INT64_MAX, THRESHOLD_HIGH, THRESHOLD_LOW, THRESHOLD_MED,
-                    account_key, add_balance, add_num_entries,
+                    add_balance, add_num_entries,
                     add_trustline_balance, asset_to_trustline_asset,
                     asset_valid, cb_key, data_key, is_authorized,
                     is_authorized_to_maintain_liabilities, is_issuer,
@@ -67,7 +67,8 @@ class OperationFrame:
 
     def check_signatures(self, checker: SignatureChecker,
                          ltx: LedgerTxn) -> Optional[X.OperationResult]:
-        acc_entry = ltx.get_entry(account_key(self.source_account_id()).to_xdr())
+        acc_entry = ltx.get_entry(
+            X.account_key_xdr(self.source_account_id().value))
         if acc_entry is None:
             return X.OperationResult(ORC.opNO_ACCOUNT)
         from .frame import check_account_signature
