@@ -1,0 +1,103 @@
+"""A/B: can successive sig-kernel chunks overlap on the tunneled backend?
+
+VERDICT r3 item 3: verify_async enqueues all chunks then collects once;
+on this lazily-executing backend it is unknown whether materializing
+chunk k also advances chunk k+1's transfer/compute.  Three variants, all
+SINGLE-THREADED (concurrent tunnel calls wedge the client — rig hazard):
+
+  serial   : enqueue chunk k, materialize chunk k      (zero in flight)
+  window2  : enqueue k+1 BEFORE materializing k        (one extra in flight)
+  allfirst : enqueue every chunk, then materialize all (current verify_async)
+
+If the backend pipelines at all, window2/allfirst beat serial; if it
+executes strictly at materialization with no read-ahead, all three tie
+(the round-3 hypothesis).  Interleaved in-process rounds — cross-process
+A/B is useless on this drifting shared chip (PROFILE.md).
+
+Run ON THE REAL CHIP:  python experiments/ab_inflight_overlap.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_batch(n):
+    import random
+
+    from stellar_core_tpu.crypto import sodium
+    keys = [sodium.sign_seed_keypair(bytes([i]) * 32) for i in range(64)]
+    rng = random.Random(5)
+    pks, sigs, msgs = [], [], []
+    for i in range(n):
+        pk, sk = keys[i % 64]
+        msg = rng.randbytes(120)
+        pks.append(pk)
+        sigs.append(sodium.sign_detached(msg, sk))
+        msgs.append(msg)
+    return pks, sigs, msgs
+
+
+def main(chunk=8192, n_chunks=8, rounds=4):
+    from stellar_core_tpu.accel import ed25519 as E
+
+    n = chunk * n_chunks
+    print(f"building {n} signatures ({n_chunks} chunks of {chunk})...",
+          flush=True)
+    pks, sigs, msgs = build_batch(n)
+    v = E.Ed25519BatchVerifier(chunk_size=chunk, tail_floor=chunk,
+                               hot_threshold=1 << 62)
+    v.verify(pks[:chunk], sigs[:chunk], msgs[:chunk])   # compile warm
+
+    def chunks():
+        for k in range(n_chunks):
+            lo = k * chunk
+            yield pks[lo:lo + chunk], sigs[lo:lo + chunk], msgs[lo:lo + chunk]
+
+    def run_serial():
+        total = 0
+        for p, s, m in chunks():
+            total += int(v.verify_async(p, s, m)().sum())
+        return total
+
+    def run_window2():
+        total = 0
+        prev = None
+        for p, s, m in chunks():
+            cur = v.verify_async(p, s, m)      # enqueue k+1 ...
+            if prev is not None:
+                total += int(prev().sum())     # ... before materializing k
+            prev = cur
+        total += int(prev().sum())
+        return total
+
+    def run_allfirst():
+        collectors = [v.verify_async(p, s, m) for p, s, m in chunks()]
+        return sum(int(c().sum()) for c in collectors)
+
+    variants = [("serial", run_serial), ("window2", run_window2),
+                ("allfirst", run_allfirst)]
+    results = {name: [] for name, _ in variants}
+    for r in range(rounds):
+        for name, fn in variants:             # interleaved within a round
+            t0 = time.perf_counter()
+            total = fn()
+            dt = time.perf_counter() - t0
+            assert total == n, (name, total)
+            results[name].append(n / dt)
+            print(f"round {r+1} {name:9s}: {n/dt:,.0f} sigs/s", flush=True)
+
+    print(f"\n=== medians over {rounds} interleaved rounds ===")
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    base = med(results["serial"])
+    for name, _ in variants:
+        m = med(results[name])
+        print(f"{name:9s}: {m:,.0f} sigs/s  ({m/base - 1:+.1%} vs serial)")
+
+
+if __name__ == "__main__":
+    main()
