@@ -206,19 +206,24 @@ def bench_sigs():
         sigs.append(sig)
         msgs.append(msg)
 
-    t0 = time.perf_counter()
-    acc = 0
-    for i in range(n_base):
-        acc += sodium.verify_detached(sigs[i], msgs[i], pks[i])
-    base_rate = n_base / (time.perf_counter() - t0)
-
     v = Ed25519BatchVerifier(chunk_size=chunk)
     v.verify(pks[:chunk], sigs[:chunk], msgs[:chunk])  # compile + warm
-    t0 = time.perf_counter()
-    verdicts = v.verify(pks, sigs, msgs)
-    tpu_rate = n_total / (time.perf_counter() - t0)
-    assert int(verdicts.sum()) == n_total - n_bad
-    return tpu_rate, base_rate
+    # the shared chip drifts 20-66% minute to minute (r3: 58.3k sigs/s,
+    # r4 morning: 35.1k, same code) — interleave (cpu, tpu) x 3 and report
+    # medians so one bad minute doesn't become the round's record
+    base_rates, tpu_rates = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = 0
+        for i in range(n_base):
+            acc += sodium.verify_detached(sigs[i], msgs[i], pks[i])
+        base_rates.append(n_base / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        verdicts = v.verify(pks, sigs, msgs)
+        tpu_rates.append(n_total / (time.perf_counter() - t0))
+        assert int(verdicts.sum()) == n_total - n_bad
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    return med(tpu_rates), med(base_rates)
 
 
 def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
@@ -448,9 +453,12 @@ def main():
         # 1100 payment ledgers ≈ 1215 total ≈ 19 checkpoints keeps the
         # steady-state pipeline visible while fitting the driver budget
         # (VERDICT r2 weak #5: 127 ledgers was inside the drift noise).
-        archive, mgr = build_archive(nid, passphrase,
-                                     os.path.join(d, "archive"),
-                                     n_payment_ledgers=1100)
+        # BENCH_PAYMENT_LEDGERS overrides for offline full-scale runs
+        # (VERDICT r3 item 7: the 10k-ledger config-1/4 measurement).
+        archive, mgr = build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=int(os.environ.get(
+                "BENCH_PAYMENT_LEDGERS", "1100")))
         _stage("replay bench...")
         cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
             nid, passphrase, archive, mgr.lcl_hash)

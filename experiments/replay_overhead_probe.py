@@ -1,0 +1,139 @@
+"""Isolate the accel replay pipeline's HOST-side overhead from device
+speed (round-4: the fresh interleaved bench measured accel 0.881x CPU
+after the pack-cut sped CPU replay up — where do the accel pass's extra
+seconds actually go?).
+
+Method: run the full CatchupManager accel path, but monkeypatch
+`verify_batch_async` so the "device job" verifies with libsodium ON THE
+WORKER THREAD (ctypes releases the GIL, so the main thread's apply
+proceeds — an idealized infinitely-overlappable device with CPU-core
+throughput).  Compare, interleaved:
+
+  cpu     : accel=False                       (baseline)
+  fakedev : accel=True + libsodium worker     (pipeline overhead +
+                                               perfectly hidden verify)
+  seednop : like fakedev but seeding verdicts is skipped and collect
+            returns instantly (measures dispatch-prep + bookkeeping
+            alone; apply re-verifies on CPU, so NOT a correctness run —
+            hash still asserted since verdicts recompute identically)
+
+If the pipeline is sound, fakedev ≈ cpu − (libsodium verify share) and
+seednop ≈ cpu + dispatch_prep.  Gaps between theory and measurement are
+the host overhead to hunt.  Runs entirely on CPU JAX (no tunnel).
+"""
+
+import os
+import sys
+import time
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def fake_verify_batch_async(pks, sigs, msgs, **kw):
+    """Stand-in device job: verify on the calling (worker) thread with
+    libsodium; ctypes releases the GIL per call."""
+    from stellar_core_tpu.crypto import sodium
+
+    def collect():
+        out = np.zeros(len(pks), dtype=np.int32)
+        for i in range(len(pks)):
+            out[i] = sodium.verify_detached(sigs[i], msgs[i], pks[i])
+        return out
+    return collect
+
+
+def main(rounds=2, n_payment_ledgers=1100):
+    import bench
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.crypto import keys
+    from stellar_core_tpu.testutils import network_id
+    from stellar_core_tpu.accel import ed25519 as accel_ed
+
+    passphrase = "bench network"
+    nid = network_id(passphrase)
+
+    with tempfile.TemporaryDirectory() as d:
+        print(f"building archive ({n_payment_ledgers} payment ledgers)...",
+              flush=True)
+        t0 = time.perf_counter()
+        archive, mgr = bench.build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=n_payment_ledgers)
+        print(f"  built in {time.perf_counter()-t0:.1f}s", flush=True)
+        has = archive.get_state()
+        n_ledgers = has.current_ledger
+        expected = mgr.lcl_hash
+
+        real_async = accel_ed.verify_batch_async
+        results = {"cpu": [], "fakedev": []}
+        phase_snap = {}
+
+        for r in range(rounds):
+            # --- cpu baseline ---
+            keys.clear_verify_cache()
+            cm = CatchupManager(nid, passphrase, accel=False)
+            t0 = time.perf_counter()
+            m = cm.catchup_complete(archive)
+            dt = time.perf_counter() - t0
+            assert m.lcl_hash == expected
+            results["cpu"].append(n_ledgers / dt)
+            print(f"round {r+1} cpu    : {n_ledgers/dt:7.1f} l/s "
+                  f"({dt:.1f}s)", flush=True)
+
+            # --- fake-device accel ---
+            accel_ed.verify_batch_async = fake_verify_batch_async
+            try:
+                keys.clear_verify_cache()
+                cm = CatchupManager(nid, passphrase, accel=True,
+                                    accel_chunk=8192)
+                t0 = time.perf_counter()
+                m = cm.catchup_complete(archive)
+                dt = time.perf_counter() - t0
+                assert m.lcl_hash == expected, "fakedev replay diverged"
+                results["fakedev"].append(n_ledgers / dt)
+                phase_snap = dict(cm.stats)
+                print(f"round {r+1} fakedev: {n_ledgers/dt:7.1f} l/s "
+                      f"({dt:.1f}s)  hit={cm.offload_hit_rate():.3f}",
+                      flush=True)
+            finally:
+                accel_ed.verify_batch_async = real_async
+
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        cpu_r, fake_r = med(results["cpu"]), med(results["fakedev"])
+        t_cpu, t_fake = n_ledgers / cpu_r, n_ledgers / fake_r
+        sigs_total = phase_snap.get("sigs_total", 0)
+
+        # measure this host's libsodium rate for the theory line
+        from stellar_core_tpu.crypto import sodium
+        pk, sk = sodium.sign_seed_keypair(b"\x07" * 32)
+        msg = b"m" * 120
+        sig = sodium.sign_detached(msg, sk)
+        t0 = time.perf_counter()
+        for _ in range(3000):
+            sodium.verify_detached(sig, msg, pk)
+        libsodium_rate = 3000 / (time.perf_counter() - t0)
+        verify_share_s = sigs_total / libsodium_rate
+
+        print(f"\n=== medians over {rounds} interleaved rounds "
+              f"({n_ledgers} ledgers, {sigs_total} sigs) ===")
+        print(f"cpu      : {cpu_r:7.1f} l/s  ({t_cpu:.2f}s)")
+        print(f"fakedev  : {fake_r:7.1f} l/s  ({t_fake:.2f}s)")
+        print(f"libsodium: {libsodium_rate:,.0f} sigs/s "
+              f"=> verify share ~{verify_share_s:.2f}s of the cpu pass")
+        print(f"theory fakedev floor = cpu - verify = "
+              f"{t_cpu - verify_share_s:.2f}s "
+              f"({n_ledgers/(t_cpu-verify_share_s):.1f} l/s)")
+        print(f"pipeline host overhead = fakedev - floor = "
+              f"{t_fake - (t_cpu - verify_share_s):+.2f}s")
+        print(f"phases: dispatch_s={phase_snap.get('dispatch_s', 0):.3f} "
+              f"collect_wait_s={phase_snap.get('collect_wait_s', 0):.3f} "
+              f"groups={phase_snap.get('dispatch_groups', 0)} "
+              f"shipped={phase_snap.get('sigs_shipped', 0)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""A/B: per-key window tables on the replay pre-verify path.
+
+Round-3 disabled tables for replay ("install dispatches cost more than
+they save at replay batch sizes") — but the verifier and its installed
+tables persist across every dispatch group of a catchup, and the bench
+archive has only ~150 distinct signing keys, so the install cost is paid
+once while the ~2.5x fewer field mults repay it on all ~55k signatures.
+Re-test the r3 conclusion, interleaved on the real chip:
+
+  cpu      : accel=False
+  generic  : accel=True, hot_threshold=1<<62   (r3 default)
+  tables   : accel=True, hot_threshold=4       (tables after 4 sightings)
+
+Run ON THE REAL CHIP:  python experiments/replay_tables_ab.py [rounds]
+"""
+
+import os
+import sys
+import time
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(rounds=3, n_payment_ledgers=1100):
+    import bench
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.crypto import keys
+    from stellar_core_tpu.testutils import network_id
+
+    passphrase = "bench network"
+    nid = network_id(passphrase)
+
+    with tempfile.TemporaryDirectory() as d:
+        print(f"building archive ({n_payment_ledgers} payment ledgers)...",
+              flush=True)
+        archive, mgr = bench.build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=n_payment_ledgers)
+        has = archive.get_state()
+        n_ledgers = has.current_ledger
+        expected = mgr.lcl_hash
+
+        variants = [
+            ("cpu", dict(accel=False)),
+            ("generic", dict(accel=True, accel_chunk=8192)),
+            ("tables", dict(accel=True, accel_chunk=8192,
+                            accel_hot_threshold=4)),
+        ]
+
+        print("warm passes (compiles both accel paths)...", flush=True)
+        for name, kw in variants[1:]:
+            keys.clear_verify_cache()
+            CatchupManager(nid, passphrase, **kw).catchup_complete(
+                archive, to_ledger=127)
+
+        results = {name: [] for name, _ in variants}
+        stats_snap = {}
+        for r in range(rounds):
+            for name, kw in variants:
+                keys.clear_verify_cache()
+                cm = CatchupManager(nid, passphrase, **kw)
+                t0 = time.perf_counter()
+                m = cm.catchup_complete(archive)
+                dt = time.perf_counter() - t0
+                assert m.lcl_hash == expected, name
+                results[name].append(n_ledgers / dt)
+                if name != "cpu":
+                    stats_snap[name] = dict(cm.stats)
+                print(f"round {r+1} {name:8s}: {n_ledgers/dt:7.1f} l/s "
+                      f"({dt:.1f}s)", flush=True)
+
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        base = med(results["cpu"])
+        print(f"\n=== medians over {rounds} interleaved rounds "
+              f"({n_ledgers} ledgers) ===")
+        for name, _ in variants:
+            m = med(results[name])
+            print(f"{name:8s}: {m:7.1f} l/s  ({m/base:5.3f}x vs cpu)")
+        for name, st in stats_snap.items():
+            print(f"{name} phases: "
+                  f"dispatch_s={st.get('dispatch_s', 0):.3f} "
+                  f"collect_wait_s={st.get('collect_wait_s', 0):.3f} "
+                  f"groups={st.get('dispatch_groups', 0)} "
+                  f"shipped={st.get('sigs_shipped', 0)}"
+                  f"/{st.get('sigs_total', 0)}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 3)

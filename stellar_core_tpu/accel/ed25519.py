@@ -310,16 +310,25 @@ class Ed25519BatchVerifier:
         assert len(sigs) == n and len(msgs) == n
 
         # -- vectorized encoding checks ---------------------------------
+        # one join+frombuffer per matrix, not one frombuffer per signature:
+        # per-sig numpy calls were ~8 us/sig of host prep, a real cost on
+        # the 1-core bench host where prep competes with the apply thread
         ok = np.ones(n, dtype=bool)
-        sig_mat = np.zeros((n, 64), dtype=np.uint8)
-        pk_mat = np.zeros((n, 32), dtype=np.uint8)
-        for i in range(n):
-            s, p = sigs[i], pks[i]
-            if len(s) == 64 and len(p) == 32:
-                sig_mat[i] = np.frombuffer(bytes(s), dtype=np.uint8)
-                pk_mat[i] = np.frombuffer(bytes(p), dtype=np.uint8)
-            else:
-                ok[i] = False
+        if all(len(s) == 64 for s in sigs) and all(len(p) == 32 for p in pks):
+            sig_mat = np.frombuffer(b"".join(sigs), dtype=np.uint8) \
+                .reshape(n, 64).copy()
+            pk_mat = np.frombuffer(b"".join(pks), dtype=np.uint8) \
+                .reshape(n, 32).copy()
+        else:
+            sig_mat = np.zeros((n, 64), dtype=np.uint8)
+            pk_mat = np.zeros((n, 32), dtype=np.uint8)
+            for i in range(n):
+                s, p = sigs[i], pks[i]
+                if len(s) == 64 and len(p) == 32:
+                    sig_mat[i] = np.frombuffer(bytes(s), dtype=np.uint8)
+                    pk_mat[i] = np.frombuffer(bytes(p), dtype=np.uint8)
+                else:
+                    ok[i] = False
         ok &= _lt_vec(sig_mat[:, 32:], _L_BYTES)            # S canonical
         ok &= ~_small_order_vec(sig_mat[:, :32])            # R not small order
         pk_no_sign = pk_mat.copy()
@@ -328,7 +337,11 @@ class Ed25519BatchVerifier:
         ok &= ~_small_order_vec(pk_mat)                     # pk not small order
 
         # -- per-element: pk decompress (cached) + challenge hash --------
-        h_raw = np.zeros((n, 32), dtype=np.uint8)
+        # h rows are accumulated as bytes and materialized with ONE
+        # join+frombuffer at the end (same 1-core prep-cost rationale as
+        # the sig/pk matrices above)
+        _zero32 = b"\x00" * 32
+        h_rows = [_zero32] * n
         decoded = [None] * n       # per-sig (cx, cy, ct) limbs of -A
         cache = self._pk_cache
         counts = self._use_counts
@@ -350,7 +363,8 @@ class Ed25519BatchVerifier:
             sig = bytes(sigs[i])
             h = int.from_bytes(sha512(sig[:32] + pk + bytes(msgs[i])).digest(),
                                "little") % L
-            h_raw[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+            h_rows[i] = h.to_bytes(32, "little")
+        h_raw = np.frombuffer(b"".join(h_rows), dtype=np.uint8).reshape(n, 32)
         self.stats["rejected_prep"] += int(n - ok.sum())
 
         # -- hot/cold key split -----------------------------------------

@@ -117,10 +117,22 @@ def _contract_fixpoint(S, top_thr, top_masks, inner_thr, inner_masks):
     return out
 
 
-def _child_flags(children, remaining, top_thr, top_masks, inner_thr,
-                 inner_masks):
-    """Shared prune predicate: (dead [B], is_q [B]) for candidate
-    committed-masks `children` against the depth's remaining-mask."""
+def _child_flags(children, remaining, scc_words, top_thr, top_masks,
+                 inner_thr, inner_masks):
+    """Shared prune predicate: (dead [B], is_q [B], witness [B]) for
+    candidate committed-masks `children` against the depth's
+    remaining-mask.
+
+    witness[b] means children[b] is a quorum AND its complement within the
+    scc contains a quorum — a concrete split, found ON DEVICE.  Minimality
+    of the hit is NOT checked: it only gates which hit finds a given split
+    first (any split is witnessed by one of its side's minimal quorums,
+    which this enumeration reaches on its own branch; a non-minimal hit's
+    complement check can only surface another REAL split, never a false
+    one, because both sides are quorums and disjoint by construction).
+    Dropping it moves the entire hit-processing loop off the host — the r3
+    path shipped every quorum hit to Python (1.37M hits at orgs=6, ~100 us
+    each ≈ 140 s of host time) plus a 256-row buffer overflow cascade."""
     perimeter = children | remaining[None, :]
     mq = _contract_fixpoint(perimeter, top_thr, top_masks, inner_thr,
                             inner_masks)
@@ -135,22 +147,34 @@ def _child_flags(children, remaining, top_thr, top_masks, inner_thr,
                                 inner_masks), n_words)
     nonzero = jnp.any(children, axis=-1)
     is_q = nonzero & ~jnp.any(children & ~sat, axis=-1)
-    return dead, is_q
+    # split witness: greatest quorum of the scc-complement, batched.  The
+    # input is masked to the (almost always empty) quorum-hit lanes so the
+    # shared while_loop converges in one body iteration on hit-free
+    # batches instead of running a full fixpoint for every lane whose
+    # result would be discarded.
+    comp_mq = _contract_fixpoint(
+        jnp.where(is_q[:, None], scc_words[None, :] & ~children, 0),
+        top_thr, top_masks, inner_thr, inner_masks)
+    witness = is_q & jnp.any(comp_mq, axis=-1)
+    return dead, is_q, witness
 
 
 @partial(jax.jit, static_argnames=("mesh_size",))
-def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
-                inner_masks, mesh_size=None):
+def _prune_step(children, remaining, scc_words, top_thr, top_masks,
+                inner_thr, inner_masks, mesh_size=None):
     """One frontier depth step, fully batched.
 
     children [B, W]: candidate committed-masks after the split expansion.
     remaining [W]: the shared remaining-mask at the children's depth.
     Returns (alive [B] bool — survives pruning and is not itself a quorum,
-             is_quorum [B] bool — contract(committed)==committed != 0).
+             is_quorum [B] bool — contract(committed)==committed != 0,
+             witness [B] bool — is_quorum with a disjoint-quorum
+             complement, i.e. a proven split).
     """
-    dead, is_q = _child_flags(children, remaining, top_thr, top_masks,
-                              inner_thr, inner_masks)
-    return ~dead & ~is_q, is_q
+    dead, is_q, witness = _child_flags(children, remaining, scc_words,
+                                       top_thr, top_masks, inner_thr,
+                                       inner_masks)
+    return ~dead & ~is_q, is_q, witness
 
 
 # Depths fused per device dispatch on the resident-frontier path.  Fixed
@@ -158,15 +182,18 @@ def _prune_step(children, remaining, top_thr, top_masks, inner_thr,
 # shape axis is the frontier capacity bucket — one compile costs 20-40s on
 # this backend, so the shape space must stay tiny (PROFILE.md round 3).
 SEG_DEPTHS = 4
-# Per-depth capacity of the found-quorum output buffer.  Quorum hits are
-# rare events handled by the CPU oracle; a depth that finds more than this
-# many falls back to the host-chunked path (counted, correct, slower).
-QROWS_CAP = 256
+# Per-depth capacity of the split-WITNESS output buffer.  Witnesses are
+# genuinely rare (zero on any intersecting map — quorum hits are filtered
+# by the on-device complement check, not shipped to the host), and one
+# witness already decides the verdict, so a tiny buffer suffices; a depth
+# that somehow finds more keeps the first WITNESS_CAP (the verdict and a
+# valid split are identical either way).
+WITNESS_CAP = 8
 
 
 @jax.jit
-def _segment_step(frontier, count, bits_seq, rems_seq, active_seq, top_thr,
-                  top_masks, inner_thr, inner_masks):
+def _segment_step(frontier, count, bits_seq, rems_seq, active_seq,
+                  scc_words, top_thr, top_masks, inner_thr, inner_masks):
     """SEG_DEPTHS frontier depths in ONE dispatch, frontier resident on
     device (VERDICT r3 weak #4: the old path round-tripped every batch
     host<->device once per chunk per depth on a ~0.3 s/dispatch tunnel).
@@ -175,16 +202,17 @@ def _segment_step(frontier, count, bits_seq, rems_seq, active_seq, top_thr,
     count      int32 — live frontier rows;
     bits_seq   [SEG_DEPTHS, W] — the split bit of each depth;
     rems_seq   [SEG_DEPTHS, W] — remaining-mask BELOW each depth;
-    active_seq [SEG_DEPTHS] bool — False = padding depth (pass-through).
+    active_seq [SEG_DEPTHS] bool — False = padding depth (pass-through);
+    scc_words  [W] — the main quorum-bearing SCC (complement universe).
 
-    Returns (frontier', meta [SEG_DEPTHS+2] int32, q_rows [SEG_DEPTHS,
-    QROWS_CAP, W]) where meta = per-depth quorum counts ++ [count',
-    ovf_depth] — ONE packed array so the host's segment sync is a single
-    device->host transfer (each materialization is its own ~0.3 s RPC on
-    the tunneled backend).  ovf_depth is the first depth index whose
-    compacted frontier exceeded capacity (or whose quorum hits exceeded
-    QROWS_CAP), -1 if none; state stops advancing at the overflow depth so
-    the host can finish that depth with the chunked fallback path.
+    Returns (frontier', meta [2*SEG_DEPTHS+2] int32, w_rows [SEG_DEPTHS,
+    WITNESS_CAP, W]) where meta = per-depth quorum-hit counts ++ per-depth
+    witness counts ++ [count', ovf_depth] — ONE packed array so the host's
+    segment sync is a single device->host transfer (each materialization
+    is its own ~0.3 s RPC on the tunneled backend).  ovf_depth is the
+    first depth index whose compacted frontier exceeded capacity, -1 if
+    none; state stops advancing at the overflow depth so the host can
+    finish that depth with the chunked fallback path.
     """
     C = frontier.shape[0]
     W = frontier.shape[1]
@@ -198,47 +226,48 @@ def _segment_step(frontier, count, bits_seq, rems_seq, active_seq, top_thr,
             children = jnp.concatenate([fr, fr | bit[None, :]])   # [2C, W]
             valid = jnp.concatenate([jnp.arange(C) < cnt,
                                      jnp.arange(C) < cnt])
-            dead, is_q = _child_flags(children, rem, top_thr, top_masks,
-                                      inner_thr, inner_masks)
+            dead, is_q, wit = _child_flags(children, rem, scc_words,
+                                           top_thr, top_masks, inner_thr,
+                                           inner_masks)
             alive = ~dead & ~is_q & valid
             is_q = is_q & valid
+            wit = wit & valid
             # device-side compaction: stable argsort moves alive rows to
             # the front in order (exclude-branch children first, matching
             # the host path's concatenation order)
             order = jnp.argsort(~alive)
             new_fr = children[order][:C]
             new_cnt = jnp.sum(alive).astype(jnp.int32)
-            q_order = jnp.argsort(~is_q)
-            q_rows = children[q_order][:QROWS_CAP]
-            if q_rows.shape[0] < QROWS_CAP:   # static: 2C < QROWS_CAP
-                q_rows = jnp.pad(q_rows,
-                                 ((0, QROWS_CAP - q_rows.shape[0]), (0, 0)))
+            w_order = jnp.argsort(~wit)
+            w_rows = children[w_order][:WITNESS_CAP]
             q_cnt = jnp.sum(is_q).astype(jnp.int32)
-            did_ovf = (new_cnt > C) | (q_cnt > QROWS_CAP)
-            return new_fr, new_cnt, q_rows, q_cnt, did_ovf
+            w_cnt = jnp.sum(wit).astype(jnp.int32)
+            did_ovf = new_cnt > C
+            return new_fr, new_cnt, w_rows, q_cnt, w_cnt, did_ovf
 
         def skip(args):
             fr, cnt = args
-            return (fr, cnt, jnp.zeros((QROWS_CAP, W), jnp.uint32),
-                    jnp.int32(0), jnp.bool_(False))
+            return (fr, cnt, jnp.zeros((WITNESS_CAP, W), jnp.uint32),
+                    jnp.int32(0), jnp.int32(0), jnp.bool_(False))
 
         live = is_active & (ovf < 0)
-        new_fr, new_cnt, q_rows, q_cnt, did_ovf = jax.lax.cond(
+        new_fr, new_cnt, w_rows, q_cnt, w_cnt, did_ovf = jax.lax.cond(
             live, run, skip, (fr, cnt))
         # overflow: freeze the PRE-step state for the host to resume from
         new_fr = jnp.where(did_ovf, fr, new_fr)
         new_cnt = jnp.where(did_ovf, cnt, new_cnt)
-        q_rows = jnp.where(did_ovf, jnp.zeros_like(q_rows), q_rows)
+        w_rows = jnp.where(did_ovf, jnp.zeros_like(w_rows), w_rows)
         q_cnt = jnp.where(did_ovf, 0, q_cnt)
+        w_cnt = jnp.where(did_ovf, 0, w_cnt)
         new_ovf = jnp.where((ovf < 0) & did_ovf, didx, ovf)
         return ((new_fr, new_cnt, new_ovf, didx + 1),
-                (q_rows, q_cnt))
+                (w_rows, q_cnt, w_cnt))
 
-    (fr, cnt, ovf, _), (q_rows, q_counts) = jax.lax.scan(
+    (fr, cnt, ovf, _), (w_rows, q_counts, w_counts) = jax.lax.scan(
         depth, (frontier, count, jnp.int32(-1), jnp.int32(0)),
         (bits_seq, rems_seq, active_seq))
-    meta = jnp.concatenate([q_counts, jnp.stack([cnt, ovf])])
-    return fr, meta, q_rows
+    meta = jnp.concatenate([q_counts, w_counts, jnp.stack([cnt, ovf])])
+    return fr, meta, w_rows
 
 
 class TPUQuorumIntersectionChecker:
@@ -283,25 +312,31 @@ class TPUQuorumIntersectionChecker:
             ndev = mesh.devices.size
             spec_b = Pspec("data", None)
             sharded = shard_map(
-                lambda c, r, tt, tm, it, im: _prune_step(c, r, tt, tm, it, im),
+                lambda c, r, sw, tt, tm, it, im: _prune_step(
+                    c, r, sw, tt, tm, it, im),
                 mesh=mesh,
-                in_specs=(spec_b, Pspec(None), Pspec(None),
+                in_specs=(spec_b, Pspec(None), Pspec(None), Pspec(None),
                           Pspec(None, None), Pspec(None, None),
                           Pspec(None, None, None)),
-                out_specs=(Pspec("data"), Pspec("data")))
+                out_specs=(Pspec("data"), Pspec("data"), Pspec("data")))
             self._step = jax.jit(sharded)
             self._pad_to = ndev
         else:
             self._step = _prune_step
             self._pad_to = 1
+        # set by check() once the quorum-bearing SCC is known; the device
+        # complement check runs against this universe
+        self._scc_words = None
 
     # -- batched pruning over the device ---------------------------------
     def _prune(self, children: np.ndarray, remaining_words: np.ndarray
-               ) -> Tuple[np.ndarray, np.ndarray]:
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         alive = np.zeros(len(children), dtype=bool)
         is_q = np.zeros(len(children), dtype=bool)
+        wit = np.zeros(len(children), dtype=bool)
         bs = self.batch_size
         rem = jnp.asarray(remaining_words)
+        scc_w = jnp.asarray(self._scc_words)
         for lo in range(0, len(children), bs):
             if self.interrupt():
                 raise InterruptedError_()
@@ -320,12 +355,13 @@ class TPUQuorumIntersectionChecker:
                 # compute a real (discarded) contraction, never an error
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad, self.n_words), dtype=np.uint32)])
-            a, q = self._step(jnp.asarray(chunk), rem, self.top_thr,
-                              self.top_masks, self.inner_thr,
-                              self.inner_masks)
+            a, q, w = self._step(jnp.asarray(chunk), rem, scc_w,
+                                 self.top_thr, self.top_masks,
+                                 self.inner_thr, self.inner_masks)
             alive[lo:lo + bs] = np.asarray(a)[:n_real]
             is_q[lo:lo + bs] = np.asarray(q)[:n_real]
-        return alive, is_q
+            wit[lo:lo + bs] = np.asarray(w)[:n_real]
+        return alive, is_q, wit
 
     # -- the frontier search ---------------------------------------------
     def check(self) -> QuorumIntersectionResult:
@@ -370,39 +406,47 @@ class TPUQuorumIntersectionChecker:
         rems_all = np.stack(
             [_masks_to_words([depth_remaining[d + 1]], self.n_words)[0]
              for d in range(D)])
+        self._scc_words = _masks_to_words([scc], self.n_words)[0]
 
         self._quorum_hits = 0
 
-        def process_quorum(words) -> Optional[QuorumIntersectionResult]:
-            """Rare path: exact minimality + disjoint-complement on CPU."""
+        def process_witness(words) -> QuorumIntersectionResult:
+            """A device-reported split witness: committed claims to be a
+            quorum whose scc-complement contains one.  BOTH sides are
+            re-verified on the exact CPU oracle before the verdict leaves
+            this class — a fault on the flaky tunneled device must
+            fail-stop, never fabricate a 'proven non-intersection'."""
             committed = _words_to_mask(words)
-            self._quorum_hits += 1
-            if oracle.is_minimal_quorum(committed):
-                disjoint = oracle.contract_to_max_quorum(scc & ~committed)
-                if disjoint:
-                    return QuorumIntersectionResult(
-                        False,
-                        split=(oracle._names(committed),
-                               oracle._names(disjoint)),
-                        node_count=n, main_scc_size=scc.bit_count(),
-                        max_quorums_found=self._quorum_hits)
-            return None
+            if not oracle.is_quorum(committed):
+                raise RuntimeError(
+                    "device split witness rejected by CPU oracle: committed "
+                    "set is not a quorum (device fault?)")
+            disjoint = oracle.contract_to_max_quorum(scc & ~committed)
+            if not disjoint:
+                raise RuntimeError(
+                    "device split witness rejected by CPU oracle: "
+                    "complement has no quorum (device fault?)")
+            return QuorumIntersectionResult(
+                False,
+                split=(oracle._names(committed), oracle._names(disjoint)),
+                node_count=n, main_scc_size=scc.bit_count(),
+                max_quorums_found=self._quorum_hits)
 
         if self.mesh is None:
-            res = self._run_resident(bits_all, rems_all, process_quorum)
+            res = self._run_resident(bits_all, rems_all, process_witness)
         else:
             # the sharded multi-chip path keeps the per-depth chunked step
             # (device-side argsort compaction is shard-local under
             # shard_map; cross-shard compaction would need a gather that
             # defeats the residency win)
-            res = self._run_chunked(bits_all, rems_all, process_quorum)
+            res = self._run_chunked(bits_all, rems_all, process_witness)
         if res is not None:
             return res
         return QuorumIntersectionResult(
             True, node_count=n, main_scc_size=scc.bit_count(),
             max_quorums_found=self._quorum_hits)
 
-    def _run_chunked(self, bits_all, rems_all, process_quorum
+    def _run_chunked(self, bits_all, rems_all, process_witness
                      ) -> Optional[QuorumIntersectionResult]:
         """Per-depth host-chunked frontier walk (the round-3 path; still
         used under a mesh and as the overflow fallback)."""
@@ -411,33 +455,37 @@ class TPUQuorumIntersectionChecker:
             if len(frontier) == 0:
                 break
             frontier, res = self._chunked_depth(frontier, bits_all[d],
-                                                rems_all[d], process_quorum)
+                                                rems_all[d], process_witness)
             if res is not None:
                 return res
         return None
 
-    def _chunked_depth(self, frontier, bit_words, rem_words, process_quorum):
+    def _chunked_depth(self, frontier, bit_words, rem_words, process_witness):
         """Expand + prune ONE depth on the host-chunked path; returns
         (new_frontier, early_result_or_None)."""
         children = np.concatenate([frontier, frontier | bit_words])
-        alive, is_q = self._prune(children, rem_words)
-        for idx in np.nonzero(is_q)[0]:
-            res = process_quorum(children[idx])
-            if res is not None:
-                return children[alive], res
+        alive, is_q, wit = self._prune(children, rem_words)
+        self._quorum_hits += int(is_q.sum())
+        w_idx = np.nonzero(wit)[0]
+        if len(w_idx):
+            return children[alive], process_witness(children[w_idx[0]])
         return children[alive], None
 
     # capacity buckets for the device-resident frontier: pow4-spaced —
     # coarse enough that jit compiles stay few (one compile per bucket
     # costs 20-40s on this backend), fine enough that padded rows stay
-    # within ~4x of the worst-case segment need
-    CAPACITY_BUCKETS = (1024, 4096, 16384, 65536)
+    # within ~4x of the worst-case segment need.  The top buckets exist
+    # for the adversarial asym-org maps whose frontiers peak in the
+    # hundreds of thousands: falling off the resident path there costs
+    # hundreds of chunked dispatches per depth (W is 1-2 words, so even
+    # 1M rows is only ~8 MB of frontier).
+    CAPACITY_BUCKETS = (1024, 4096, 16384, 65536, 262144, 1048576)
 
-    def _run_resident(self, bits_all, rems_all, process_quorum
+    def _run_resident(self, bits_all, rems_all, process_witness
                       ) -> Optional[QuorumIntersectionResult]:
         """Device-resident frontier walk: SEG_DEPTHS depths per dispatch,
         compaction on device; per segment the host syncs scalars, the rare
-        found-quorum rows, and the frontier array only when the capacity
+        split-witness rows, and the frontier array only when the capacity
         bucket changes (VERDICT r3 weak #4: the old path shipped every
         chunk host<->device once per depth)."""
         D = len(bits_all)
@@ -465,7 +513,7 @@ class TPUQuorumIntersectionChecker:
                 # this depth host-chunked, then retry residency
                 fr_host, res = self._chunked_depth(
                     to_host(count), bits_all[d], rems_all[d],
-                    process_quorum)
+                    process_witness)
                 fr_dev = None
                 if res is not None:
                     return res
@@ -485,32 +533,35 @@ class TPUQuorumIntersectionChecker:
                 fr_in = jnp.asarray(pad)
             else:
                 fr_in = fr_dev   # already device-resident at this capacity
-            fr, meta, q_rows = _segment_step(
+            fr, meta, w_rows = _segment_step(
                 fr_in, jnp.int32(count), jnp.asarray(bits),
-                jnp.asarray(rems), jnp.asarray(active), self.top_thr,
+                jnp.asarray(rems), jnp.asarray(active),
+                jnp.asarray(self._scc_words), self.top_thr,
                 self.top_masks, self.inner_thr, self.inner_masks)
             # ONE sync per segment: the packed meta array carries the
-            # per-depth quorum counts + count' + ovf in a single transfer
-            # (materialization is what executes on this lazy backend)
+            # per-depth hit/witness counts + count' + ovf in a single
+            # transfer (materialization is what executes on this lazy
+            # backend); witness rows transfer only when one exists —
+            # i.e. never, on an intersecting map
             meta = np.asarray(meta)
             q_counts = meta[:SEG_DEPTHS]
-            count = int(meta[SEG_DEPTHS])
-            ovf = int(meta[SEG_DEPTHS + 1])
+            w_counts = meta[SEG_DEPTHS:2 * SEG_DEPTHS]
+            count = int(meta[2 * SEG_DEPTHS])
+            ovf = int(meta[2 * SEG_DEPTHS + 1])
             fr_dev, cur_cap = fr, cap
             done_depths = k if ovf < 0 else min(ovf, k)
-            if q_counts[:done_depths].any():
-                rows = np.asarray(q_rows)
+            self._quorum_hits += int(q_counts[:done_depths].sum())
+            if w_counts[:done_depths].any():
+                rows = np.asarray(w_rows)
                 for j in range(done_depths):
-                    for r in range(int(q_counts[j])):
-                        res = process_quorum(rows[j, r])
-                        if res is not None:
-                            return res
+                    if w_counts[j]:
+                        return process_witness(rows[j, 0])
             if ovf >= 0:
                 # the overflow depth never ran: state froze at its input —
                 # finish that depth host-chunked and continue
                 fr_host, res = self._chunked_depth(
                     to_host(count), bits_all[d + ovf], rems_all[d + ovf],
-                    process_quorum)
+                    process_witness)
                 fr_dev = None
                 if res is not None:
                     return res

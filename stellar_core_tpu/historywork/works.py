@@ -219,7 +219,8 @@ class CatchupWork(Work):
     def __init__(self, clock: VirtualClock, mgr, archive: FileHistoryArchive,
                  target: int, network_id: bytes, accel: bool = False,
                  accel_chunk: int = 8192, lookahead: int = 2,
-                 stats: Optional[dict] = None, coalesce: int = 4):
+                 stats: Optional[dict] = None, coalesce: int = 4,
+                 accel_hot_threshold: int = 1 << 62):
         super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.archive = archive
@@ -234,7 +235,8 @@ class CatchupWork(Work):
                              2 * self.coalesce if accel else 0)
         self.stats = stats if stats is not None else {}
         self.pipeline = (PreverifyPipeline(network_id, accel_chunk,
-                                           self.stats)
+                                           self.stats,
+                                           hot_threshold=accel_hot_threshold)
                          if accel else None)
         self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
         self._apply: Optional[ApplyCheckpointWork] = None
