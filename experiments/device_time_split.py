@@ -18,7 +18,9 @@ Co-located projection printed with its arithmetic: a local chip pays
 ~PCIe/ICI transfer (>10 GB/s) instead of the ~14 MB/s tunnel, so
 projected sigs/s = n / (compute + n_bytes / 10 GB/s + ~1 ms launch).
 
-Run ON THE REAL CHIP (no JAX_PLATFORMS=cpu):  python experiments/device_time_split.py
+Run ON THE REAL CHIP (no JAX_PLATFORMS=cpu):
+    python experiments/device_time_split.py [--tables]
+(--tables measures the per-key-table path instead of the generic one.)
 """
 
 import sys
@@ -30,13 +32,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def main(n=32768, rounds=5):
+def main(n=32768, rounds=5, tables=False):
     import jax.numpy as jnp
 
     from stellar_core_tpu.accel import ed25519 as E
     from stellar_core_tpu.crypto import sodium
 
-    print(f"building {n} signatures...", flush=True)
+    print(f"building {n} signatures (path: "
+          f"{'tables' if tables else 'generic'})...", flush=True)
     keys = [sodium.sign_seed_keypair(bytes([i]) * 32) for i in range(64)]
     pks, sigs, msgs = [], [], []
     import random
@@ -49,7 +52,7 @@ def main(n=32768, rounds=5):
         msgs.append(msg)
 
     v = E.Ed25519BatchVerifier(chunk_size=n, tail_floor=n,
-                               hot_threshold=1 << 62)
+                               hot_threshold=4 if tables else 1 << 62)
 
     # -- host prep: time the numpy/SHA section by running verify_async and
     # subtracting nothing — the call itself IS the prep + enqueue (enqueue
@@ -121,4 +124,4 @@ def main(n=32768, rounds=5):
 
 
 if __name__ == "__main__":
-    main()
+    main(tables="--tables" in sys.argv)

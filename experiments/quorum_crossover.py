@@ -2,11 +2,12 @@
 enumerator (VERDICT r3 item 4: orgs=7 inside the 900 s budget; round-3
 chunked path took 1815 s vs CPU TIMEOUT>900 s).
 
-Runs orgs=5 (sanity + warm), then orgs=6, then orgs=7 with a wall-clock
-printout per map and per segment-count stats.  Verdicts cross-checked
-against the exact CPU checker where it answers inside its budget.
+Runs orgs=min_orgs..max_orgs with a wall-clock printout per map.
+Verdicts cross-checked against the exact CPU checker where it answers
+inside its budget (orgs<=6).
 
-Run ON THE REAL CHIP:  python experiments/quorum_crossover.py [max_orgs]
+Run ON THE REAL CHIP:
+    python experiments/quorum_crossover.py [max_orgs] [min_orgs]
 """
 
 import os
@@ -16,7 +17,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(max_orgs=7):
+def main(max_orgs=7, min_orgs=5):
     from stellar_core_tpu.accel.quorum import check_intersection_tpu
     from stellar_core_tpu.herder.quorum_intersection import check_intersection
     from stellar_core_tpu.testutils import asym_org_qmap
@@ -30,7 +31,7 @@ def main(max_orgs=7):
           flush=True)
 
     cpu_budget_s = 900.0
-    for n_orgs in range(5, max_orgs + 1):
+    for n_orgs in range(min_orgs, max_orgs + 1):
         qmap = asym_org_qmap(n_orgs)
         t0 = time.perf_counter()
         tres = check_intersection_tpu(qmap)
@@ -54,4 +55,5 @@ def main(max_orgs=7):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 7)
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 7,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 5)

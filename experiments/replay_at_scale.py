@@ -1,0 +1,76 @@
+"""Config-1/4 replay at (near) stated scale (VERDICT r3 item 7: the
+bench archive was a 1151-ledger proxy for configs that call for ~10k
+pubnet-shaped ledgers; the scale-up had never been attempted).
+
+Builds a BENCH_PAYMENT_LEDGERS-shaped archive once (default 10000
+payment ledgers ≈ 10.1k total), then one interleaved (cpu, accel) replay
+pair with identical-hash assertion, reporting per-phase pipeline stats.
+One pair, not medians: a ~10x-longer pass averages over the drift that
+the short bench needs interleaved medians for.
+
+Run ON THE REAL CHIP:  python experiments/replay_at_scale.py [n_payment]
+"""
+
+import os
+import sys
+import time
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(n_payment_ledgers=10000):
+    import bench
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.crypto import keys
+    from stellar_core_tpu.testutils import network_id
+
+    passphrase = "bench network"
+    nid = network_id(passphrase)
+
+    with tempfile.TemporaryDirectory() as d:
+        print(f"building archive ({n_payment_ledgers} payment ledgers)...",
+              flush=True)
+        t0 = time.perf_counter()
+        archive, mgr = bench.build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=n_payment_ledgers)
+        print(f"  built in {time.perf_counter()-t0:.1f}s", flush=True)
+        has = archive.get_state()
+        n_ledgers = has.current_ledger
+        expected = mgr.lcl_hash
+
+        print("accel warm pass (compiles)...", flush=True)
+        keys.clear_verify_cache()
+        CatchupManager(nid, passphrase, accel=True,
+                       accel_chunk=8192).catchup_complete(archive,
+                                                          to_ledger=127)
+
+        keys.clear_verify_cache()
+        cm = CatchupManager(nid, passphrase, accel=False)
+        t0 = time.perf_counter()
+        m = cm.catchup_complete(archive)
+        t_cpu = time.perf_counter() - t0
+        assert m.lcl_hash == expected
+        print(f"cpu  : {n_ledgers/t_cpu:7.1f} l/s ({t_cpu:.1f}s, "
+              f"{n_ledgers} ledgers)", flush=True)
+
+        keys.clear_verify_cache()
+        cm = CatchupManager(nid, passphrase, accel=True, accel_chunk=8192)
+        t0 = time.perf_counter()
+        m = cm.catchup_complete(archive)
+        t_acc = time.perf_counter() - t0
+        assert m.lcl_hash == expected, "accel replay diverged at scale"
+        print(f"accel: {n_ledgers/t_acc:7.1f} l/s ({t_acc:.1f}s)  "
+              f"ratio {t_cpu/t_acc:.3f}x  "
+              f"hit={cm.offload_hit_rate():.3f}", flush=True)
+        st = cm.stats
+        print(f"phases: dispatch_s={st.get('dispatch_s', 0):.2f} "
+              f"collect_wait_s={st.get('collect_wait_s', 0):.2f} "
+              f"groups={st.get('dispatch_groups', 0)} "
+              f"sigs={st.get('sigs_shipped', 0)}/{st.get('sigs_total', 0)} "
+              f"fallbacks={st.get('collect_fallbacks', 0)}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10000)

@@ -280,13 +280,19 @@ class TPUQuorumIntersectionChecker:
 
     def __init__(self, qmap: Dict[bytes, object],
                  interrupt: Optional[Callable[[], bool]] = None,
-                 batch_size: int = 2048,
+                 batch_size: Optional[int] = None,
                  mesh: Optional[Mesh] = None):
         (self.node_ids, tops, top_masks, inner_thrs,
          inner_masks) = flatten_qmap(qmap)
         self.n = len(self.node_ids)
         self.interrupt = interrupt or (lambda: False)
-        self.batch_size = batch_size
+        # None = auto.  The chunked path (mesh, and the over-capacity
+        # fallback when a frontier outgrows the largest resident bucket)
+        # pays ~0.3 s of tunnel latency PER DISPATCH: at the old 2048
+        # default an orgs=7 peak depth (~2M children) cost ~1000 dispatches
+        # ≈ 300 s per depth.  Wide chunks amortize it; the frontier rows
+        # are 1-2 uint32 words, so even 65536-row chunks are ~0.5 MB.
+        self.batch_size = 65536 if batch_size is None else batch_size
         self.mesh = mesh
         # CPU oracle shares index order (flatten_qmap and the checker both
         # sort node ids) — used for SCC analysis and rare-event checks.
@@ -573,7 +579,7 @@ class TPUQuorumIntersectionChecker:
 
 
 def check_intersection_tpu(qmap, interrupt=None, mesh=None,
-                           batch_size=2048) -> QuorumIntersectionResult:
+                           batch_size=None) -> QuorumIntersectionResult:
     """One-shot API mirroring herder.quorum_intersection.check_intersection."""
     return TPUQuorumIntersectionChecker(qmap, interrupt, batch_size,
                                         mesh).check()
