@@ -155,6 +155,38 @@ class TestResidentFrontier:
         a, b = tpu.split
         assert set(a) & set(b) == set()
 
+    @pytest.mark.parametrize("row_kind,branch", [
+        ("zeros", "not a quorum"),            # corrupt transfer shape
+        ("all_nodes", "complement has no quorum"),  # real quorum, bogus claim
+    ])
+    def test_corrupt_device_witness_fails_stop(self, monkeypatch, row_kind,
+                                               branch):
+        """A device fault that fabricates a witness row must raise, never
+        report a 'proven' non-intersection: process_witness re-verifies
+        BOTH sides on the exact CPU oracle (the threat model is the flaky
+        tunneled chip corrupting rows or counts).  Two corruptions, one
+        per oracle branch: an all-zero row (committed side not a quorum)
+        and a genuine-quorum row whose split claim is bogus (complement
+        side empty on an intersecting map)."""
+        import numpy as np
+
+        from stellar_core_tpu.accel import quorum as AQ
+
+        qmap = org_qmap(5, 3, 3, 2)            # intersecting, 15 nodes
+        fill = 0 if row_kind == "zeros" else (1 << 15) - 1
+        real_step = AQ._segment_step
+
+        def corrupted(*args, **kw):
+            fr, meta, w_rows = real_step(*args, **kw)
+            meta = np.asarray(meta).copy()
+            meta[AQ.SEG_DEPTHS] = 1            # claim one witness, depth 0
+            rows = np.full_like(np.asarray(w_rows), fill)
+            return fr, meta, rows
+
+        monkeypatch.setattr(AQ, "_segment_step", corrupted)
+        with pytest.raises(RuntimeError, match=branch):
+            check_intersection_tpu(qmap)
+
 
 class TestBigMap:
     def test_tier1_shape_21_nodes(self):
