@@ -7,4 +7,4 @@ test:
 	python -m pytest tests/ -q
 
 clean:
-	rm -rf build stellar_core_tpu/_cxdr*.so
+	rm -rf build stellar_core_tpu/_cxdr*.so stellar_core_tpu/_cquorum*.so

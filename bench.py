@@ -188,9 +188,12 @@ def bench_sigs():
 
     rng = random.Random(7)
     n_total = 65536
-    # round-3 A/B: the kernel is per-dispatch-cost bound, not step bound —
-    # 34k sigs/s @ chunk 8192 vs 54k @ 32768 (device-only table path)
-    chunk = 32768
+    # round-4 A/B (experiments/sig_chunk_ab.py): the backend pipelines
+    # in-flight chunks, so several mid-size dispatches beat one full-width
+    # one — 46.2k sigs/s @ chunk 16384 (4 in flight) vs 43.9k @ 32768 vs
+    # 38.2k @ 65536.  Round 3's "width is the lever" held only for
+    # serial one-chunk-at-a-time dispatch.
+    chunk = 16384
     n_base = 3000
     keys = [sodium.sign_seed_keypair(bytes([i]) * 32) for i in range(64)]
     pks, sigs, msgs = [], [], []
@@ -338,11 +341,13 @@ def bench_quorum():
     t_tpu_adv = time.perf_counter() - t0
     assert bool(tres.intersects) == bool(res2.intersects)
 
-    # config 5's exponential class at the largest size that fits the
-    # driver budget (orgs=5, 19 nodes); the 6/7-org crossover rows are
-    # measured offline and recorded in BASELINE.md (orgs=6: CPU 191.5s vs
-    # TPU 211.4s; orgs=7: CPU TIMEOUT>900s vs TPU 1815s — the TPU answers
-    # a map the CPU cannot; growth per org CPU ~58x vs TPU ~9-13x)
+    # config 5's exponential class.  The native enumeration core
+    # (native/cquorum.c, round 4) answers orgs=5 in ~0.3s and orgs=6 in
+    # ~9s, so both CPU rows fit the driver budget WHEN the extension is
+    # built; on the pure-Python fallback orgs=6 takes ~3 minutes, so the
+    # row is skipped (None).  The offline crossover table (orgs<=8, incl.
+    # the TPU resident-frontier rows) is in BASELINE.md config 5.
+    from stellar_core_tpu.herder.quorum_intersection import _cquorum
     asym = asym_org_map(5)
     t0 = time.perf_counter()
     ares_t = check_intersection_tpu(asym, batch_size=8192)
@@ -351,7 +356,14 @@ def bench_quorum():
     ares_c = check_intersection(asym)
     t_cpu_asym = time.perf_counter() - t0
     assert bool(ares_t.intersects) == bool(ares_c.intersects)
-    return t_cpu_tier1, t_cpu_adv, t_tpu_adv, t_cpu_asym, t_tpu_asym
+    t_cpu_asym6 = None
+    if _cquorum is not None:
+        t0 = time.perf_counter()
+        ares_c6 = check_intersection(asym_org_map(6))
+        t_cpu_asym6 = time.perf_counter() - t0
+        assert ares_c6.intersects
+    return (t_cpu_tier1, t_cpu_adv, t_tpu_adv, t_cpu_asym, t_tpu_asym,
+            t_cpu_asym6)
 
 
 def probe_device(timeout_s: float = 120.0, attempts: int = 3) -> bool:
@@ -474,13 +486,17 @@ def main():
 
     _stage("quorum bench...")
     (t_cpu_tier1, t_cpu_adv, t_tpu_adv,
-     t_cpu_asym, t_tpu_asym) = bench_quorum()
+     t_cpu_asym, t_tpu_asym, t_cpu_asym6) = bench_quorum()
+    from stellar_core_tpu.herder.quorum_intersection import _cquorum
     _cache_put("quorum", {
         "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
         "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
         "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
         "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
         "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
+        **({"quorum_asym6_cpu_s": round(t_cpu_asym6, 3)}
+           if t_cpu_asym6 is not None else {}),
+        "quorum_native_engine": _cquorum is not None,
     })
 
     print(json.dumps({
@@ -504,6 +520,9 @@ def main():
             "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
             "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
             "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
+            **({"quorum_asym6_cpu_s": round(t_cpu_asym6, 3)}
+               if t_cpu_asym6 is not None else {}),
+            "quorum_native_engine": _cquorum is not None,
             "replay_phases": phases,
         },
     }))

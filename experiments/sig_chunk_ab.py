@@ -1,0 +1,58 @@
+"""Chunk-width A/B for the sig bench config (table path, 64 hot keys,
+n=65536) in light of the round-4 in-flight discovery: the backend
+pipelines enqueued chunks (+91% allfirst vs serial), so several mid-size
+chunks in flight may now match or beat one full-width dispatch that the
+round-3 width study (single-chunk-at-a-time) favored.
+
+Run ON THE REAL CHIP:  python experiments/sig_chunk_ab.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(n_total=65536, rounds=4):
+    import random
+    from stellar_core_tpu.accel.ed25519 import Ed25519BatchVerifier
+    from stellar_core_tpu.crypto import sodium
+
+    rng = random.Random(7)
+    keys = [sodium.sign_seed_keypair(bytes([i]) * 32) for i in range(64)]
+    pks, sigs, msgs = [], [], []
+    for i in range(n_total):
+        pk, sk = keys[i % 64]
+        msg = rng.randbytes(120)
+        pks.append(pk)
+        sigs.append(sodium.sign_detached(msg, sk))
+        msgs.append(msg)
+
+    widths = (8192, 16384, 32768, 65536)
+    vs = {}
+    for w in widths:
+        print(f"warm chunk {w}...", flush=True)
+        v = Ed25519BatchVerifier(chunk_size=w)
+        v.verify(pks[:w], sigs[:w], msgs[:w])
+        vs[w] = v
+
+    results = {w: [] for w in widths}
+    for r in range(rounds):
+        for w in widths:                      # interleaved within a round
+            t0 = time.perf_counter()
+            out = vs[w].verify(pks, sigs, msgs)
+            dt = time.perf_counter() - t0
+            assert int(out.sum()) == n_total
+            results[w].append(n_total / dt)
+            print(f"round {r+1} chunk {w:6d}: {n_total/dt:8,.0f} sigs/s",
+                  flush=True)
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    print(f"\n=== medians over {rounds} interleaved rounds (n={n_total}) ===")
+    for w in widths:
+        print(f"chunk {w:6d}: {med(results[w]):8,.0f} sigs/s")
+
+
+if __name__ == "__main__":
+    main()

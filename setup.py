@@ -1,9 +1,10 @@
-"""Build the native XDR serializer (see native/cxdr.c).
+"""Build the native extensions (see native/cxdr.c, native/cquorum.c).
 
     python setup.py build_ext --inplace
 
-The framework runs without it (pure-Python codec fallback); building it
-accelerates the serialization-bound replay path.
+The framework runs without them (pure-Python fallbacks); building them
+accelerates the serialization-bound replay path and the exact
+quorum-intersection enumeration.
 """
 
 from setuptools import Extension, setup
@@ -11,9 +12,16 @@ from setuptools import Extension, setup
 setup(
     name="stellar-core-tpu-native",
     version="2.0.0",
-    ext_modules=[Extension(
-        "stellar_core_tpu._cxdr",
-        sources=["native/cxdr.c"],
-        extra_compile_args=["-O2"],
-    )],
+    ext_modules=[
+        Extension(
+            "stellar_core_tpu._cxdr",
+            sources=["native/cxdr.c"],
+            extra_compile_args=["-O2"],
+        ),
+        Extension(
+            "stellar_core_tpu._cquorum",
+            sources=["native/cquorum.c"],
+            extra_compile_args=["-O2"],
+        ),
+    ],
 )
