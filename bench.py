@@ -19,6 +19,11 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from stellar_core_tpu._native_build import ensure_native  # noqa: E402
+
+ensure_native()
+
 
 def _stage(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)

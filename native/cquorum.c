@@ -166,12 +166,15 @@ static int
 slice_satisfied(Ctx *c, uint32_t qi, u128 mask)
 {
     QB *q = &c->qbs[qi];
-    int count = popcount128(q->nodes & mask);
-    if (count >= (int)q->thr)
+    /* count is non-negative and bounded by n + n_inner; compare unsigned so
+     * a hostile threshold >= 2^31 (valid XDR uint32 in a never-sanity-checked
+     * qmap) cannot wrap negative and satisfy the slice unconditionally. */
+    uint32_t count = (uint32_t)popcount128(q->nodes & mask);
+    if (count >= q->thr)
         return 1;
     for (uint32_t i = 0; i < q->n_inner; i++) {
         if (slice_satisfied(c, c->kids[q->first + i], mask)) {
-            if (++count >= (int)q->thr)
+            if (++count >= q->thr)
                 return 1;
         }
     }

@@ -1,0 +1,57 @@
+"""Build-on-demand for the native C extensions (_cxdr, _cquorum, ...).
+
+The compiled .so files are NOT tracked in git (a prebuilt binary can go
+silently stale relative to native/*.c, defeating the differential tests
+that are supposed to validate it).  Instead, every entry point that wants
+native speed (tests/conftest.py, bench.py, __graft_entry__.py) calls
+ensure_native(), which (re)builds in-place iff a .so is missing or older
+than its C source.  Pure-Python fallbacks exist for every extension, so a
+failed build degrades to slow-but-correct.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "stellar_core_tpu")
+
+# module name -> C source (relative to repo root)
+_EXTENSIONS = {
+    "_cxdr": "native/cxdr.c",
+    "_cquorum": "native/cquorum.c",
+    "_capply": "native/capply.c",
+}
+
+
+def _stale():
+    out = []
+    for mod, src in _EXTENSIONS.items():
+        src_path = os.path.join(_REPO, src)
+        if not os.path.exists(src_path):
+            continue
+        sos = glob.glob(os.path.join(_PKG, mod + ".*.so"))
+        if not sos or any(
+                os.path.getmtime(so) < os.path.getmtime(src_path)
+                for so in sos):
+            out.append(mod)
+    return out
+
+
+def ensure_native(quiet=True):
+    """Build missing/stale native extensions in-place.  Returns True when
+    everything that has a source is built and current."""
+    stale = _stale()
+    if not stale:
+        return True
+    try:
+        res = subprocess.run(
+            [sys.executable, "setup.py", "build_ext", "--inplace"],
+            cwd=_REPO, capture_output=True, text=True, timeout=300)
+        if res.returncode != 0 and not quiet:
+            sys.stderr.write(res.stdout + res.stderr)
+    except Exception as e:  # missing compiler etc. — fall back to Python
+        if not quiet:
+            sys.stderr.write(f"native build failed: {e}\n")
+    return not _stale()

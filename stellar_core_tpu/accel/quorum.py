@@ -556,12 +556,18 @@ class TPUQuorumIntersectionChecker:
             ovf = int(meta[2 * SEG_DEPTHS + 1])
             fr_dev, cur_cap = fr, cap
             done_depths = k if ovf < 0 else min(ovf, k)
-            self._quorum_hits += int(q_counts[:done_depths].sum())
             if w_counts[:done_depths].any():
+                # count quorum hits only up to and including the witnessing
+                # depth, so max_quorums_found matches the CPU oracle's count
+                # at the moment the split is found (the whole segment ran on
+                # device, but depths past the witness are diagnostically
+                # "after" it)
                 rows = np.asarray(w_rows)
                 for j in range(done_depths):
                     if w_counts[j]:
+                        self._quorum_hits += int(q_counts[:j + 1].sum())
                         return process_witness(rows[j, 0])
+            self._quorum_hits += int(q_counts[:done_depths].sum())
             if ovf >= 0:
                 # the overflow depth never ran: state froze at its input —
                 # finish that depth host-chunked and continue

@@ -293,7 +293,13 @@ class QuorumIntersectionChecker:
         pure-Python enumeration below is the fallback and the semantic
         source of truth."""
         if _cquorum is not None and 0 < self.n <= 128:
-            return self._check_native()
+            try:
+                return self._check_native()
+            except ValueError:
+                # The native parser enforces bounds (e.g. >4096 inner sets)
+                # that the Python enumeration — the semantic source of
+                # truth — handles fine; degrade rather than refuse.
+                pass
         return self._check_python()
 
     def _blob(self) -> bytes:

@@ -337,46 +337,97 @@ class LedgerEntryIsValid(Invariant):
 def _sponsorship_units(entry: Optional[X.LedgerEntry]
                        ) -> Optional[Tuple[bytes, int]]:
     """(sponsor AccountID xdr, reserve units) when the entry carries a
-    sponsoringID (claimable balances reserve one unit per claimant;
-    everything else one).  Reference: computeMultiplier in
-    SponsorshipUtils."""
+    sponsoringID (2 for an account entry, one per claimant for claimable
+    balances, 2 for pool-share trustlines, else 1).  Reference:
+    computeMultiplier in SponsorshipUtils."""
     if entry is None or entry.ext.switch != 1 \
             or entry.ext.value.sponsoringID is None:
         return None
-    units = 1
-    if entry.data.switch == X.LedgerEntryType.CLAIMABLE_BALANCE:
-        units = len(entry.data.value.claimants)
-    return entry.ext.value.sponsoringID.to_xdr(), units
+    from ..transactions.sponsorship import compute_multiplier
+    return entry.ext.value.sponsoringID.to_xdr(), compute_multiplier(entry)
+
+
+def _entry_owner_units(entry: Optional[X.LedgerEntry]
+                       ) -> Optional[Tuple[bytes, int]]:
+    """(owner AccountID xdr, units) for a SPONSORED entry whose reserve is
+    counted in an owner account's numSponsored — accounts own themselves,
+    trustlines/data/offers their account; claimable balances are
+    owner-less."""
+    su = _sponsorship_units(entry)
+    if su is None:
+        return None
+    d = entry.data
+    t = d.switch
+    if t == X.LedgerEntryType.ACCOUNT:
+        return d.value.accountID.to_xdr(), su[1]
+    if t in (X.LedgerEntryType.TRUSTLINE, X.LedgerEntryType.DATA):
+        return d.value.accountID.to_xdr(), su[1]
+    if t == X.LedgerEntryType.OFFER:
+        return d.value.sellerID.to_xdr(), su[1]
+    return None
+
+
+def _signer_sponsor_counts(entry: Optional[X.LedgerEntry],
+                           sign: int, by_sponsor: Dict[bytes, int],
+                           by_owner: Dict[bytes, int]) -> None:
+    """Accumulate one account entry's sponsored-signer units into both the
+    per-sponsor and per-owner tallies."""
+    if entry is None or entry.data.switch != X.LedgerEntryType.ACCOUNT:
+        return
+    from ..transactions.sponsorship import signer_sponsoring_ids
+    ids = signer_sponsoring_ids(entry.data.value)
+    if not ids:
+        return
+    aid = entry.data.value.accountID.to_xdr()
+    for sp in ids:
+        if sp is not None:
+            sb = sp.to_xdr()
+            by_sponsor[sb] = by_sponsor.get(sb, 0) + sign
+            by_owner[aid] = by_owner.get(aid, 0) + sign
 
 
 class SponsorshipCountIsValid(Invariant):
     """Δ numSponsoring of each account equals the Δ of reserve units it
-    sponsors across this close's delta.  Reference:
-    src/invariant/SponsorshipCountIsValid.cpp (subset: entry sponsorships;
-    signer sponsorships arrive with the sponsorship ops)."""
+    sponsors (entries AND signers), and Δ numSponsored of each account
+    equals the Δ of sponsored units it owns.  Reference:
+    src/invariant/SponsorshipCountIsValid.cpp."""
     NAME = "SponsorshipCountIsValid"
 
     def check_on_ledger_close(self, ctx: LedgerCloseContext) -> Optional[str]:
-        d_units: Dict[bytes, int] = {}
-        d_declared: Dict[bytes, int] = {}
+        from ..transactions.utils import num_sponsored, num_sponsoring
+        d_units: Dict[bytes, int] = {}          # sponsored units BY sponsor
+        d_owned: Dict[bytes, int] = {}          # sponsored units ON owner
+        d_declared: Dict[bytes, int] = {}       # numSponsoring deltas
+        d_declared_ed: Dict[bytes, int] = {}    # numSponsored deltas
         for kb in set(ctx.pre) | set(ctx.post):
             pre_e, post_e = ctx.pre.get(kb), ctx.post.get(kb)
             for e, sign in ((pre_e, -1), (post_e, +1)):
                 su = _sponsorship_units(e)
                 if su is not None:
                     d_units[su[0]] = d_units.get(su[0], 0) + sign * su[1]
+                ou = _entry_owner_units(e)
+                if ou is not None:
+                    d_owned[ou[0]] = d_owned.get(ou[0], 0) + sign * ou[1]
+                _signer_sponsor_counts(e, sign, d_units, d_owned)
             key = X.LedgerKey.from_xdr(kb)
             if key.switch == X.LedgerEntryType.ACCOUNT:
-                from ..transactions.utils import num_sponsoring
                 aid = key.value.accountID.to_xdr()
                 pre_n = num_sponsoring(pre_e.data.value) if pre_e else 0
                 post_n = num_sponsoring(post_e.data.value) if post_e else 0
                 d_declared[aid] = d_declared.get(aid, 0) + post_n - pre_n
+                pre_d = num_sponsored(pre_e.data.value) if pre_e else 0
+                post_d = num_sponsored(post_e.data.value) if post_e else 0
+                d_declared_ed[aid] = d_declared_ed.get(aid, 0) + post_d - pre_d
         for aid in set(d_units) | set(d_declared):
             if d_units.get(aid, 0) != d_declared.get(aid, 0):
                 return (f"numSponsoring delta {d_declared.get(aid, 0)} != "
                         f"sponsored-unit delta {d_units.get(aid, 0)} for "
                         f"account {aid.hex()[:16]}")
+        for aid in set(d_owned) | set(d_declared_ed):
+            if d_owned.get(aid, 0) != d_declared_ed.get(aid, 0):
+                return (f"numSponsored delta {d_declared_ed.get(aid, 0)} != "
+                        f"owned sponsored-unit delta {d_owned.get(aid, 0)} "
+                        f"for account {aid.hex()[:16]}")
         return None
 
 

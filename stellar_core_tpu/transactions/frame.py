@@ -280,6 +280,7 @@ class TransactionFrame:
 
         if getattr(self, "_bad_seq", False):
             return _tx_result(fee, C.txBAD_SEQ)
+        self._sponsorship_ctx = {}   # fresh Begin/End sandwich state per apply
         inner = LedgerTxn(ltx)
         try:
             code = self._common_valid(inner, close_time, check_seq=False)
@@ -301,6 +302,12 @@ class TransactionFrame:
                 op_results.append(res)
                 if not _op_ok(res):
                     ok = False
+            if ok and self._sponsorship_ctx:
+                # a BeginSponsoringFutureReserves left unclosed at tx end
+                # fails the whole tx (reference: TransactionFrame apply —
+                # processPostApply sponsorship check, txBAD_SPONSORSHIP)
+                inner.rollback()
+                return _tx_result(fee, C.txBAD_SPONSORSHIP)
             if ok and not self._check_extra_signers(checker):
                 inner.rollback()
                 return _tx_result(fee, C.txBAD_AUTH_EXTRA)

@@ -478,10 +478,16 @@ def convert_with_offers(
 
 
 def _erase_offer(ltx: LedgerTxn, offer_key: X.LedgerKey, owner, header):
-    """Remove an offer entry and its subentry count."""
+    """Remove an offer entry and its subentry count, releasing the
+    sponsor's reserve when the offer was sponsored (reference:
+    removeEntryWithPossibleSponsorship on the crossing path)."""
+    from . import sponsorship
+    entry = ltx.load(offer_key)
     ltx.erase(offer_key)
     acc_e = load_account(ltx, owner)
     acc = acc_e.data.value
+    if entry is not None and sponsorship.entry_sponsor(entry) is not None:
+        sponsorship.release_entry_sponsorship(ltx, header, entry, acc_e)
     acc.numSubEntries -= 1
     ltx.update(acc_e)
 

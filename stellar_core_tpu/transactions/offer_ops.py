@@ -15,7 +15,7 @@ import math
 from typing import List, Optional
 
 from .. import xdr as X
-from . import utils
+from . import sponsorship, utils
 from .offer_exchange import (CONVERT_FILTER_STOP, CONVERT_OK, CONVERT_PARTIAL,
                              ROUND_NORMAL, ROUND_PATH_STRICT_RECEIVE,
                              ROUND_PATH_STRICT_SEND, _can_buy_at_most,
@@ -92,11 +92,13 @@ class _ManageOfferBase(OperationFrame):
 
         creating = offer_id == 0
         old_flags = 0
+        old_ext = None   # preserved across the erase/recreate update path
         if not creating:
             key, existing = self._load_own_offer(ltx, offer_id)
             if existing is None:
                 return self.result(C("NOT_FOUND"))
             old = existing.data.value
+            old_ext = existing.ext
             # take the old offer off the book (liabilities + entry); it is
             # recreated below if a residual remains
             assert acquire_or_release_offer_liabilities(
@@ -104,6 +106,9 @@ class _ManageOfferBase(OperationFrame):
             ltx.erase(key)
             if sell_amount == 0:
                 acc_e = load_account(ltx, src)
+                if sponsorship.entry_sponsor(existing) is not None:
+                    sponsorship.release_entry_sponsorship(
+                        ltx, header, existing, acc_e)
                 acc_e.data.value.numSubEntries -= 1
                 ltx.update(acc_e)
                 return self.success(X.ManageOfferSuccessResult(
@@ -143,25 +148,42 @@ class _ManageOfferBase(OperationFrame):
             # fully crossed (or dust): nothing rests on the book
             if not creating:
                 acc_e = load_account(ltx, src)
+                if sponsorship.entry_sponsor(existing) is not None:
+                    sponsorship.release_entry_sponsorship(
+                        ltx, header, existing, acc_e)
                 acc_e.data.value.numSubEntries -= 1
                 ltx.update(acc_e)
             return self.success(X.ManageOfferSuccessResult(
                 offersClaimed=cross.offers_claimed,
                 offer=X.ManageOfferSuccessResultOffer(EFF.MANAGE_OFFER_DELETED)))
 
-        if creating:
-            acc_e = load_account(ltx, src)
-            if not utils.add_num_entries(header, acc_e.data.value, 1):
-                return self.result(C("LOW_RESERVE"))
-            ltx.update(acc_e)
-            offer_id = _generate_offer_id(ltx)
         offer = X.OfferEntry(
             sellerID=src, offerID=offer_id, selling=selling, buying=buying,
             amount=new_amount, price=price,
             flags=X.OfferEntryFlags.PASSIVE_FLAG if self.PASSIVE else 0)
-        ltx.create(X.LedgerEntry(
+        new_ledger_entry = X.LedgerEntry(
             lastModifiedLedgerSeq=header.ledgerSeq,
-            data=X.LedgerEntryData.offer(offer)))
+            data=X.LedgerEntryData.offer(offer))
+        if creating:
+            acc_e = load_account(ltx, src)
+            code, sponsored = sponsorship.create_entry_with_possible_sponsorship(
+                ltx, header, self.tx, new_ledger_entry, acc_e,
+                src if header.ledgerVersion >= 14 else None)
+            bad = self.sponsorship_error(code, C("LOW_RESERVE"))
+            if bad is not None:
+                return bad
+            if sponsored:
+                acc_e.data.value.numSubEntries += 1
+            elif not utils.add_num_entries(header, acc_e.data.value, 1):
+                return self.result(C("LOW_RESERVE"))
+            ltx.update(acc_e)
+            offer_id = _generate_offer_id(ltx)
+            offer.offerID = offer_id
+        elif old_ext is not None:
+            # update path (erase + recreate with the same id): the entry's
+            # sponsorship, if any, carries over unchanged
+            new_ledger_entry.ext = old_ext
+        ltx.create(new_ledger_entry)
         if not acquire_or_release_offer_liabilities(ltx, offer, acquire=True):
             return self.result(C("LINE_FULL"))
         return self.success(X.ManageOfferSuccessResult(

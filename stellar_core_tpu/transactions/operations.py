@@ -7,8 +7,9 @@ Protocol level: current classic semantics.
 Implemented here: CreateAccount, Payment, ManageData, BumpSequence,
 SetOptions, ChangeTrust, AllowTrust, AccountMerge, Inflation,
 CreateClaimableBalance, ClaimClaimableBalance, Clawback,
-ClawbackClaimableBalance, SetTrustLineFlags, Begin/End/RevokeSponsorship
-(basic, no full sponsorship bookkeeping yet).  Offers, path payments and
+ClawbackClaimableBalance, SetTrustLineFlags, and the full CAP-33
+sponsorship set (Begin/End/RevokeSponsorship with per-entry and per-signer
+reserve bookkeeping — see sponsorship.py).  Offers, path payments and
 liquidity pools live in offer_exchange.py; Soroban ops return
 opNOT_SUPPORTED (capability gap per SURVEY.md §2.4 — no wasm host).
 """
@@ -20,7 +21,7 @@ from typing import Optional
 from .. import xdr as X
 from ..crypto.sha import sha256
 from ..ledger.ledger_txn import LedgerTxn
-from . import utils
+from . import sponsorship, utils
 from .signature_checker import SignatureChecker
 from .utils import (INT64_MAX, THRESHOLD_HIGH, THRESHOLD_LOW, THRESHOLD_MED,
                     add_balance, add_num_entries,
@@ -100,6 +101,18 @@ class OperationFrame:
     def success(self, value=None) -> X.OperationResult:
         return self.result(self.RESULT_CLS._switch_type.enum_cls(0), value)
 
+    def sponsorship_error(self, code: int,
+                          low_reserve_code) -> Optional[X.OperationResult]:
+        """Map a SponsorshipResult to this op's result: None on SUCCESS,
+        the op-specific LOW_RESERVE on reserve failure, the outer
+        opTOO_MANY_SPONSORING on counter overflow (the real XDR has no
+        opTOO_MANY_SPONSORED; the reference maps both overflows here)."""
+        if code == sponsorship.SUCCESS:
+            return None
+        if code == sponsorship.LOW_RESERVE:
+            return self.result(low_reserve_code)
+        return X.OperationResult(ORC.opTOO_MANY_SPONSORING)
+
 
 class UnsupportedOpFrame(OperationFrame):
     def check_valid(self, checker, ltx):
@@ -121,9 +134,12 @@ class CreateAccountOpFrame(OperationFrame):
     C = X.CreateAccountResultCode
 
     def do_check_valid(self, ltx):
-        if self.body.startingBalance <= 0:
-            # pre-v14 rule was <=0; v14+ allows 0 for sponsored accounts —
-            # sponsorship path not wired yet, keep strict
+        # v14+ (CAP-33) allows startingBalance == 0 — a sponsored account
+        # needs no balance of its own; pre-v14 requires > 0
+        min_ok = (self.body.startingBalance >= 0
+                  if ltx.get_header().ledgerVersion >= 14
+                  else self.body.startingBalance > 0)
+        if not min_ok:
             return self.result(self.C.CREATE_ACCOUNT_MALFORMED)
         if self.body.destination == self.source_account_id():
             return self.result(self.C.CREATE_ACCOUNT_MALFORMED)
@@ -134,20 +150,36 @@ class CreateAccountOpFrame(OperationFrame):
         dest_key = utils.account_key(self.body.destination)
         if ltx.exists(dest_key):
             return self.result(self.C.CREATE_ACCOUNT_ALREADY_EXIST)
-        src_e = load_account(ltx, self.source_account_id())
-        src = src_e.data.value
-        if self.body.startingBalance < 2 * header.baseReserve:
-            return self.result(self.C.CREATE_ACCOUNT_LOW_RESERVE)
-        if not add_balance(src, -self.body.startingBalance, header):
-            return self.result(self.C.CREATE_ACCOUNT_UNDERFUNDED)
-        ltx.update(src_e)
         new_acc = X.AccountEntry(
             accountID=self.body.destination,
             balance=self.body.startingBalance,
             seqNum=starting_sequence_number(header))
-        ltx.create(X.LedgerEntry(
+        new_entry = X.LedgerEntry(
             lastModifiedLedgerSeq=header.ledgerSeq,
-            data=X.LedgerEntryData.account(new_acc)))
+            data=X.LedgerEntryData.account(new_acc))
+        sponsor_id = (sponsorship.active_sponsor(self.tx, self.body.destination)
+                      if header.ledgerVersion >= 14 else None)
+        if sponsor_id is not None:
+            # sponsored create: the sponsor's reserve covers the new
+            # account's 2 base reserves (mult=2); checked BEFORE the source
+            # pays the starting balance, so the sponsor check sees the
+            # pre-transfer state.  The source is loaded only afterwards —
+            # the sandwich sponsor may BE the op source, and a copy held
+            # across establish would clobber its numSponsoring update.
+            code = sponsorship.establish_entry_sponsorship(
+                ltx, header, new_entry, sponsor_id, new_entry)
+            bad = self.sponsorship_error(
+                code, self.C.CREATE_ACCOUNT_LOW_RESERVE)
+            if bad is not None:
+                return bad
+        elif self.body.startingBalance < 2 * header.baseReserve:
+            return self.result(self.C.CREATE_ACCOUNT_LOW_RESERVE)
+        src_e = load_account(ltx, self.source_account_id())
+        src = src_e.data.value
+        if not add_balance(src, -self.body.startingBalance, header):
+            return self.result(self.C.CREATE_ACCOUNT_UNDERFUNDED)
+        ltx.update(src_e)
+        ltx.create(new_entry)
         return self.success()
 
 
@@ -251,18 +283,33 @@ class ManageDataOpFrame(OperationFrame):
             if existing is None:
                 return self.result(self.C.MANAGE_DATA_NAME_NOT_FOUND)
             ltx.erase(key)
-            add_num_entries(header, src, -1)
+            if sponsorship.entry_sponsor(existing) is not None:
+                sponsorship.release_entry_sponsorship(
+                    ltx, header, existing, src_e)
+                src.numSubEntries -= 1
+            else:
+                add_num_entries(header, src, -1)
             ltx.update(src_e)
             return self.success()
         if existing is None:
-            if not add_num_entries(header, src, 1):
-                return self.result(self.C.MANAGE_DATA_LOW_RESERVE)
-            ltx.update(src_e)
-            ltx.create(X.LedgerEntry(
+            new_entry = X.LedgerEntry(
                 lastModifiedLedgerSeq=header.ledgerSeq,
                 data=X.LedgerEntryData.data(X.DataEntry(
                     accountID=src_id, dataName=self.body.dataName,
-                    dataValue=self.body.dataValue))))
+                    dataValue=self.body.dataValue)))
+            code, sponsored = sponsorship.create_entry_with_possible_sponsorship(
+                ltx, header, self.tx, new_entry, src_e,
+                src_id if header.ledgerVersion >= 14 else None)
+            bad = self.sponsorship_error(
+                code, self.C.MANAGE_DATA_LOW_RESERVE)
+            if bad is not None:
+                return bad
+            if sponsored:
+                src.numSubEntries += 1
+            elif not add_num_entries(header, src, 1):
+                return self.result(self.C.MANAGE_DATA_LOW_RESERVE)
+            ltx.update(src_e)
+            ltx.create(new_entry)
         else:
             existing.data.value.dataValue = self.body.dataValue
             existing.lastModifiedLedgerSeq = header.ledgerSeq
@@ -370,19 +417,45 @@ class SetOptionsOpFrame(OperationFrame):
                         if s.key == b.signer.key), None)
             if b.signer.weight == 0:
                 if idx is not None:
+                    sponsor_id = sponsorship.signer_sponsor(src, idx)
                     signers.pop(idx)
-                    if not add_num_entries(header, src, -1):
+                    src.signers = signers
+                    sponsorship.record_signer_remove(src, idx)
+                    if sponsor_id is not None:
+                        # sponsored signer: release the sponsor's reserve,
+                        # no reserve movement on the owner
+                        sponsorship.release_signer_sponsorship(
+                            ltx, header, sponsor_id, src_e)
+                        src.numSubEntries -= 1
+                    elif not add_num_entries(header, src, -1):
                         return self.result(C.SET_OPTIONS_LOW_RESERVE)
             elif idx is not None:
+                # weight update: sponsorship (if any) is untouched
                 signers[idx] = b.signer
+                src.signers = signers
             else:
                 if len(signers) >= X.MAX_SIGNERS:
                     return self.result(C.SET_OPTIONS_TOO_MANY_SIGNERS)
-                if not add_num_entries(header, src, 1):
+                sponsor_id = (sponsorship.active_sponsor(
+                    self.tx, self.source_account_id())
+                    if header.ledgerVersion >= 14 else None)
+                if sponsor_id is not None:
+                    code = sponsorship.establish_signer_sponsorship(
+                        ltx, header, sponsor_id, src_e)
+                    bad = self.sponsorship_error(
+                        code, C.SET_OPTIONS_LOW_RESERVE)
+                    if bad is not None:
+                        return bad
+                    src.numSubEntries += 1
+                elif not add_num_entries(header, src, 1):
                     return self.result(C.SET_OPTIONS_LOW_RESERVE)
-                signers.append(b.signer)
-            signers.sort(key=lambda s: s.key.to_xdr())
-            src.signers = signers
+                # sorted insert keeps signerSponsoringIDs index-aligned
+                key = b.signer.key.to_xdr()
+                pos = next((i for i, s in enumerate(signers)
+                            if s.key.to_xdr() > key), len(signers))
+                signers.insert(pos, b.signer)
+                src.signers = signers
+                sponsorship.record_signer_insert(src, pos, sponsor_id)
         src_e.lastModifiedLedgerSeq = header.ledgerSeq
         ltx.update(src_e)
         return self.success()
@@ -434,21 +507,30 @@ class ChangeTrustOpFrame(OperationFrame):
                 utils.account_key(asset.value.issuer).to_xdr())
             if issuer_e is None:
                 return self.result(C.CHANGE_TRUST_NO_ISSUER)
-            if not add_num_entries(header, src, 1):
-                return self.result(C.CHANGE_TRUST_LOW_RESERVE)
             flags = 0
             issuer = issuer_e.data.value
             if not (issuer.flags & X.AccountFlags.AUTH_REQUIRED_FLAG):
                 flags |= X.TrustLineFlags.AUTHORIZED_FLAG
             if issuer.flags & X.AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG:
                 flags |= X.TrustLineFlags.TRUSTLINE_CLAWBACK_ENABLED_FLAG
-            ltx.update(src_e)
-            ltx.create(X.LedgerEntry(
+            new_entry = X.LedgerEntry(
                 lastModifiedLedgerSeq=header.ledgerSeq,
                 data=X.LedgerEntryData.trustLine(X.TrustLineEntry(
                     accountID=src_id,
                     asset=asset_to_trustline_asset(asset),
-                    balance=0, limit=self.body.limit, flags=flags))))
+                    balance=0, limit=self.body.limit, flags=flags)))
+            code, sponsored = sponsorship.create_entry_with_possible_sponsorship(
+                ltx, header, self.tx, new_entry, src_e,
+                src_id if header.ledgerVersion >= 14 else None)
+            bad = self.sponsorship_error(code, C.CHANGE_TRUST_LOW_RESERVE)
+            if bad is not None:
+                return bad
+            if sponsored:
+                src.numSubEntries += 1
+            elif not add_num_entries(header, src, 1):
+                return self.result(C.CHANGE_TRUST_LOW_RESERVE)
+            ltx.update(src_e)
+            ltx.create(new_entry)
             return self.success()
         tl = existing.data.value
         if self.body.limit == 0:
@@ -458,7 +540,12 @@ class ChangeTrustOpFrame(OperationFrame):
             if buying or selling:
                 return self.result(C.CHANGE_TRUST_CANNOT_DELETE)
             ltx.erase(key)
-            add_num_entries(header, src, -1)
+            if sponsorship.entry_sponsor(existing) is not None:
+                sponsorship.release_entry_sponsorship(
+                    ltx, header, existing, src_e)
+                src.numSubEntries -= 1
+            else:
+                add_num_entries(header, src, -1)
             ltx.update(src_e)
             return self.success()
         buying, _ = utils.trustline_liabilities(tl)
@@ -508,7 +595,22 @@ class ChangeTrustOpFrame(OperationFrame):
                     return self.result(C.CHANGE_TRUST_NOT_AUTH_MAINTAIN_LIABILITIES)
                 self._bump_pool_use(tl_e, +1)
                 ltx.update(tl_e)
-            if not add_num_entries(header, src, 2):
+            new_tl_entry = X.LedgerEntry(
+                lastModifiedLedgerSeq=header.ledgerSeq,
+                data=X.LedgerEntryData.trustLine(X.TrustLineEntry(
+                    accountID=src_id,
+                    asset=X.TrustLineAsset.liquidityPoolID(pool_id),
+                    balance=0, limit=self.body.limit,
+                    flags=X.TrustLineFlags.AUTHORIZED_FLAG)))
+            code, sponsored = sponsorship.create_entry_with_possible_sponsorship(
+                ltx, header, self.tx, new_tl_entry, src_e,
+                src_id if header.ledgerVersion >= 18 else None)
+            bad = self.sponsorship_error(code, C.CHANGE_TRUST_LOW_RESERVE)
+            if bad is not None:
+                return bad
+            if sponsored:
+                src.numSubEntries += 2
+            elif not add_num_entries(header, src, 2):
                 return self.result(C.CHANGE_TRUST_LOW_RESERVE)
             ltx.update(src_e)
             pe = ltx.load(pool_key)
@@ -524,13 +626,7 @@ class ChangeTrustOpFrame(OperationFrame):
             else:
                 pe.data.value.body.value.poolSharesTrustLineCount += 1
                 ltx.update(pe)
-            ltx.create(X.LedgerEntry(
-                lastModifiedLedgerSeq=header.ledgerSeq,
-                data=X.LedgerEntryData.trustLine(X.TrustLineEntry(
-                    accountID=src_id,
-                    asset=X.TrustLineAsset.liquidityPoolID(pool_id),
-                    balance=0, limit=self.body.limit,
-                    flags=X.TrustLineFlags.AUTHORIZED_FLAG))))
+            ltx.create(new_tl_entry)
             return self.success()
 
         tl = existing.data.value
@@ -538,7 +634,12 @@ class ChangeTrustOpFrame(OperationFrame):
             if tl.balance != 0:
                 return self.result(C.CHANGE_TRUST_INVALID_LIMIT)
             ltx.erase(key)
-            add_num_entries(header, src, -2)
+            if sponsorship.entry_sponsor(existing) is not None:
+                sponsorship.release_entry_sponsorship(
+                    ltx, header, existing, src_e)
+                src.numSubEntries -= 2
+            else:
+                add_num_entries(header, src, -2)
             ltx.update(src_e)
             pe = ltx.load(pool_key)
             cp = pe.data.value.body.value
@@ -677,6 +778,10 @@ class AccountMergeOpFrame(OperationFrame):
             return self.result(C.ACCOUNT_MERGE_DEST_FULL)
         dest_e.lastModifiedLedgerSeq = header.ledgerSeq
         ltx.update(dest_e)
+        # a sponsored account entry releases its sponsor's 2 reserve units
+        # when it leaves the ledger (removeEntryWithPossibleSponsorship);
+        # the dying account's own numSponsored vanishes with it
+        sponsorship.release_entry_sponsorship(ltx, header, src_e, None)
         ltx.erase(utils.account_key(src_id))
         return self.result(C.ACCOUNT_MERGE_SUCCESS, balance)
 
@@ -725,13 +830,28 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
         header = ltx.get_header()
         b = self.body
         src_id = self.source_account_id()
+        # reserve for claimants is a sponsored reserve (reference:
+        # CreateClaimableBalanceOpFrame — createEntryWithPossibleSponsorship;
+        # the entry has no owner account, so only numSponsoring moves).
+        # With an active sandwich for the source, the sandwich sponsor takes
+        # it; otherwise the source sponsors its own creation.
+        sponsor_id = sponsorship.active_sponsor(self.tx, src_id) or src_id
+        if sponsor_id != src_id:
+            # external sponsor: counters move on the sponsor inside the
+            # helper (one unit per claimant; the entry is owner-less); the
+            # source is loaded afterwards so its copy cannot clobber a
+            # sponsor update
+            code = sponsorship.establish_sponsorship(
+                ltx, header, sponsor_id, None, len(b.claimants))
+            bad = self.sponsorship_error(
+                code, C.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
+            if bad is not None:
+                return bad
         src_e = load_account(ltx, src_id)
         src = src_e.data.value
-        # reserve for claimants is a sponsored reserve on the source, not a
-        # subentry (reference: CreateClaimableBalanceOpFrame — the entry is
-        # created with createEntryWithPossibleSponsorship; numSponsoring)
-        if not utils.add_num_sponsoring(header, src, len(b.claimants)):
-            return self.result(C.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
+        if sponsor_id == src_id:
+            if not utils.add_num_sponsoring(header, src, len(b.claimants)):
+                return self.result(C.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
         if b.asset.switch == X.AssetType.ASSET_TYPE_NATIVE:
             if not add_balance(src, -b.amount, header):
                 return self.result(C.CREATE_CLAIMABLE_BALANCE_UNDERFUNDED)
@@ -767,7 +887,7 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
             lastModifiedLedgerSeq=header.ledgerSeq,
             data=X.LedgerEntryData.claimableBalance(entry),
             ext=X.LedgerEntryExt.v1(X.LedgerEntryExtensionV1(
-                sponsoringID=src_id,
+                sponsoringID=sponsor_id,
                 ext=X.LedgerEntryExtensionV1Ext.v0()))))
         return self.result(C.CREATE_CLAIMABLE_BALANCE_SUCCESS, bid)
 
@@ -989,9 +1109,10 @@ class SetTrustLineFlagsOpFrame(OperationFrame):
 
 class BeginSponsoringFutureReservesOpFrame(OperationFrame):
     """Reference: src/transactions/BeginSponsoringFutureReservesOpFrame.cpp.
-    Round-1 scope: tracked in the apply context so Begin/End pair validates,
-    but per-entry sponsorship bookkeeping is not yet wired into entry
-    creation (documented gap)."""
+    Opens a sandwich: until the sponsored account's
+    EndSponsoringFutureReserves, every reserve created FOR that account
+    (entries via create_entry_with_possible_sponsorship, signers via
+    establish_signer_sponsorship) is sponsored by this op's source."""
     MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.BEGIN_SPONSORING_FUTURE_RESERVES
     RESULT_CLS = X.BeginSponsoringFutureReservesResult
@@ -1010,10 +1131,14 @@ class BeginSponsoringFutureReservesOpFrame(OperationFrame):
         sponsor = self.source_account_id().to_xdr()
         if sponsored in ctx:
             return self.result(C.BEGIN_SPONSORING_FUTURE_RESERVES_ALREADY_SPONSORED)
+        # RECURSIVE guards (reference: no sponsorship chains): the sponsor
+        # must not itself be inside a sandwich, and the sponsored account
+        # must not currently be sponsoring someone.  A sponsor MAY open
+        # several concurrent sandwiches for different accounts.
         if sponsor in ctx:
             return self.result(C.BEGIN_SPONSORING_FUTURE_RESERVES_RECURSIVE)
-        for sponsored_of in ctx.values():
-            if sponsored_of == sponsor:
+        for sponsor_of in ctx.values():
+            if sponsor_of == sponsored:
                 return self.result(C.BEGIN_SPONSORING_FUTURE_RESERVES_RECURSIVE)
         ctx[sponsored] = sponsor
         return self.success()
@@ -1036,27 +1161,138 @@ class EndSponsoringFutureReservesOpFrame(OperationFrame):
 
 
 class RevokeSponsorshipOpFrame(OperationFrame):
-    """Round-1 scope: structure + DOES_NOT_EXIST/NOT_SPONSOR paths; full
-    reserve-transfer logic arrives with sponsorship bookkeeping."""
+    """Reference: src/transactions/RevokeSponsorshipOpFrame.cpp —
+    updateLedgerEntrySponsorship / updateSignerSponsorship.
+
+    Semantics (CAP-33): the op source must be the entry's CURRENT sponsor
+    (when sponsored) or its owner (when not).  The NEW sponsor is the
+    active sandwich sponsor of the op source, if any:
+      old=None, new=None  -> no-op SUCCESS
+      old=None, new=S     -> establish (owner numSponsored+, S numSponsoring+)
+      old=S1,  new=None   -> remove: reserve returns to the owner, which
+                             must afford it (LOW_RESERVE); claimable
+                             balances have no owner -> ONLY_TRANSFERABLE
+      old=S1,  new=S2     -> transfer (S1 releases, S2 takes w/ checks)
+    The canonical transfer recipe is therefore: S2 begins a sandwich FOR S1
+    (the current sponsor), S1 runs RevokeSponsorship, S1 ends it."""
     MIN_PROTOCOL_VERSION = 14
     OP_TYPE = OT.REVOKE_SPONSORSHIP
     RESULT_CLS = X.RevokeSponsorshipResult
     C = X.RevokeSponsorshipResultCode
 
+    _SPONSORABLE = (X.LedgerEntryType.ACCOUNT, X.LedgerEntryType.TRUSTLINE,
+                    X.LedgerEntryType.OFFER, X.LedgerEntryType.DATA,
+                    X.LedgerEntryType.CLAIMABLE_BALANCE)
+
+    @staticmethod
+    def _owner_of(key: X.LedgerKey):
+        t = key.switch
+        if t == X.LedgerEntryType.ACCOUNT:
+            return key.value.accountID
+        if t in (X.LedgerEntryType.TRUSTLINE, X.LedgerEntryType.DATA):
+            return key.value.accountID
+        if t == X.LedgerEntryType.OFFER:
+            return key.value.sellerID
+        return None  # claimable balance: owner-less reserve
+
     def do_apply(self, ltx):
         C = self.C
-        if self.op.body.value.switch == \
-                X.RevokeSponsorshipType.REVOKE_SPONSORSHIP_LEDGER_ENTRY:
-            key = self.op.body.value.value
-            if not ltx.exists(key):
-                return self.result(C.REVOKE_SPONSORSHIP_DOES_NOT_EXIST)
+        header = ltx.get_header()
+        src = self.source_account_id()
+        new_sponsor = sponsorship.active_sponsor(self.tx, src)
+        arm = self.op.body.value
+        if arm.switch == X.RevokeSponsorshipType.REVOKE_SPONSORSHIP_LEDGER_ENTRY:
+            key = arm.value
+            if key.switch not in self._SPONSORABLE:
+                return self.result(C.REVOKE_SPONSORSHIP_MALFORMED)
             entry = ltx.load(key)
-            sponsor = (entry.ext.value.sponsoringID
-                       if entry.ext.switch == 1 else None)
-            if sponsor is None:
-                return self.success()  # not sponsored: no-op success
+            if entry is None:
+                return self.result(C.REVOKE_SPONSORSHIP_DOES_NOT_EXIST)
+            owner_id = self._owner_of(key)
+            old_sponsor = sponsorship.entry_sponsor(entry)
+            if new_sponsor is not None and new_sponsor == owner_id:
+                # transferring to the owner == the owner reclaiming its own
+                # reserve: a removal, never a self-sponsorship record
+                new_sponsor = None
+            if old_sponsor is not None:
+                if src != old_sponsor:
+                    return self.result(C.REVOKE_SPONSORSHIP_NOT_SPONSOR)
+            elif owner_id is None or src != owner_id:
+                return self.result(C.REVOKE_SPONSORSHIP_NOT_SPONSOR)
+            if old_sponsor == new_sponsor or \
+                    (old_sponsor is None and new_sponsor is None):
+                return self.success()
+            mult = sponsorship.compute_multiplier(entry)
+            # the owner's account entry, when the owner is not the entry
+            # itself (an ACCOUNT key's owner IS the entry)
+            own_is_entry = key.switch == X.LedgerEntryType.ACCOUNT
+            owner_e = entry if own_is_entry else (
+                load_account(ltx, owner_id) if owner_id is not None else None)
+            if old_sponsor is not None:
+                if new_sponsor is None and owner_id is None:
+                    return self.result(C.REVOKE_SPONSORSHIP_ONLY_TRANSFERABLE)
+                if new_sponsor is None:
+                    owner = owner_e.data.value
+                    if not sponsorship.owner_can_afford(header, owner, mult):
+                        return self.result(C.REVOKE_SPONSORSHIP_LOW_RESERVE)
+                sponsorship.release_entry_sponsorship(
+                    ltx, header, entry, owner_e)
+                entry.ext = X.LedgerEntryExt.v0()
+            if new_sponsor is not None:
+                code = sponsorship.establish_entry_sponsorship(
+                    ltx, header, entry, new_sponsor, owner_e)
+                bad = self.sponsorship_error(
+                    code, C.REVOKE_SPONSORSHIP_LOW_RESERVE)
+                if bad is not None:
+                    return bad
+            entry.lastModifiedLedgerSeq = header.ledgerSeq
+            ltx.update(entry)
+            if owner_e is not None and not own_is_entry:
+                owner_e.lastModifiedLedgerSeq = header.ledgerSeq
+                ltx.update(owner_e)
+            return self.success()
+
+        # SIGNER arm
+        acc_id = arm.value.accountID
+        signer_key = arm.value.signerKey
+        acc_e = load_account(ltx, acc_id)
+        if acc_e is None:
+            return self.result(C.REVOKE_SPONSORSHIP_DOES_NOT_EXIST)
+        acc = acc_e.data.value
+        idx = next((i for i, s in enumerate(acc.signers)
+                    if s.key == signer_key), None)
+        if idx is None:
+            return self.result(C.REVOKE_SPONSORSHIP_DOES_NOT_EXIST)
+        old_sponsor = sponsorship.signer_sponsor(acc, idx)
+        if new_sponsor is not None and new_sponsor == acc_id:
+            new_sponsor = None  # owner reclaiming its own reserve
+        if old_sponsor is not None:
+            if src != old_sponsor:
+                return self.result(C.REVOKE_SPONSORSHIP_NOT_SPONSOR)
+        elif src != acc_id:
             return self.result(C.REVOKE_SPONSORSHIP_NOT_SPONSOR)
-        return self.result(C.REVOKE_SPONSORSHIP_DOES_NOT_EXIST)
+        if old_sponsor == new_sponsor or \
+                (old_sponsor is None and new_sponsor is None):
+            return self.success()
+        if old_sponsor is not None:
+            if new_sponsor is None and not sponsorship.owner_can_afford(
+                    header, acc, 1):
+                return self.result(C.REVOKE_SPONSORSHIP_LOW_RESERVE)
+            sponsorship.release_signer_sponsorship(
+                ltx, header, old_sponsor, acc_e)
+        if new_sponsor is not None:
+            code = sponsorship.establish_signer_sponsorship(
+                ltx, header, new_sponsor, acc_e)
+            bad = self.sponsorship_error(
+                code, C.REVOKE_SPONSORSHIP_LOW_RESERVE)
+            if bad is not None:
+                return bad
+        ids = sponsorship._aligned_sponsoring_ids(acc)
+        ids[idx] = new_sponsor
+        utils._acc_ext_v2(acc).signerSponsoringIDs = ids
+        acc_e.lastModifiedLedgerSeq = header.ledgerSeq
+        ltx.update(acc_e)
+        return self.success()
 
 
 def _sponsorship_ctx(tx_frame) -> dict:

@@ -75,13 +75,17 @@ def _acc_ext_v2(acc: X.AccountEntry):
 
 def _ensure_acc_ext_v2(acc: X.AccountEntry) -> X.AccountEntryExtensionV2:
     """Materialize the v1+v2 extension chain (reference: prepareAccountEntry
-    extension upgrade on first sponsorship use)."""
+    extension upgrade on first sponsorship use).  signerSponsoringIDs is
+    padded to the signer count on materialization so the invariant
+    len(signerSponsoringIDs) == len(signers) holds from the first
+    sponsorship touch (reference: AccountEntry extension constraints)."""
     if acc.ext.switch == 0:
         acc.ext = X.AccountEntryExt.v1(X.AccountEntryExtensionV1(
             liabilities=X.Liabilities(buying=0, selling=0)))
     v1 = acc.ext.value
     if v1.ext.switch != 2:
-        v1.ext = X.AccountEntryExtensionV1Ext.v2(X.AccountEntryExtensionV2())
+        v1.ext = X.AccountEntryExtensionV1Ext.v2(X.AccountEntryExtensionV2(
+            signerSponsoringIDs=[None] * len(acc.signers)))
     return v1.ext.value
 
 

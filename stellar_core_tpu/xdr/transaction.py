@@ -1010,3 +1010,4 @@ ManageOfferSuccessResultOffer = _ManageOfferSuccessOffer
 PathPaymentStrictReceiveResultSuccess = _PPSRSuccess
 PathPaymentStrictSendResultSuccess = _PPSSSuccess
 OperationIDId = _OperationIDId
+RevokeSponsorshipOpSigner = _RevokeSponsorshipSigner

@@ -27,6 +27,15 @@ except ImportError:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Native extensions are built on demand (they are not tracked in git; a
+# stale binary would defeat the C-vs-Python differential tests).
+from stellar_core_tpu._native_build import ensure_native  # noqa: E402
+
+if not ensure_native(quiet=False):
+    sys.stderr.write(
+        "WARNING: native extensions failed to build — C-vs-Python "
+        "differential tests will skip and cannot validate native/*.c\n")
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long end-to-end tests")

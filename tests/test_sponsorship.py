@@ -1,0 +1,551 @@
+"""CAP-33 sponsored-reserve tests.
+
+Mirrors reference coverage in src/transactions/test/
+{BeginSponsoringFutureReservesTests, EndSponsoringFutureReservesTests,
+RevokeSponsorshipTests}.cpp: sandwiched entry/signer creation for every
+sponsorable type, revoke transfer/remove on both arms, reserve-failure
+paths, and the tx-level txBAD_SPONSORSHIP for unclosed sandwiches —
+driven through LedgerManager.close_ledger with all invariants enabled
+(SponsorshipCountIsValid validates every close's bookkeeping).
+"""
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.testutils import (TestAccount, build_tx,
+                                        change_trust_op, create_account_op,
+                                        make_asset, manage_sell_offer_op,
+                                        native_payment_op, network_id)
+from stellar_core_tpu.transactions import sponsorship
+from stellar_core_tpu.transactions.utils import (num_sponsored,
+                                                 num_sponsoring)
+
+NID = network_id("tpu-core sponsorship network")
+
+
+@pytest.fixture
+def mgr():
+    m = LedgerManager(NID)
+    m.start_new_ledger()
+    return m
+
+
+@pytest.fixture
+def root(mgr):
+    sk = mgr.root_account_secret()
+    acc = mgr.root.get_entry(
+        X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, acc.data.value.seqNum)
+
+
+def _close(mgr, *frames, close_time=1000):
+    return mgr.close_ledger(list(frames), close_time)
+
+
+def _result_of(arts, frame):
+    for pair in arts.result_entry.txResultSet.results:
+        if pair.transactionHash == frame.content_hash():
+            return pair.result
+    raise AssertionError("tx not in result set")
+
+
+def _acc_entry(mgr, account_id):
+    return mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=account_id)).to_xdr())
+
+
+def _acc(mgr, account_id):
+    e = _acc_entry(mgr, account_id)
+    return e.data.value if e else None
+
+
+def _mk(mgr, root, seed, balance=20_000_000_000):
+    sk = SecretKey(bytes([seed]) * 32)
+    _close(mgr, root.tx([create_account_op(
+        X.AccountID.ed25519(sk.public_key.ed25519), balance)]))
+    acc = _acc(mgr, X.AccountID.ed25519(sk.public_key.ed25519))
+    return TestAccount(mgr, sk, acc.seqNum)
+
+
+def begin_op(sponsored: X.AccountID, source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.beginSponsoringFutureReservesOp(
+            X.BeginSponsoringFutureReservesOp(sponsoredID=sponsored)))
+
+
+def end_op(source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.endSponsoringFutureReserves())
+
+
+def revoke_entry_op(key: X.LedgerKey, source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.revokeSponsorshipOp(
+            X.RevokeSponsorshipOp.ledgerKey(key)))
+
+
+def revoke_signer_op(account: X.AccountID, signer_key: X.SignerKey,
+                     source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.revokeSponsorshipOp(
+            X.RevokeSponsorshipOp.signer(X.RevokeSponsorshipOpSigner(
+                accountID=account, signerKey=signer_key))))
+
+
+def set_signer_op(key_bytes: bytes, weight: int, source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.setOptionsOp(X.SetOptionsOp(
+            signer=X.Signer(key=X.SignerKey.ed25519(key_bytes),
+                            weight=weight))))
+
+
+def manage_data_op(name: bytes, value, source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.manageDataOp(X.ManageDataOp(
+            dataName=name, dataValue=value)))
+
+
+def _sandwich_tx(sponsor: TestAccount, sponsored: TestAccount, ops):
+    """sponsor Begins for `sponsored`, the sandwiched ops run as
+    `sponsored`'s, then `sponsored` Ends — all one tx signed by both."""
+    body = [begin_op(sponsored.account_id, source=sponsor.account_id)]
+    body += ops
+    body.append(end_op(source=sponsored.account_id))
+    return build_tx(NID, sponsor.secret, sponsor.next_seq(), body,
+                    extra_signers=[sponsored.secret])
+
+
+# --- sponsored creation, one per entry type --------------------------------
+
+def test_sponsored_create_account_zero_balance(mgr, root):
+    s = _mk(mgr, root, 1)
+    new_sk = SecretKey(bytes([9]) * 32)
+    new_id = X.AccountID.ed25519(new_sk.public_key.ed25519)
+    # destination sandwiched: the sponsor covers the 2 base reserves, so a
+    # 0-balance create succeeds at v14+
+    ops = [begin_op(new_id, source=s.account_id),
+           create_account_op(new_id, 0, source=s.account_id),
+           end_op(source=new_id)]
+    tx = build_tx(NID, s.secret, s.next_seq(), ops,
+                  extra_signers=[new_sk])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txSUCCESS
+    new_e = _acc_entry(mgr, new_id)
+    assert new_e.ext.switch == 1
+    assert new_e.ext.value.sponsoringID == s.account_id
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 2
+    assert num_sponsored(_acc(mgr, new_id)) == 2
+
+
+def test_unsponsored_zero_balance_create_fails(mgr, root):
+    s = _mk(mgr, root, 2)
+    new_id = X.AccountID.ed25519(SecretKey(bytes([8]) * 32).public_key.ed25519)
+    tx = s.tx([create_account_op(new_id, 0)])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[0].value.value
+    assert op_res.switch == X.CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE
+
+
+def test_sponsored_trustline(mgr, root):
+    s = _mk(mgr, root, 3)
+    a = _mk(mgr, root, 4)
+    issuer = _mk(mgr, root, 5)
+    asset = make_asset("USD", issuer.account_id)
+    tx = _sandwich_tx(s, a, [change_trust_op(asset, source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    tl = mgr.root.get_entry(X.LedgerKey.trustLine(X.LedgerKeyTrustLine(
+        accountID=a.account_id,
+        asset=X.TrustLineAsset(asset.switch, asset.value))).to_xdr())
+    assert tl.ext.switch == 1 and tl.ext.value.sponsoringID == s.account_id
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 1
+    assert num_sponsored(_acc(mgr, a.account_id)) == 1
+    acc_a = _acc(mgr, a.account_id)
+    assert acc_a.numSubEntries == 1
+
+
+def test_sponsored_data_entry_and_offer(mgr, root):
+    s = _mk(mgr, root, 6)
+    a = _mk(mgr, root, 7)
+    issuer = _mk(mgr, root, 8)
+    asset = make_asset("EUR", issuer.account_id)
+    # data entry
+    tx = _sandwich_tx(s, a, [manage_data_op(b"k1", b"v1",
+                                            source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    # offer needs a trustline first (unsponsored, a pays)
+    _close(mgr, a.tx([change_trust_op(asset)]))
+    tx2 = _sandwich_tx(s, a, [manage_sell_offer_op(
+        X.Asset.native(), asset, 1000, 1, 1, source=a.account_id)])
+    arts2 = _close(mgr, tx2)
+    assert _result_of(arts2, tx2).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 2  # data + offer
+    assert num_sponsored(_acc(mgr, a.account_id)) == 2
+
+
+def test_sponsored_signer(mgr, root):
+    s = _mk(mgr, root, 10)
+    a = _mk(mgr, root, 11)
+    signer_pk = SecretKey(bytes([12]) * 32).public_key.ed25519
+    tx = _sandwich_tx(s, a, [set_signer_op(signer_pk, 1,
+                                           source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    acc = _acc(mgr, a.account_id)
+    assert num_sponsored(acc) == 1
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 1
+    ids = sponsorship.signer_sponsoring_ids(acc)
+    assert len(ids) == len(acc.signers) == 1
+    assert ids[0] == s.account_id
+    # removing the sponsored signer releases the sponsor
+    arts2 = _close(mgr, a.tx([set_signer_op(signer_pk, 0)]))
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
+    assert num_sponsored(_acc(mgr, a.account_id)) == 0
+    assert len(_acc(mgr, a.account_id).signers) == 0
+
+
+def test_signer_sponsoring_ids_stay_aligned(mgr, root):
+    """Unsponsored + sponsored signers interleaved: the ids array tracks
+    the sorted signer list index-for-index."""
+    s = _mk(mgr, root, 13)
+    a = _mk(mgr, root, 14)
+    pks = sorted(bytes([x]) * 32 for x in (40, 140, 240))
+    # add middle signer unsponsored, then outer two sponsored
+    _close(mgr, a.tx([set_signer_op(pks[1], 1)]))
+    tx = _sandwich_tx(s, a, [set_signer_op(pks[0], 1, source=a.account_id),
+                             set_signer_op(pks[2], 1, source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    acc = _acc(mgr, a.account_id)
+    keys = [s_.key.value for s_ in acc.signers]
+    assert keys == pks  # sorted by key xdr (same tag => by bytes)
+    ids = sponsorship.signer_sponsoring_ids(acc)
+    assert ids[0] == s.account_id
+    assert ids[1] is None
+    assert ids[2] == s.account_id
+
+
+# --- failure paths ---------------------------------------------------------
+
+def test_unclosed_sandwich_fails_tx(mgr, root):
+    s = _mk(mgr, root, 15)
+    a = _mk(mgr, root, 16)
+    tx = build_tx(NID, s.secret, s.next_seq(),
+                  [begin_op(a.account_id)])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txBAD_SPONSORSHIP
+    # nothing leaked into the ledger
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
+
+
+def test_end_without_begin(mgr, root):
+    a = _mk(mgr, root, 17)
+    tx = a.tx([end_op()])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[0].value.value
+    assert op_res.switch == X.EndSponsoringFutureReservesResultCode.\
+        END_SPONSORING_FUTURE_RESERVES_NOT_SPONSORED
+
+
+def test_sponsor_low_reserve(mgr, root):
+    # sponsor with exactly its own min balance cannot take a sponsorship
+    base_reserve = mgr.lcl_header.baseReserve
+    s = _mk(mgr, root, 18, balance=2 * base_reserve + 100)
+    a = _mk(mgr, root, 19)
+    signer_pk = SecretKey(bytes([20]) * 32).public_key.ed25519
+    tx = _sandwich_tx(s, a, [set_signer_op(signer_pk, 1,
+                                           source=a.account_id)])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    codes = [r.value.value.switch for r in res.result.value
+             if r.switch == X.OperationResultCode.opINNER]
+    assert X.SetOptionsResultCode.SET_OPTIONS_LOW_RESERVE in codes
+
+
+def test_begin_recursive_and_already(mgr, root):
+    s = _mk(mgr, root, 21)
+    a = _mk(mgr, root, 22)
+    b = _mk(mgr, root, 23)
+    # already sponsored: two Begins for the same account
+    tx = build_tx(NID, s.secret, s.next_seq(),
+                  [begin_op(a.account_id),
+                   begin_op(a.account_id, source=b.account_id),
+                   end_op(source=a.account_id)],
+                  extra_signers=[a.secret, b.secret])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op1 = res.result.value[1].value.value
+    assert op1.switch == X.BeginSponsoringFutureReservesResultCode.\
+        BEGIN_SPONSORING_FUTURE_RESERVES_ALREADY_SPONSORED
+    # recursive: a (sponsored) begins for someone else
+    tx2 = build_tx(NID, s.secret, s.next_seq(),
+                   [begin_op(a.account_id),
+                    begin_op(b.account_id, source=a.account_id),
+                    end_op(source=a.account_id)],
+                   extra_signers=[a.secret, b.secret])
+    arts2 = _close(mgr, tx2)
+    res2 = _result_of(arts2, tx2)
+    assert res2.result.switch == X.TransactionResultCode.txFAILED
+    op21 = res2.result.value[1].value.value
+    assert op21.switch == X.BeginSponsoringFutureReservesResultCode.\
+        BEGIN_SPONSORING_FUTURE_RESERVES_RECURSIVE
+
+
+# --- revoke: ledger-entry arm ---------------------------------------------
+
+def _sponsored_trustline(mgr, root, s, a, issuer_seed=50, code="GBP"):
+    issuer = _mk(mgr, root, issuer_seed)
+    asset = make_asset(code, issuer.account_id)
+    tx = _sandwich_tx(s, a, [change_trust_op(asset, source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    key = X.LedgerKey.trustLine(X.LedgerKeyTrustLine(
+        accountID=a.account_id,
+        asset=X.TrustLineAsset(asset.switch, asset.value)))
+    return key
+
+
+def test_revoke_remove_returns_reserve_to_owner(mgr, root):
+    s = _mk(mgr, root, 24)
+    a = _mk(mgr, root, 25)
+    key = _sponsored_trustline(mgr, root, s, a, 26)
+    # the current sponsor revokes with no sandwich: reserve moves to owner
+    tx = s.tx([revoke_entry_op(key)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    tl = mgr.root.get_entry(key.to_xdr())
+    assert tl.ext.switch == 0
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
+    assert num_sponsored(_acc(mgr, a.account_id)) == 0
+
+
+def test_revoke_transfer_while_sandwiched(mgr, root):
+    s1 = _mk(mgr, root, 27)
+    s2 = _mk(mgr, root, 28)
+    a = _mk(mgr, root, 29)
+    key = _sponsored_trustline(mgr, root, s1, a, 30)
+    # canonical transfer: s2 begins FOR s1 (current sponsor), s1 revokes
+    tx = _sandwich_tx(s2, s1, [revoke_entry_op(key, source=s1.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    tl = mgr.root.get_entry(key.to_xdr())
+    assert tl.ext.value.sponsoringID == s2.account_id
+    assert num_sponsoring(_acc(mgr, s1.account_id)) == 0
+    assert num_sponsoring(_acc(mgr, s2.account_id)) == 1
+    assert num_sponsored(_acc(mgr, a.account_id)) == 1  # unchanged
+
+
+def test_revoke_establish_on_unsponsored_entry(mgr, root):
+    s = _mk(mgr, root, 31)
+    a = _mk(mgr, root, 32)
+    issuer = _mk(mgr, root, 33)
+    asset = make_asset("JPY", issuer.account_id)
+    _close(mgr, a.tx([change_trust_op(asset)]))   # unsponsored
+    key = X.LedgerKey.trustLine(X.LedgerKeyTrustLine(
+        accountID=a.account_id,
+        asset=X.TrustLineAsset(asset.switch, asset.value)))
+    # owner inside a sandwich revokes -> establishes sponsorship to s
+    tx = _sandwich_tx(s, a, [revoke_entry_op(key, source=a.account_id)])
+    arts = _close(mgr, tx)
+    assert _result_of(arts, tx).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    tl = mgr.root.get_entry(key.to_xdr())
+    assert tl.ext.value.sponsoringID == s.account_id
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 1
+    assert num_sponsored(_acc(mgr, a.account_id)) == 1
+
+
+def test_revoke_not_sponsor(mgr, root):
+    s = _mk(mgr, root, 34)
+    a = _mk(mgr, root, 35)
+    b = _mk(mgr, root, 36)
+    key = _sponsored_trustline(mgr, root, s, a, 37)
+    for actor in (a, b):   # neither the owner nor a stranger may revoke
+        tx = actor.tx([revoke_entry_op(key)])
+        arts = _close(mgr, tx)
+        res = _result_of(arts, tx)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        op_res = res.result.value[0].value.value
+        assert op_res.switch == X.RevokeSponsorshipResultCode.\
+            REVOKE_SPONSORSHIP_NOT_SPONSOR
+
+
+def test_revoke_remove_low_reserve_on_owner(mgr, root):
+    base_reserve = mgr.lcl_header.baseReserve
+    s = _mk(mgr, root, 38)
+    # owner kept at the bare minimum for (2 + 1 subentry - 1 sponsored)
+    a = _mk(mgr, root, 39, balance=2 * base_reserve + 200)
+    key = _sponsored_trustline(mgr, root, s, a, 40)
+    tx = s.tx([revoke_entry_op(key)])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[0].value.value
+    assert op_res.switch == X.RevokeSponsorshipResultCode.\
+        REVOKE_SPONSORSHIP_LOW_RESERVE
+
+
+def test_revoke_claimable_balance_only_transferable(mgr, root):
+    s = _mk(mgr, root, 41)
+    a = _mk(mgr, root, 42)
+    cb = X.Operation(body=X.OperationBody.createClaimableBalanceOp(
+        X.CreateClaimableBalanceOp(
+            asset=X.Asset.native(), amount=5_000_000,
+            claimants=[X.Claimant.v0(X.ClaimantV0(
+                destination=a.account_id,
+                predicate=X.ClaimPredicate.unconditional()))])))
+    tx = s.tx([cb])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txSUCCESS
+    bid = res.result.value[0].value.value.value
+    key = X.LedgerKey.claimableBalance(
+        X.LedgerKeyClaimableBalance(balanceID=bid))
+    tx2 = s.tx([revoke_entry_op(key)])
+    arts2 = _close(mgr, tx2)
+    res2 = _result_of(arts2, tx2)
+    assert res2.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res2.result.value[0].value.value
+    assert op_res.switch == X.RevokeSponsorshipResultCode.\
+        REVOKE_SPONSORSHIP_ONLY_TRANSFERABLE
+
+
+def test_revoke_claimable_balance_transfer(mgr, root):
+    s1 = _mk(mgr, root, 43)
+    s2 = _mk(mgr, root, 44)
+    a = _mk(mgr, root, 45)
+    cb = X.Operation(body=X.OperationBody.createClaimableBalanceOp(
+        X.CreateClaimableBalanceOp(
+            asset=X.Asset.native(), amount=5_000_000,
+            claimants=[X.Claimant.v0(X.ClaimantV0(
+                destination=a.account_id,
+                predicate=X.ClaimPredicate.unconditional()))])))
+    tx = s1.tx([cb])
+    arts = _close(mgr, tx)
+    bid = _result_of(arts, tx).result.value[0].value.value.value
+    key = X.LedgerKey.claimableBalance(
+        X.LedgerKeyClaimableBalance(balanceID=bid))
+    tx2 = _sandwich_tx(s2, s1, [revoke_entry_op(key, source=s1.account_id)])
+    arts2 = _close(mgr, tx2)
+    assert _result_of(arts2, tx2).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    cb_e = mgr.root.get_entry(key.to_xdr())
+    assert cb_e.ext.value.sponsoringID == s2.account_id
+    assert num_sponsoring(_acc(mgr, s1.account_id)) == 0
+    assert num_sponsoring(_acc(mgr, s2.account_id)) == 1
+
+
+# --- revoke: signer arm ----------------------------------------------------
+
+def test_revoke_signer_remove_and_transfer(mgr, root):
+    s1 = _mk(mgr, root, 46)
+    s2 = _mk(mgr, root, 47)
+    a = _mk(mgr, root, 48)
+    signer_pk = SecretKey(bytes([49]) * 32).public_key.ed25519
+    skey = X.SignerKey.ed25519(signer_pk)
+    tx = _sandwich_tx(s1, a, [set_signer_op(signer_pk, 1,
+                                            source=a.account_id)])
+    _close(mgr, tx)
+    assert num_sponsoring(_acc(mgr, s1.account_id)) == 1
+    # transfer s1 -> s2
+    tx2 = _sandwich_tx(s2, s1, [revoke_signer_op(a.account_id, skey,
+                                                 source=s1.account_id)])
+    arts2 = _close(mgr, tx2)
+    assert _result_of(arts2, tx2).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    acc = _acc(mgr, a.account_id)
+    assert sponsorship.signer_sponsoring_ids(acc)[0] == s2.account_id
+    assert num_sponsoring(_acc(mgr, s1.account_id)) == 0
+    assert num_sponsoring(_acc(mgr, s2.account_id)) == 1
+    # remove: s2 revokes outside any sandwich
+    tx3 = s2.tx([revoke_signer_op(a.account_id, skey)])
+    arts3 = _close(mgr, tx3)
+    assert _result_of(arts3, tx3).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    acc = _acc(mgr, a.account_id)
+    assert sponsorship.signer_sponsoring_ids(acc)[0] is None
+    assert num_sponsoring(_acc(mgr, s2.account_id)) == 0
+    assert num_sponsored(acc) == 0
+    assert len(acc.signers) == 1   # the signer itself stays
+
+
+def test_revoke_signer_missing(mgr, root):
+    a = _mk(mgr, root, 51)
+    skey = X.SignerKey.ed25519(bytes([52]) * 32)
+    tx = a.tx([revoke_signer_op(a.account_id, skey)])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[0].value.value
+    assert op_res.switch == X.RevokeSponsorshipResultCode.\
+        REVOKE_SPONSORSHIP_DOES_NOT_EXIST
+
+
+# --- lifecycle: sponsored entries released on deletion ---------------------
+
+def test_sponsored_trustline_delete_releases_sponsor(mgr, root):
+    s = _mk(mgr, root, 53)
+    a = _mk(mgr, root, 54)
+    issuer = _mk(mgr, root, 55)
+    asset = make_asset("CAD", issuer.account_id)
+    tx = _sandwich_tx(s, a, [change_trust_op(asset, source=a.account_id)])
+    _close(mgr, tx)
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 1
+    _close(mgr, a.tx([change_trust_op(asset, limit=0)]))
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
+    assert num_sponsored(_acc(mgr, a.account_id)) == 0
+
+
+def test_sponsored_account_merge_releases_sponsor(mgr, root):
+    s = _mk(mgr, root, 56)
+    payer = _mk(mgr, root, 57)
+    new_sk = SecretKey(bytes([58]) * 32)
+    new_id = X.AccountID.ed25519(new_sk.public_key.ed25519)
+    ops = [begin_op(new_id, source=s.account_id),
+           create_account_op(new_id, 1_000_000_000, source=payer.account_id),
+           end_op(source=new_id)]
+    tx = build_tx(NID, s.secret, s.next_seq(), ops,
+                  extra_signers=[payer.secret, new_sk])
+    _close(mgr, tx)
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 2
+    new_acc = _acc(mgr, new_id)
+    merge = build_tx(NID, new_sk, new_acc.seqNum + 1, [X.Operation(
+        body=X.OperationBody.destination(
+            X.muxed_from_account_id(payer.account_id)))])
+    arts = _close(mgr, merge)
+    assert _result_of(arts, merge).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    assert _acc(mgr, new_id) is None
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
