@@ -23,5 +23,10 @@ setup(
             sources=["native/cquorum.c"],
             extra_compile_args=["-O2"],
         ),
+        Extension(
+            "stellar_core_tpu._capply",
+            sources=["native/capply.c"],
+            extra_compile_args=["-O2"],
+        ),
     ],
 )

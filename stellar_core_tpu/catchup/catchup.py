@@ -90,9 +90,13 @@ class PreverifyPipeline:
 
     def __init__(self, network_id: bytes, chunk_size: int = 2048,
                  stats: Optional[Dict[str, int]] = None,
-                 hot_threshold: int = 1 << 62):
+                 hot_threshold: int = 1 << 62,
+                 verdict_sink=None):
         self.network_id = network_id
         self.chunk_size = chunk_size
+        # optional second consumer of collected verdicts (the native apply
+        # engine's verify cache) alongside the process verify cache
+        self.verdict_sink = verdict_sink
         # per-key window tables on the replay path: default OFF (the r3
         # measurement said install dispatches cost more than they saved),
         # overridable for A/B — replay key sets are small and the verifier
@@ -361,6 +365,8 @@ class PreverifyPipeline:
         keys.seed_verify_cache(
             (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
             for i in range(len(pks)))
+        if self.verdict_sink is not None:
+            self.verdict_sink(pks, sigs, msgs, verdicts)
         self.stats["sigs_shipped"] = \
             self.stats.get("sigs_shipped", 0) + len(pks)
 
@@ -440,17 +446,28 @@ class CatchupManager:
     def __init__(self, network_id: bytes, network_passphrase: str,
                  accel: bool = False, accel_chunk: int = 2048,
                  invariant_manager=None,
-                 accel_hot_threshold: int = 1 << 62):
+                 accel_hot_threshold: int = 1 << 62,
+                 native: Optional[bool] = None):
         """invariant_manager: None (default — the bench/hot replay path;
         the hash chain is the corruption *detector*) or an
         InvariantManager to also *localize* faults during replay and
-        bucket apply (reference: INVARIANT_CHECKS honored in catchup)."""
+        bucket apply (reference: INVARIANT_CHECKS honored in catchup).
+
+        native: route supported checkpoints through the native C apply
+        engine (native/capply.c).  Default (None) = auto: on when the
+        extension is built, no invariants are requested (the invariant
+        hooks live on the Python close path), and STELLAR_TPU_NO_CAPPLY
+        is unset.  The Python engine remains the oracle and the fallback
+        for unsupported tx shapes."""
         self.network_id = network_id
         self.network_passphrase = network_passphrase
         self.accel = accel
         self.accel_chunk = accel_chunk
         self.accel_hot_threshold = accel_hot_threshold
         self.invariant_manager = invariant_manager
+        from ..ledger.native_apply import native_apply_available
+        self.native = (native if native is not None else True) \
+            and native_apply_available() and invariant_manager is None
         # offload hit-rate accounting (VERDICT r1 weak #4)
         self.stats = {"sigs_total": 0, "sigs_shipped": 0}
 
@@ -507,10 +524,23 @@ class CatchupManager:
 
         if clock is None:
             clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        bridge = None
+        if self.native:
+            from ..ledger.native_apply import NativeApplyBridge
+            bridge = NativeApplyBridge(self.network_id)
+            bridge.import_from(mgr)
+            mgr.native_bridge = bridge
         work = CatchupWork(clock, mgr, archive, target, self.network_id,
                            accel=self.accel, accel_chunk=self.accel_chunk,
                            lookahead=lookahead, stats=self.stats,
-                           accel_hot_threshold=self.accel_hot_threshold)
+                           accel_hot_threshold=self.accel_hot_threshold,
+                           # frame decode feeds only the accel pairing;
+                           # the native engine parses raw records itself
+                           decode_txs=not self.native or self.accel,
+                           keep_raw=self.native,
+                           verdict_sink=(bridge.seed_verdicts
+                                         if bridge is not None and self.accel
+                                         else None))
         work.start()
         try:
             while not work.done:
@@ -520,6 +550,12 @@ class CatchupManager:
             # a stalled DAG never reaches the work's finish hooks — the
             # collector thread must still be released
             work._close_pipeline()
+            if bridge is not None:
+                mgr.native_bridge = None
+                if bridge.active:
+                    bridge.export_to_manager(mgr)
+                self.stats.update(
+                    {f"native_{k}": v for k, v in bridge.stats().items()})
         if not work.succeeded:
             detail = work.error_detail or "unknown failure"
             raise CatchupError(

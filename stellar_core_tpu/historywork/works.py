@@ -58,20 +58,46 @@ class GetAndVerifyCheckpointWork(BasicWork):
     the 1.14x accel margin and its ~1.3x verify-share bound)."""
 
     def __init__(self, clock: VirtualClock, archive: FileHistoryArchive,
-                 checkpoint: int, network_id: Optional[bytes] = None):
+                 checkpoint: int, network_id: Optional[bytes] = None,
+                 decode_txs: bool = True, keep_raw: bool = False):
+        """decode_txs=False keeps the transaction records RAW (for the
+        native apply engine, which parses them itself; each record is
+        strict-scanned by the C parser at download so corrupt archives
+        keep their retry-with-backoff contract) — the decoded txs/frames
+        views are then built lazily by ensure_decoded() on the
+        Python-fallback path only.  keep_raw retains the raw records even
+        when decoding (the accel+native path needs both)."""
         super().__init__(clock, f"get-verify-{checkpoint:08x}",
                          max_retries=RETRY_A_FEW)
         self.archive = archive
         self.checkpoint = checkpoint
         self.network_id = network_id
+        self.decode_txs = decode_txs
+        self.keep_raw = keep_raw or not decode_txs
         self.headers: List[X.LedgerHeaderHistoryEntry] = []
+        self.raw_headers: List[bytes] = []
+        self.raw_txs: Dict[int, bytes] = {}
         self.txs: Dict[int, X.TransactionHistoryEntry] = {}
         self.frames: Dict[int, List[TransactionFrame]] = {}
 
     def on_reset(self) -> None:
         self.headers = []
+        self.raw_headers = []
+        self.raw_txs = {}
         self.txs = {}
         self.frames = {}
+
+    def ensure_decoded(self) -> None:
+        """Decode any raw tx records not yet decoded (the download may have
+        decoded only the scan-rejected ones) — the Python-fallback apply
+        path and the accel pairing need objects."""
+        for seq, raw in self.raw_txs.items():
+            if seq not in self.txs:
+                self.txs[seq] = _THE.unpack(raw)
+            if self.network_id is not None and seq not in self.frames:
+                self.frames[seq] = [
+                    TransactionFrame.make_from_wire(self.network_id, env)
+                    for env in self.txs[seq].txSet.txs]
 
     def all_frames(self) -> List[TransactionFrame]:
         """Every decoded frame of the checkpoint in ledger order (the
@@ -90,17 +116,44 @@ class GetAndVerifyCheckpointWork(BasicWork):
                 return State.FAILURE
             headers = [_LHHE.unpack(r) for r in recs]
             verify_ledger_chain(headers)
+            raw_txs: Dict[int, bytes] = {}
             txs: Dict[int, X.TransactionHistoryEntry] = {}
             frames: Dict[int, List[TransactionFrame]] = {}
+            scan = None
+            if not self.decode_txs and self.network_id is not None:
+                try:
+                    from stellar_core_tpu import _capply
+                    scan = _capply.scan_tx_record
+                    scan_err = _capply.Error
+                except ImportError:
+                    pass
             for r in self.archive.get_xdr_file(
                     category_path(CATEGORY_TRANSACTIONS,
                                   self.checkpoint)) or []:
-                e = _THE.unpack(r)
-                txs[e.ledgerSeq] = e
-                if self.network_id is not None:
-                    frames[e.ledgerSeq] = [
-                        TransactionFrame.make_from_wire(self.network_id, env)
-                        for env in e.txSet.txs]
+                # TransactionHistoryEntry leads with its u32 ledgerSeq
+                if len(r) < 4:
+                    raise CatchupError("truncated tx record")
+                if self.keep_raw:
+                    raw_txs[int.from_bytes(r[:4], "big")] = r
+                if self.decode_txs:
+                    e = _THE.unpack(r)
+                    txs[e.ledgerSeq] = e
+                    if self.network_id is not None:
+                        frames[e.ledgerSeq] = [
+                            TransactionFrame.make_from_wire(
+                                self.network_id, env)
+                            for env in e.txSet.txs]
+                elif scan is not None:
+                    try:
+                        rc = scan(self.network_id, r)
+                    except scan_err as exc:
+                        raise CatchupError(str(exc)) from exc
+                    if rc != 0:
+                        # well-formed but outside the native set: decode
+                        # NOW (strict, retryable) so the fallback apply
+                        # never hits a first-time decode error
+                        e = _THE.unpack(r)
+                        txs[e.ledgerSeq] = e
         except (X.XdrError, CatchupError, ValueError, OSError) as e:
             # corrupt OR hostile archive data (bad gzip, truncated record
             # mark/body, inflate-cap bomb, XDR decode failure): retry with
@@ -108,6 +161,8 @@ class GetAndVerifyCheckpointWork(BasicWork):
             log.warning("%s: %s", self.name, e)
             return State.FAILURE
         self.headers = headers
+        self.raw_headers = recs
+        self.raw_txs = raw_txs
         self.txs = txs
         self.frames = frames
         return State.SUCCESS
@@ -137,12 +192,56 @@ class ApplyCheckpointWork(BasicWork):
         self.pipeline = pipeline
         self._idx = 0
         self._preverified = False
+        self._native_rejected = False
         self.error_detail = None
 
     def _fail(self, detail: str) -> State:
         self.error_detail = detail
         log.error("%s: %s", self.name, detail)
         return State.FAILURE
+
+    def _run_native(self, bridge) -> Optional[State]:
+        """Apply the whole checkpoint through the native engine.  Returns
+        the work State, or None to fall back to the Python path (probe
+        rejected — unsupported tx shapes in this checkpoint)."""
+        mgr = self.mgr
+        headers = self.download.headers
+        raw_headers = self.download.raw_headers
+        raw_txs = self.download.raw_txs
+        # pending rows only (resume semantics mirror the Python loop)
+        rows = [(entry, raw_headers[i])
+                for i, entry in enumerate(headers)
+                if entry.header.ledgerSeq > mgr.last_closed_ledger_seq]
+        rows = [rw for rw in rows if rw[0].header.ledgerSeq <= self.target]
+        if not rows:
+            return State.SUCCESS
+        tx_recs = [raw_txs.get(e.header.ledgerSeq) for e, _ in rows]
+        if not bridge.probe(tx_recs):
+            if bridge.active:
+                bridge.export_to_manager(mgr)
+            try:
+                self.download.ensure_decoded()
+            except Exception as e:
+                return self._fail(f"tx decode failed on fallback: {e}")
+            return None
+        if not bridge.active:
+            bridge.import_from(mgr)
+        try:
+            bridge.apply_checkpoint([raw for _, raw in rows], tx_recs,
+                                    self.target)
+        except Exception as e:
+            return self._fail(f"native apply failed: {e}")
+        # bookkeeping: the manager's LCL view advances with the engine
+        # (full state stays in C until export); the engine verified these
+        # hashes against its own serialization fail-stop
+        # the engine verified every applied header hash against its own
+        # serialization (fail-stop in close_one_ledger); mirror its LCL
+        seq, lcl_hash = bridge.lcl()
+        tail = next(e for e, _ in reversed(rows)
+                    if e.header.ledgerSeq == seq)
+        mgr.lcl_header = tail.header
+        mgr.lcl_hash = lcl_hash
+        return State.SUCCESS
 
     def _checkpoint_frames(self) -> List[TransactionFrame]:
         if self.download.frames or not self.download.txs:
@@ -168,6 +267,15 @@ class ApplyCheckpointWork(BasicWork):
                                        ledger_state=mgr.root)
             self.pipeline.collect(cp)
             return State.RUNNING
+        bridge = getattr(mgr, "native_bridge", None)
+        if bridge is not None and not self._native_rejected:
+            state = self._run_native(bridge)
+            if state is not None:
+                return state
+            # probe rejected the checkpoint (memoized): state was exported
+            # back to the Python manager; the oracle path below applies
+            # this checkpoint on every subsequent crank
+            self._native_rejected = True
         applied = 0
         while self._idx < len(headers) and applied < self.LEDGERS_PER_CRANK:
             entry = headers[self._idx]
@@ -220,13 +328,18 @@ class CatchupWork(Work):
                  target: int, network_id: bytes, accel: bool = False,
                  accel_chunk: int = 8192, lookahead: int = 2,
                  stats: Optional[dict] = None, coalesce: int = 4,
-                 accel_hot_threshold: int = 1 << 62):
+                 accel_hot_threshold: int = 1 << 62,
+                 decode_txs: bool = True, keep_raw: bool = False,
+                 verdict_sink=None):
         super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.archive = archive
         self.target = target
         self.network_id = network_id
         self.accel = accel
+        self.decode_txs = decode_txs
+        self.keep_raw = keep_raw
+        self.verdict_sink = verdict_sink
         self.accel_chunk = accel_chunk
         self.coalesce = max(1, coalesce)
         # the download window must run ahead of the dispatch groups for
@@ -236,7 +349,8 @@ class CatchupWork(Work):
         self.stats = stats if stats is not None else {}
         self.pipeline = (PreverifyPipeline(network_id, accel_chunk,
                                            self.stats,
-                                           hot_threshold=accel_hot_threshold)
+                                           hot_threshold=accel_hot_threshold,
+                                           verdict_sink=verdict_sink)
                          if accel else None)
         self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
         self._apply: Optional[ApplyCheckpointWork] = None
@@ -318,7 +432,9 @@ class CatchupWork(Work):
                 break
             if c not in self._downloads:
                 w = GetAndVerifyCheckpointWork(self.clock, self.archive, c,
-                                               network_id=self.network_id)
+                                               network_id=self.network_id,
+                                               decode_txs=self.decode_txs,
+                                               keep_raw=self.keep_raw)
                 self._downloads[c] = w
                 self.add_work(w)
         if self.pipeline is not None:

@@ -1,0 +1,111 @@
+"""Bridge between the Python LedgerManager state and the native apply
+engine (native/capply.c).
+
+Reference: SURVEY.md §3.3 — the catchup replay hot loop.  The native
+engine owns the ledger state (entry store + bucket list + header) while a
+catchup replays supported checkpoints; anything the probe rejects (fee
+bumps, ops outside the native set, generalized tx sets) falls back to the
+Python engine for that checkpoint after an export/import round-trip.
+State transfer is exact: entries as (LedgerKey XDR, LedgerEntry XDR)
+pairs, buckets as aligned (sort-key, record) lists / serialized streams,
+pending merges resolved (outputs are pure functions of inputs, so eager
+resolution is hash-identical to the lazy merge pipeline).
+
+Kill switch: STELLAR_TPU_NO_CAPPLY forces the Python path everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .. import xdr as X
+from ..bucket.bucket import Bucket
+from ..bucket.future import FutureBucket
+from .ledger_txn import LedgerTxnRoot
+
+try:
+    if os.environ.get("STELLAR_TPU_NO_CAPPLY"):
+        raise ImportError("capply disabled by STELLAR_TPU_NO_CAPPLY")
+    from stellar_core_tpu import _capply  # built via `make native`
+except ImportError:
+    _capply = None
+
+
+def native_apply_available() -> bool:
+    return _capply is not None
+
+
+def _bucket_tuple(bucket: Bucket):
+    return (bucket.sort_keys(), bucket.packed_entries(),
+            bucket.protocol_version)
+
+
+class NativeApplyBridge:
+    """Owns a _capply.Engine and tracks where the authoritative state
+    lives (`active`: in the engine; otherwise: in the Python manager)."""
+
+    def __init__(self, network_id: bytes):
+        if _capply is None:
+            raise RuntimeError("native apply engine not built")
+        self.engine = _capply.Engine(network_id)
+        self.active = False
+
+    # -- state transfer ----------------------------------------------------
+    def import_from(self, mgr) -> None:
+        """Python manager -> engine (authoritative state moves to C)."""
+        entries = [(kb, e.to_xdr()) for kb, e in mgr.root._entries.items()]
+        buckets = []
+        nexts = []
+        for lvl in mgr.bucket_list.levels:
+            buckets.append(_bucket_tuple(lvl.curr))
+            buckets.append(_bucket_tuple(lvl.snap))
+            nexts.append(None if lvl.next is None
+                         else _bucket_tuple(lvl.next.resolve()))
+        self.engine.import_state(mgr.lcl_header.to_xdr(), mgr.lcl_hash,
+                                 entries, buckets, nexts)
+        self.active = True
+
+    def export_to_manager(self, mgr) -> None:
+        """Engine -> Python manager (authoritative state moves back)."""
+        hdr, lcl_hash, entries, bucket_streams, next_streams = \
+            self.engine.export_state()
+        header = X.LedgerHeader.from_xdr(hdr)
+        root = LedgerTxnRoot(header)
+        root._entries = {kb: X.LedgerEntry.from_xdr(rec)
+                         for kb, rec in entries}
+        for i, lvl in enumerate(mgr.bucket_list.levels):
+            lvl.curr = Bucket.deserialize(bucket_streams[2 * i])
+            lvl.snap = Bucket.deserialize(bucket_streams[2 * i + 1])
+            ns = next_streams[i]
+            lvl.next = (None if ns is None
+                        else FutureBucket.from_output(Bucket.deserialize(ns)))
+        mgr.root = root
+        mgr.lcl_header = header
+        mgr.lcl_hash = lcl_hash
+        if mgr.bucket_list.hash() != header.bucketListHash:
+            raise RuntimeError(
+                "native state export diverged from the bucket list hash")
+        self.active = False
+
+    # -- replay ------------------------------------------------------------
+    def probe(self, tx_recs: Sequence[Optional[bytes]]) -> bool:
+        return bool(self.engine.probe(list(tx_recs)))
+
+    def apply_checkpoint(self, header_recs: List[bytes],
+                         tx_recs: List[Optional[bytes]],
+                         max_seq: int) -> int:
+        return self.engine.apply_checkpoint(header_recs, tx_recs, max_seq)
+
+    def seed_verdicts(self, pks, sigs, msgs, verdicts) -> None:
+        """TPU preverify hook: push batch-verified signature verdicts into
+        the engine's verify cache (identical to the Python seam in
+        crypto/keys.py — a miss just recomputes via libsodium)."""
+        self.engine.seed_verdicts(list(pks), list(sigs), list(msgs),
+                                  [bool(v) for v in verdicts])
+
+    def lcl(self):
+        return self.engine.lcl()
+
+    def stats(self) -> dict:
+        return self.engine.stats()

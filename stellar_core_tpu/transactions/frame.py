@@ -327,25 +327,39 @@ class TransactionFrame:
 
     def _remove_used_one_time_signers(self, ltx: LedgerTxn) -> None:
         """Drop preauth-tx signers matching this tx's hash from every source
-        account (reference: removeOneTimeSignerFromAllSourceAccounts)."""
+        account (reference: removeOneTimeSignerFromAllSourceAccounts),
+        releasing the sponsor and keeping signerSponsoringIDs aligned when
+        a removed signer was sponsored."""
+        from .sponsorship import (record_signer_remove,
+                                  release_signer_sponsorship, signer_sponsor)
         ids = {self.source_account_id().value: self.source_account_id()}
         for op in self.operations:
             if op.sourceAccount is not None:
                 a = X.muxed_to_account_id(op.sourceAccount)
                 ids[a.value] = a
+        header = ltx.get_header()
         for acc_id in ids.values():
             acc_e = load_account(ltx, acc_id)
             if acc_e is None:
                 continue
             acc = acc_e.data.value
-            new_signers = [
-                s for s in acc.signers
-                if not (s.key.switch == X.SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
-                        and s.key.value == self.content_hash())]
-            if len(new_signers) != len(acc.signers):
-                removed = len(acc.signers) - len(new_signers)
-                acc.signers = new_signers
-                acc.numSubEntries -= removed
+            changed = False
+            i = 0
+            while i < len(acc.signers):
+                s = acc.signers[i]
+                if s.key.switch == X.SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX \
+                        and s.key.value == self.content_hash():
+                    sponsor_id = signer_sponsor(acc, i)
+                    acc.signers = acc.signers[:i] + acc.signers[i + 1:]
+                    record_signer_remove(acc, i)
+                    if sponsor_id is not None:
+                        release_signer_sponsorship(ltx, header, sponsor_id,
+                                                   acc_e)
+                    acc.numSubEntries -= 1
+                    changed = True
+                else:
+                    i += 1
+            if changed:
                 ltx.update(acc_e)
 
     def _make_op_frames(self):

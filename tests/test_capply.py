@@ -1,0 +1,271 @@
+"""Differential tests for the native apply engine (native/capply.c).
+
+The Python engine is the semantic oracle: every test replays the same
+archive through both paths and asserts identical LCL hashes, entry
+stores and bucket-list hashes — the same strategy as the cxdr/cquorum
+differentials (SURVEY.md §4: CPU-vs-offload bit-equality)."""
+
+import random
+import tempfile
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.catchup.catchup import CatchupManager
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.history.archive import FileHistoryArchive
+from stellar_core_tpu.history.manager import HistoryManager
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.ledger.native_apply import (NativeApplyBridge,
+                                                  native_apply_available)
+from stellar_core_tpu.testutils import (TestAccount, build_tx,
+                                        change_trust_op, create_account_op,
+                                        make_asset, native_payment_op,
+                                        network_id)
+
+pytestmark = pytest.mark.skipif(not native_apply_available(),
+                                reason="_capply not built (make native)")
+
+NID = network_id("capply differential network")
+PASS = "capply differential network"
+
+
+def _archive(tmp, build_traffic, n_accounts=24):
+    """Generate an archive with `build_traffic(close, accounts, root)`."""
+    mgr = LedgerManager(NID, invariant_manager=None)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(tmp + "/archive")
+    history = HistoryManager(mgr, PASS, [archive])
+    root_sk = mgr.root_account_secret()
+    e = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(root_sk.public_key.ed25519))).to_xdr())
+    root = TestAccount(mgr, root_sk, e.data.value.seqNum)
+    ct = [1_600_000_000]
+
+    def close(frames):
+        ct[0] += 5
+        history.ledger_closed(mgr.close_ledger(frames, ct[0]))
+
+    sks = [SecretKey(bytes([10 + i]) * 32) for i in range(n_accounts)]
+    ops = [create_account_op(X.AccountID.ed25519(sk.public_key.ed25519),
+                             10 ** 11) for sk in sks]
+    close([root.tx(ops)])
+    accounts = []
+    for sk in sks:
+        entry = mgr.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        accounts.append(TestAccount(mgr, sk, entry.data.value.seqNum))
+    build_traffic(close, accounts, root)
+    while not history.published_checkpoints or \
+            history.published_checkpoints[-1] != mgr.last_closed_ledger_seq:
+        close([])
+    return archive, mgr
+
+
+def _assert_replays_agree(archive, mgr):
+    cm_py = CatchupManager(NID, PASS, native=False)
+    m_py = cm_py.catchup_complete(archive)
+    cm_c = CatchupManager(NID, PASS, native=True)
+    m_c = cm_c.catchup_complete(archive)
+    assert m_py.lcl_hash == mgr.lcl_hash
+    assert m_c.lcl_hash == mgr.lcl_hash
+    assert m_c.bucket_list.hash() == m_py.bucket_list.hash()
+    assert {k: e.to_xdr() for k, e in m_c.root._entries.items()} == \
+        {k: e.to_xdr() for k, e in m_py.root._entries.items()}
+    return cm_c
+
+
+def test_payment_traffic_native_equals_python():
+    rng = random.Random(3)
+
+    def traffic(close, accounts, root):
+        for _ in range(12):
+            frames = []
+            for _ in range(14):
+                a = accounts[rng.randrange(len(accounts))]
+                b = accounts[rng.randrange(len(accounts))]
+                frames.append(a.tx([native_payment_op(
+                    b.account_id, 1000 + rng.randrange(10 ** 6))]))
+            close(frames)
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        cm = _assert_replays_agree(archive, mgr)
+        # every checkpoint was natively applied (no fallbacks)
+        assert cm.stats["native_ledgers_applied"] >= 12
+
+
+def test_multisig_setoptions_and_failures_native_equals_python():
+    """SetOptions signer add/remove, multisig payments, and failing txs
+    (underfunded / bad auth) must produce identical results + hashes."""
+    rng = random.Random(4)
+
+    def traffic(close, accounts, root):
+        extras = {}
+        setopts = []
+        for i, acct in enumerate(accounts):
+            if i % 3 == 0:
+                extra = SecretKey(bytes([99 + i]) * 32)
+                extras[i] = extra
+                setopts.append(acct.tx([X.Operation(
+                    body=X.OperationBody.setOptionsOp(X.SetOptionsOp(
+                        signer=X.Signer(
+                            key=X.SignerKey.ed25519(
+                                extra.public_key.ed25519),
+                            weight=1))))]))
+        close(setopts)
+        for _ in range(8):
+            frames = []
+            for _ in range(10):
+                i = rng.randrange(len(accounts))
+                acct = accounts[i]
+                op = native_payment_op(
+                    accounts[rng.randrange(len(accounts))].account_id,
+                    1000 + rng.randrange(10 ** 6))
+                if i in extras:
+                    frames.append(build_tx(NID, acct.secret,
+                                           acct.next_seq(), [op],
+                                           extra_signers=[extras[i]]))
+                else:
+                    frames.append(acct.tx([op]))
+            # a deliberately failing tx: overdrawn payment
+            a = accounts[rng.randrange(len(accounts))]
+            frames.append(a.tx([native_payment_op(
+                accounts[0].account_id, 10 ** 18)]))
+            # and a wrongly-signed one (signed by an unrelated key)
+            b = accounts[rng.randrange(len(accounts))]
+            stranger = SecretKey(bytes([210]) * 32)
+            frames.append(build_tx(NID, b.secret, b.next_seq(),
+                                   [native_payment_op(
+                                       accounts[1].account_id, 1000)],
+                                   signers=[stranger]))
+            close(frames)
+        # remove some signers again
+        removals = []
+        for i, extra in list(extras.items())[:3]:
+            removals.append(accounts[i].tx([X.Operation(
+                body=X.OperationBody.setOptionsOp(X.SetOptionsOp(
+                    signer=X.Signer(
+                        key=X.SignerKey.ed25519(extra.public_key.ed25519),
+                        weight=0))))]))
+        close(removals)
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        _assert_replays_agree(archive, mgr)
+
+
+def test_mixed_unsupported_traffic_falls_back_mid_stream():
+    """Checkpoints containing ops outside the native set (trustlines)
+    force the per-checkpoint Python fallback; the export/import round
+    trips must be hash-exact."""
+    rng = random.Random(5)
+
+    def traffic(close, accounts, root):
+        issuer = accounts[0]
+        asset = make_asset("USD", issuer.account_id)
+        # checkpoint 1: payments (native-appliable)
+        for _ in range(4):
+            close([a.tx([native_payment_op(accounts[2].account_id, 5000)])
+                   for a in accounts[3:9]])
+        # spill into unsupported traffic: trustlines (python fallback)
+        for batch in range(2):
+            close([a.tx([change_trust_op(asset)])
+                   for a in accounts[10 + 5 * batch:15 + 5 * batch]])
+        # ... 60+ more native-only ledgers so a later whole checkpoint is
+        # native again after the fallback one
+        for _ in range(66):
+            a = accounts[rng.randrange(3, 9)]
+            close([a.tx([native_payment_op(
+                accounts[rng.randrange(3, 9)].account_id, 777)])])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        cm = _assert_replays_agree(archive, mgr)
+        assert cm.stats["native_ledgers_applied"] > 0
+
+
+def test_preauth_and_hashx_signers_native():
+    """Preauth-tx signers (consumed on use, sponsorship-aware removal) and
+    hashX signers run through the native checker identically."""
+    def traffic(close, accounts, root):
+        a, b = accounts[0], accounts[1]
+        # preauth: sign a future payment, add its hash as signer, then
+        # submit it unsigned-by-master
+        future = build_tx(NID, a.secret, a.seq_num + 2,
+                          [native_payment_op(b.account_id, 12345)],
+                          signers=[])
+        close([a.tx([X.Operation(body=X.OperationBody.setOptionsOp(
+            X.SetOptionsOp(signer=X.Signer(
+                key=X.SignerKey.pre_auth_tx(future.content_hash()),
+                weight=1))))])])
+        a.next_seq()
+        close([future])
+        # hashX: preimage-revealing payment
+        preimage = b"\x42" * 32
+        from stellar_core_tpu.crypto.sha import sha256
+        close([b.tx([X.Operation(body=X.OperationBody.setOptionsOp(
+            X.SetOptionsOp(signer=X.Signer(
+                key=X.SignerKey.hash_x(sha256(preimage)), weight=1))))])])
+        hx_tx = build_tx(NID, b.secret, b.next_seq(),
+                         [native_payment_op(a.account_id, 999)],
+                         signers=[])
+        hx_tx.envelope.value.signatures.append(X.DecoratedSignature(
+            hint=sha256(preimage)[28:32], signature=preimage))
+        close([hx_tx])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        _assert_replays_agree(archive, mgr)
+
+
+def test_state_roundtrip_through_engine():
+    """import -> export with no applies is the identity on entries,
+    buckets and the header."""
+    def traffic(close, accounts, root):
+        for _ in range(5):
+            close([accounts[0].tx([native_payment_op(
+                accounts[1].account_id, 1000)])])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        bridge = NativeApplyBridge(NID)
+        bridge.import_from(mgr)
+        before_entries = {k: e.to_xdr() for k, e in mgr.root._entries.items()}
+        before_hash = mgr.bucket_list.hash()
+        before_lcl = mgr.lcl_hash
+        m2 = LedgerManager(NID, invariant_manager=None)
+        m2.start_new_ledger()
+        bridge.export_to_manager(m2)
+        assert {k: e.to_xdr() for k, e in m2.root._entries.items()} == \
+            before_entries
+        assert m2.bucket_list.hash() == before_hash
+        assert m2.lcl_hash == before_lcl
+        assert m2.lcl_header.to_xdr() == mgr.lcl_header.to_xdr()
+
+
+def test_engine_rejects_corrupt_records():
+    from stellar_core_tpu import _capply
+
+    def traffic(close, accounts, root):
+        for _ in range(3):
+            close([accounts[0].tx([native_payment_op(
+                accounts[1].account_id, 1000)])])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        cm = CatchupManager(NID, PASS, native=True)
+        # corrupt one byte of a transactions file: the native parse or the
+        # hash chain must fail-stop, never diverge silently
+        import gzip, os
+        for dirpath, _, files in os.walk(d):
+            for f in files:
+                if f.startswith("transactions-"):
+                    p = os.path.join(dirpath, f)
+                    raw = bytearray(gzip.decompress(open(p, "rb").read()))
+                    raw[len(raw) // 2] ^= 0xFF
+                    open(p, "wb").write(gzip.compress(bytes(raw)))
+                    break
+        from stellar_core_tpu.catchup.catchup import CatchupError
+        with pytest.raises(CatchupError):
+            cm.catchup_complete(archive)
