@@ -248,7 +248,13 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
 
     _stage("replay: accel warm pass (compiles)...")
     keys.clear_verify_cache()
-    cm_warm = CatchupManager(nid, passphrase, accel=True, accel_chunk=8192)
+    # per-key tables (hot_threshold=4): with the native apply engine the
+    # device is the replay critical path, and the table kernel's ~2.5x
+    # lighter compute is the best accel variant (r5 A/B:
+    # experiments/out_replay_tables_ab_r5.txt — tables 215 l/s vs generic
+    # 187 l/s vs native-cpu 345 l/s on the same interleaved rounds)
+    cm_warm = CatchupManager(nid, passphrase, accel=True, accel_chunk=8192,
+                             accel_hot_threshold=4)
     cm_warm.catchup_complete(archive, to_ledger=127)
 
     cpu_rates, tpu_rates = [], []
@@ -265,7 +271,7 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
         _stage(f"replay round {r + 1}/{rounds}: accel...")
         keys.clear_verify_cache()
         cm_tpu = CatchupManager(nid, passphrase, accel=True,
-                                accel_chunk=8192)
+                                accel_chunk=8192, accel_hot_threshold=4)
         t0 = time.perf_counter()
         m2 = cm_tpu.catchup_complete(archive)
         tpu_rates.append(n_ledgers / (time.perf_counter() - t0))
@@ -275,8 +281,16 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
                   for k, v in cm_tpu.stats.items()}
 
     med = lambda xs: sorted(xs)[len(xs) // 2]
+    # drift-resistant headline (VERDICT r4 item 6): per-round arrays + the
+    # ratio as the MEDIAN OF PER-ROUND PAIRS (each pair shares one drift
+    # window), min/max recorded alongside
+    pair_ratios = [t / c for c, t in zip(cpu_rates, tpu_rates)]
     phases["cpu_rates"] = [round(x, 1) for x in cpu_rates]
     phases["accel_rates"] = [round(x, 1) for x in tpu_rates]
+    phases["pair_ratios"] = [round(x, 3) for x in pair_ratios]
+    phases["ratio_min"] = round(min(pair_ratios), 3)
+    phases["ratio_max"] = round(max(pair_ratios), 3)
+    phases["ratio_median_of_pairs"] = round(med(pair_ratios), 3)
     return med(cpu_rates), med(tpu_rates), hit_rate, n_ledgers, phases
 
 
@@ -325,50 +339,83 @@ def asym_org_map(n_orgs):
     return asym_org_qmap(n_orgs)
 
 
-def bench_quorum():
-    from stellar_core_tpu.herder.quorum_intersection import check_intersection
+def bench_quorum(budget_s: float = 700.0):
+    """Config 3 + 5 as a CROSSOVER MATRIX (VERDICT r4 item 4): tier-1,
+    rings and asym orgs=5..7 across all three engines — pure Python
+    enumeration (the semantic oracle), native C (native/cquorum.c) and the
+    TPU frontier enumerator — with per-engine wall-clocks in the driver
+    record.  Rows are attempted largest-last under a time budget so a
+    drifted chip degrades to SKIPPED rows, never a blown driver window.
+    r4 reference costs (slow-chip day): asym5 C 0.3s / TPU 56s; asym6
+    py 181s / C 9s / TPU 71s; asym7 C 93s / TPU 255s."""
+    from stellar_core_tpu.herder.quorum_intersection import (
+        QuorumIntersectionChecker, check_intersection, _cquorum)
     from stellar_core_tpu.accel.quorum import check_intersection_tpu
 
-    qmap = tier1_quorum_map()
-    t0 = time.perf_counter()
-    res = check_intersection(qmap)
-    t_cpu_tier1 = time.perf_counter() - t0
-    assert res.intersects
+    t_start = time.perf_counter()
+    matrix = {}
 
-    adv = adversarial_quorum_map()
-    t0 = time.perf_counter()
-    res2 = check_intersection(adv)
-    t_cpu_adv = time.perf_counter() - t0
+    def left():
+        return budget_s - (time.perf_counter() - t_start)
 
-    check_intersection_tpu(adversarial_quorum_map(12))  # compile warm
-    t0 = time.perf_counter()
-    tres = check_intersection_tpu(adv)
-    t_tpu_adv = time.perf_counter() - t0
-    assert bool(tres.intersects) == bool(res2.intersects)
-
-    # config 5's exponential class.  The native enumeration core
-    # (native/cquorum.c, round 4) answers orgs=5 in ~0.3s and orgs=6 in
-    # ~9s, so both CPU rows fit the driver budget WHEN the extension is
-    # built; on the pure-Python fallback orgs=6 takes ~3 minutes, so the
-    # row is skipped (None).  The offline crossover table (orgs<=8, incl.
-    # the TPU resident-frontier rows) is in BASELINE.md config 5.
-    from stellar_core_tpu.herder.quorum_intersection import _cquorum
-    asym = asym_org_map(5)
-    t0 = time.perf_counter()
-    ares_t = check_intersection_tpu(asym, batch_size=8192)
-    t_tpu_asym = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    ares_c = check_intersection(asym)
-    t_cpu_asym = time.perf_counter() - t0
-    assert bool(ares_t.intersects) == bool(ares_c.intersects)
-    t_cpu_asym6 = None
-    if _cquorum is not None:
+    def run(row, engine, fn, estimate_s, expect=None):
+        if left() < estimate_s * 1.5:
+            matrix[f"{row}_{engine}_s"] = "SKIPPED(budget)"
+            return None
         t0 = time.perf_counter()
-        ares_c6 = check_intersection(asym_org_map(6))
-        t_cpu_asym6 = time.perf_counter() - t0
-        assert ares_c6.intersects
-    return (t_cpu_tier1, t_cpu_adv, t_tpu_adv, t_cpu_asym, t_tpu_asym,
-            t_cpu_asym6)
+        res = fn()
+        dt = time.perf_counter() - t0
+        matrix[f"{row}_{engine}_s"] = round(dt, 3)
+        if expect is not None:
+            assert bool(res.intersects) == expect, (row, engine)
+        return res
+
+    def py_enum(qmap):
+        # the pure-Python enumeration, bypassing the native core AND the
+        # symmetric-org contraction (the oracle row of the matrix)
+        return QuorumIntersectionChecker(qmap)._check_python()
+
+    def c_enum(qmap):
+        return QuorumIntersectionChecker(qmap)._check_native()
+
+    def run_c(row, qmap, estimate_s, expect=None):
+        # the C rows are only meaningful with the native engine built —
+        # the pure-Python fallback is 14-23x slower and would blow the
+        # budget the estimates are calibrated for
+        if _cquorum is None:
+            matrix[f"{row}_c_s"] = "SKIPPED(no native engine)"
+            return None
+        return run(row, "c", lambda: c_enum(qmap), estimate_s,
+                   expect=expect)
+
+    # tier-1 shape: answered by the symmetric-org contraction (product
+    # fast path) in ms — engine-independent
+    run("tier1", "contraction", lambda: check_intersection(tier1_quorum_map()),
+        1, expect=True)
+
+    rings = adversarial_quorum_map()
+    run("rings16", "py", lambda: py_enum(rings), 2, expect=True)
+    run_c("rings16", rings, 1, expect=True)
+    check_intersection_tpu(adversarial_quorum_map(12))  # compile warm
+    run("rings16", "tpu", lambda: check_intersection_tpu(rings), 30,
+        expect=True)
+
+    a5, a6, a7 = asym_org_map(5), asym_org_map(6), asym_org_map(7)
+    run("asym5", "py", lambda: py_enum(a5), 8, expect=True)
+    run_c("asym5", a5, 2, expect=True)
+    run("asym5", "tpu", lambda: check_intersection_tpu(a5, batch_size=8192),
+        70, expect=True)
+    matrix["asym6_py_s"] = "SKIPPED(~180s, over per-row budget)"
+    run_c("asym6", a6, 12, expect=True)
+    run("asym6", "tpu", lambda: check_intersection_tpu(a6, batch_size=8192),
+        90, expect=True)
+    matrix["asym7_py_s"] = "SKIPPED(>900s measured r3)"
+    run_c("asym7", a7, 110, expect=True)
+    run("asym7", "tpu", lambda: check_intersection_tpu(a7, batch_size=8192),
+        280, expect=True)
+    matrix["quorum_matrix_budget_s"] = budget_s
+    matrix["quorum_matrix_spent_s"] = round(time.perf_counter() - t_start, 1)
+    return matrix
 
 
 def probe_device(timeout_s: float = 120.0, attempts: int = 3) -> bool:
@@ -489,20 +536,11 @@ def main():
         "replay_phases": phases,
     })
 
-    _stage("quorum bench...")
-    (t_cpu_tier1, t_cpu_adv, t_tpu_adv,
-     t_cpu_asym, t_tpu_asym, t_cpu_asym6) = bench_quorum()
+    _stage("quorum bench (crossover matrix)...")
+    matrix = bench_quorum()
     from stellar_core_tpu.herder.quorum_intersection import _cquorum
-    _cache_put("quorum", {
-        "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
-        "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
-        "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
-        "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
-        "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
-        **({"quorum_asym6_cpu_s": round(t_cpu_asym6, 3)}
-           if t_cpu_asym6 is not None else {}),
-        "quorum_native_engine": _cquorum is not None,
-    })
+    matrix["quorum_native_engine"] = _cquorum is not None
+    _cache_put("quorum", matrix)
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
@@ -520,14 +558,7 @@ def main():
             "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
             "ed25519_speedup_1chip_vs_1core":
                 round(tpu_sig_rate / cpu_sig_rate, 2),
-            "quorum_tier1_cpu_s": round(t_cpu_tier1, 3),
-            "quorum_adversarial_cpu_s": round(t_cpu_adv, 3),
-            "quorum_adversarial_tpu_s": round(t_tpu_adv, 3),
-            "quorum_asym5_cpu_s": round(t_cpu_asym, 3),
-            "quorum_asym5_tpu_s": round(t_tpu_asym, 3),
-            **({"quorum_asym6_cpu_s": round(t_cpu_asym6, 3)}
-               if t_cpu_asym6 is not None else {}),
-            "quorum_native_engine": _cquorum is not None,
+            **matrix,
             "replay_phases": phases,
         },
     }))

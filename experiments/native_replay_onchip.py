@@ -1,0 +1,65 @@
+"""Round-5 on-chip: native-engine replay, CPU vs accel vs python-cpu,
+interleaved rounds (the rig drifts 20-66%; only interleaved medians are
+valid — see BASELINE.md)."""
+
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+from stellar_core_tpu.catchup.catchup import CatchupManager  # noqa: E402
+from stellar_core_tpu.crypto import keys  # noqa: E402
+from stellar_core_tpu.testutils import network_id  # noqa: E402
+
+
+def main():
+    if not bench.probe_device(timeout_s=120, attempts=2):
+        print("DEVICE DOWN")
+        sys.exit(1)
+    nid = network_id("bench network")
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = bench.build_archive(
+            nid, "bench network", d + "/a", n_payment_ledgers=1100)
+        n = mgr.last_closed_ledger_seq
+        print("archive ledgers:", n, flush=True)
+        keys.clear_verify_cache()
+        cmw = CatchupManager(nid, "bench network", accel=True,
+                             accel_chunk=8192)
+        cmw.catchup_complete(archive, to_ledger=127)
+        print("warmed", flush=True)
+        rates = {"cpu": [], "accel": [], "py_cpu": []}
+        for r in range(3):
+            for name, kw in (("cpu", dict(accel=False)),
+                             ("accel", dict(accel=True, accel_chunk=8192)),
+                             ("py_cpu", dict(accel=False, native=False))):
+                keys.clear_verify_cache()
+                cm = CatchupManager(nid, "bench network", **kw)
+                t0 = time.perf_counter()
+                m = cm.catchup_complete(archive)
+                dt = time.perf_counter() - t0
+                assert m.lcl_hash == mgr.lcl_hash, name + " diverged"
+                rates[name].append(n / dt)
+                extra = ""
+                if name == "accel":
+                    extra = (
+                        f" hit={cm.offload_hit_rate():.3f}"
+                        f" collect_wait="
+                        f"{cm.stats.get('collect_wait_s', 0):.2f}"
+                        f" dispatch={cm.stats.get('dispatch_s', 0):.2f}"
+                        f" sodium="
+                        f"{cm.stats.get('native_libsodium_verifies')}")
+                print(f"round {r} {name}: {n/dt:.1f} l/s ({dt:.2f}s){extra}",
+                      flush=True)
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        c, a, p = (med(rates["cpu"]), med(rates["accel"]),
+                   med(rates["py_cpu"]))
+        print(f"MEDIANS: native-cpu {c:.1f} l/s, native-accel {a:.1f} l/s, "
+              f"python-cpu {p:.1f} l/s")
+        print(f"accel vs native-cpu: {a/c:.3f}x; "
+              f"accel vs python-cpu: {a/p:.3f}x; "
+              f"native-cpu vs python-cpu: {c/p:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""r5: with the native engine, the device is the replay bottleneck
+(collect_wait ~4s, accel 0.51x cpu).  A/B the per-key table path
+(hot_threshold=4) against generic under the new regime."""
+import sys, tempfile, time
+sys.path.insert(0, "/root/repo")
+import bench
+from stellar_core_tpu.catchup.catchup import CatchupManager
+from stellar_core_tpu.crypto import keys
+from stellar_core_tpu.testutils import network_id
+
+if not bench.probe_device(timeout_s=120, attempts=2):
+    print("DEVICE DOWN"); sys.exit(1)
+nid = network_id("bench network")
+with tempfile.TemporaryDirectory() as d:
+    archive, mgr = bench.build_archive(nid, "bench network", d + "/a",
+                                       n_payment_ledgers=1100)
+    n = mgr.last_closed_ledger_seq
+    keys.clear_verify_cache()
+    cmw = CatchupManager(nid, "bench network", accel=True, accel_chunk=8192,
+                         accel_hot_threshold=4)
+    cmw.catchup_complete(archive, to_ledger=127)
+    cmw2 = CatchupManager(nid, "bench network", accel=True, accel_chunk=8192)
+    cmw2.catchup_complete(archive, to_ledger=127)
+    print("warmed", flush=True)
+    variants = {
+        "cpu": dict(accel=False),
+        "accel_generic": dict(accel=True, accel_chunk=8192),
+        "accel_tables": dict(accel=True, accel_chunk=8192,
+                             accel_hot_threshold=4),
+        "accel_tables_c16": dict(accel=True, accel_chunk=16384,
+                                 accel_hot_threshold=4),
+    }
+    rates = {k: [] for k in variants}
+    for r in range(3):
+        for name, kw in variants.items():
+            keys.clear_verify_cache()
+            cm = CatchupManager(nid, "bench network", **kw)
+            t0 = time.perf_counter()
+            m = cm.catchup_complete(archive)
+            dt = time.perf_counter() - t0
+            assert m.lcl_hash == mgr.lcl_hash, name
+            rates[name].append(n / dt)
+            print(f"round {r} {name}: {n/dt:.1f} l/s ({dt:.2f}s) "
+                  f"wait={cm.stats.get('collect_wait_s', 0):.2f} "
+                  f"disp={cm.stats.get('dispatch_s', 0):.2f}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    base = med(rates["cpu"])
+    for k in variants:
+        print(f"MEDIAN {k}: {med(rates[k]):.1f} l/s "
+              f"({med(rates[k])/base:.3f}x vs cpu)")

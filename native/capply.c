@@ -1785,6 +1785,11 @@ typedef struct {
     CHeader header;             /* last closed header */
     uint8_t lcl_hash[32];
     VCache vcache;
+    /* cumulative SetOptions ed25519-signer harvest for accel pairing
+     * (mirrors PreverifyPipeline._harvested_hint; in-order dispatch makes
+     * it a superset of every signer the apply will try) */
+    uint8_t (*harvest)[32];
+    int n_harvest, cap_harvest;
     /* stats */
     uint64_t ledgers_applied, txs_applied;
 } Engine;
@@ -2848,6 +2853,7 @@ fail:
 static void
 Engine_dealloc(Engine *self)
 {
+    PyMem_Free(self->harvest);
     map_free(&self->store);
     map_free(&self->ledger_delta);
     map_free(&self->tx_delta);
@@ -3244,6 +3250,161 @@ Engine_seed_verdicts(Engine *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+static int
+harvest_add(Engine *e, const uint8_t pk[32])
+{
+    for (int i = 0; i < e->n_harvest; i++)
+        if (memcmp(e->harvest[i], pk, 32) == 0)
+            return 0;
+    if (e->n_harvest == e->cap_harvest) {
+        int nc = e->cap_harvest ? e->cap_harvest * 2 : 64;
+        void *np = PyMem_Realloc(e->harvest, nc * 32);
+        if (!np) { PyErr_NoMemory(); return -1; }
+        e->harvest = np;
+        e->cap_harvest = nc;
+    }
+    memcpy(e->harvest[e->n_harvest++], pk, 32);
+    return 0;
+}
+
+/* Accel pairing extraction (mirrors PreverifyPipeline.dispatch pairing):
+ * for each tx of the given raw records, candidates are the tx/op source
+ * account ids, those accounts' ed25519 signers in the engine state, and
+ * the cumulative SetOptions harvest; every decorated signature pairs with
+ * every distinct hint-matching candidate.  Returns (pks, sigs, msgs,
+ * total_sigs) — msgs are the 32-byte content hashes. */
+static PyObject *
+Engine_extract_pairs(Engine *self, PyObject *args)
+{
+    PyObject *tx_recs;
+    if (!PyArg_ParseTuple(args, "O", &tx_recs))
+        return NULL;
+    CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
+    if (!txs)
+        return PyErr_NoMemory();
+    PyObject *pks = PyList_New(0), *sigs = PyList_New(0),
+             *msgs = PyList_New(0);
+    long total = 0;
+    if (!pks || !sigs || !msgs)
+        goto fail;
+    Py_ssize_t n_recs = PyList_Size(tx_recs);
+    /* pass 1: harvest SetOptions signers from the whole group */
+    for (Py_ssize_t ri = 0; ri < n_recs; ri++) {
+        PyObject *item = PyList_GetItem(tx_recs, ri);
+        if (item == Py_None)
+            continue;
+        char *p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) < 0)
+            goto fail;
+        int n_txs, set_len;
+        const uint8_t *set_p;
+        uint32_t rec_seq;
+        if (parse_tx_record((uint8_t *)p, (int)len, self->network_id, txs,
+                            &n_txs, &set_p, &set_len, &rec_seq) != 0)
+            continue;            /* unsupported/malformed: python pairs it */
+        for (int t = 0; t < n_txs; t++) {
+            for (int oi = 0; oi < txs[t].n_ops; oi++) {
+                COp *op = &txs[t].ops[oi];
+                if (op->op_type != 5)
+                    continue;
+                /* walk the SetOptions body to the optional signer */
+                Rd r;
+                rd_init(&r, op->body, op->body_len);
+                uint32_t pr = rd_u32(&r);
+                if (pr) { rd_skip(&r, 36); }
+                for (int k = 0; k < 6; k++) {
+                    pr = rd_u32(&r);
+                    if (pr) rd_skip(&r, 4);
+                }
+                pr = rd_u32(&r);
+                if (pr) {
+                    uint32_t sl;
+                    if (!rd_varopaque(&r, 32, &sl))
+                        continue;
+                }
+                pr = rd_u32(&r);
+                if (pr && !r.err) {
+                    CSigner sg;
+                    if (parse_signer_key(&r, &sg) == 0 &&
+                        sg.key_type == 0) {
+                        if (harvest_add(self, sg.key) < 0)
+                            goto fail;
+                    }
+                }
+            }
+        }
+    }
+    /* pass 2: pair */
+    for (Py_ssize_t ri = 0; ri < n_recs; ri++) {
+        PyObject *item = PyList_GetItem(tx_recs, ri);
+        if (item == Py_None)
+            continue;
+        char *p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) < 0)
+            goto fail;
+        int n_txs, set_len;
+        const uint8_t *set_p;
+        uint32_t rec_seq;
+        if (parse_tx_record((uint8_t *)p, (int)len, self->network_id, txs,
+                            &n_txs, &set_p, &set_len, &rec_seq) != 0)
+            continue;
+        for (int t = 0; t < n_txs; t++) {
+            CTx *tx = &txs[t];
+            total += tx->n_sigs;
+            /* candidate pks: sources' masters + their state signers */
+            uint8_t cand[1 + MAX_OPS + 21 * (1 + MAX_OPS)][32];
+            int n_cand = 0;
+            uint8_t srcs[1 + MAX_OPS][32];
+            int n_srcs = 0;
+            memcpy(srcs[n_srcs++], tx->source, 32);
+            for (int oi = 0; oi < tx->n_ops; oi++)
+                if (tx->ops[oi].has_source) {
+                    int dup = 0;
+                    for (int k = 0; k < n_srcs; k++)
+                        if (memcmp(srcs[k], tx->ops[oi].source, 32) == 0) {
+                            dup = 1;
+                            break;
+                        }
+                    if (!dup)
+                        memcpy(srcs[n_srcs++], tx->ops[oi].source, 32);
+                }
+            for (int k = 0; k < n_srcs; k++) {
+                memcpy(cand[n_cand++], srcs[k], 32);
+                CAccount acc;
+                int got = eng_get_account(self, srcs[k], &acc);
+                if (got > 0) {
+                    for (int si = 0; si < acc.n_signers; si++)
+                        if (acc.signers[si].key_type == 0)
+                            memcpy(cand[n_cand++], acc.signers[si].key, 32);
+                }
+            }
+            for (int di = 0; di < tx->n_sigs; di++) {
+                CDecSig *ds = &tx->sigs[di];
+                uint8_t seen[64][32];
+                int n_seen = 0;
+#define EMIT_PAIR(PK) do {                     int dup = 0;                     for (int z = 0; z < n_seen; z++)                         if (memcmp(seen[z], (PK), 32) == 0) { dup = 1; break; }                     if (!dup && n_seen < 64) {                         memcpy(seen[n_seen++], (PK), 32);                         PyObject *o1 = PyBytes_FromStringAndSize((const char *)(PK), 32);                         PyObject *o2 = PyBytes_FromStringAndSize((const char *)ds->sig, ds->sig_len);                         PyObject *o3 = PyBytes_FromStringAndSize((const char *)tx->content_hash, 32);                         if (!o1 || !o2 || !o3 ||                             PyList_Append(pks, o1) < 0 ||                             PyList_Append(sigs, o2) < 0 ||                             PyList_Append(msgs, o3) < 0) {                             Py_XDECREF(o1); Py_XDECREF(o2); Py_XDECREF(o3);                             goto fail;                         }                         Py_DECREF(o1); Py_DECREF(o2); Py_DECREF(o3);                     }                 } while (0)
+                for (int k = 0; k < n_cand; k++)
+                    if (memcmp(ds->hint, cand[k] + 28, 4) == 0)
+                        EMIT_PAIR(cand[k]);
+                for (int k = 0; k < self->n_harvest; k++)
+                    if (memcmp(ds->hint, self->harvest[k] + 28, 4) == 0)
+                        EMIT_PAIR(self->harvest[k]);
+#undef EMIT_PAIR
+            }
+        }
+    }
+    PyMem_Free(txs);
+    return Py_BuildValue("(NNNl)", pks, sigs, msgs, total);
+fail:
+    PyMem_Free(txs);
+    Py_XDECREF(pks);
+    Py_XDECREF(sigs);
+    Py_XDECREF(msgs);
+    return NULL;
+}
+
 static PyObject *
 Engine_stats(Engine *self, PyObject *args)
 {
@@ -3270,6 +3431,8 @@ static PyMethodDef Engine_methods[] = {
     {"lcl", (PyCFunction)Engine_lcl, METH_NOARGS, "-> (seq, hash)"},
     {"seed_verdicts", (PyCFunction)Engine_seed_verdicts, METH_VARARGS,
      "seed_verdicts(pks, sigs, msgs, verdicts)"},
+    {"extract_pairs", (PyCFunction)Engine_extract_pairs, METH_VARARGS,
+     "extract_pairs(tx_recs) -> (pks, sigs, msgs, total_sigs)"},
     {"stats", (PyCFunction)Engine_stats, METH_NOARGS, "-> dict"},
     {NULL, NULL, 0, NULL},
 };
@@ -3308,10 +3471,13 @@ capply_roundtrip_account(PyObject *self, PyObject *args)
     return res;
 }
 
-/* stateless strict scan of one TransactionHistoryEntry: 0 = natively
- * supported, 1 = unsupported (fall back to Python), raises on malformed
- * framing — lets the download work keep its retry-with-backoff contract
- * for corrupt archives without decoding in Python. */
+/* stateless strict scan of one TransactionHistoryEntry: returns
+ * (rc, n_sigs) with rc 0 = natively supported / 1 = unsupported (fall
+ * back to Python); raises on malformed framing — lets the download work
+ * keep its retry-with-backoff contract for corrupt archives without
+ * decoding in Python, and gives the pipeline a pair-free signature count
+ * (n_sigs is partial for rc=1: the parse stops at the unsupported
+ * feature; the fallback path re-counts from decoded frames). */
 static PyObject *
 capply_scan_tx_record(PyObject *self, PyObject *args)
 {
@@ -3326,17 +3492,22 @@ capply_scan_tx_record(PyObject *self, PyObject *args)
     CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
     if (!txs)
         return PyErr_NoMemory();
-    int n_txs, set_len;
+    int n_txs = 0, set_len;
     const uint8_t *set_p;
     uint32_t rec_seq;
     int rc = parse_tx_record(rec, (int)rec_len, nid, txs, &n_txs,
                              &set_p, &set_len, &rec_seq);
+    long n_sigs = 0;
+    if (rc >= 0)
+        for (int i = 0; i < n_txs; i++)
+            if (txs[i].supported)
+                n_sigs += txs[i].n_sigs;
     PyMem_Free(txs);
     if (rc < 0) {
         PyErr_SetString(CapplyError, "malformed tx record");
         return NULL;
     }
-    return PyLong_FromLong(rc);
+    return Py_BuildValue("(il)", rc, n_sigs);
 }
 
 static PyMethodDef capply_methods[] = {

@@ -91,12 +91,15 @@ class PreverifyPipeline:
     def __init__(self, network_id: bytes, chunk_size: int = 2048,
                  stats: Optional[Dict[str, int]] = None,
                  hot_threshold: int = 1 << 62,
-                 verdict_sink=None):
+                 verdict_sink=None, pair_extractor=None):
         self.network_id = network_id
         self.chunk_size = chunk_size
         # optional second consumer of collected verdicts (the native apply
         # engine's verify cache) alongside the process verify cache
         self.verdict_sink = verdict_sink
+        # optional native pairing (bridge.extract_pairs): dispatch_raw
+        # pairs straight from raw records, skipping Python frame decode
+        self.pair_extractor = pair_extractor
         # per-key window tables on the replay path: default OFF (the r3
         # measurement said install dispatches cost more than they saved),
         # overridable for A/B — replay key sets are small and the verifier
@@ -123,12 +126,15 @@ class PreverifyPipeline:
         self._worker = None
         self._jobs = None
         self._consecutive_wedges = 0
+        self._consecutive_losses = 0
+        self._first_collect_done = False
         self._disabled = False
         # hint (4 bytes) -> [pk, ...] of every SetOptions-added ed25519
         # signer seen in any dispatched checkpoint (cumulative: covers
         # signers added between the pairing state snapshot and apply)
         self._harvested_hint: Dict[bytes, List[bytes]] = {}
         self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
+        self._counted_sigs: Dict[int, int] = {}  # raw-path per-cp totals
 
     # a wedged tunnel RPC must degrade to CPU-speed verification, not hang
     # the catchup; generous enough for a cold compile (~60s observed)
@@ -138,6 +144,11 @@ class PreverifyPipeline:
     # would pay the full timeout once per group (observed: the tunnel can
     # go down for an hour+)
     MAX_CONSECUTIVE_WEDGES = 2
+    # CPU-race bound: per-signature libsodium cost on this class of host
+    # (~15-20k verifies/s measured) — a collect may wait at most ~1.25x
+    # what the CPU would charge to verify the group itself
+    RACE_CPU_S_PER_SIG = 60e-6
+    MAX_CONSECUTIVE_LOSSES = 3
 
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
@@ -281,6 +292,69 @@ class PreverifyPipeline:
         self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + total
         # sigs_shipped is counted at COLLECT time (successful seeding
         # only): a group that wedges and falls back to CPU never shipped
+        self._enqueue_group(cps, pks, sigs, msgs, t0)
+
+    def dispatch_raw(self, recs_by_checkpoint: Dict[int, Sequence[bytes]]
+                     ) -> None:
+        """dispatch() for the native path: pairing runs in C straight from
+        the raw transaction records (no Python frame decode)."""
+        cps = sorted(recs_by_checkpoint)
+        if self._disabled or self.pair_extractor is None:
+            # count signatures per checkpoint (honest hit rate denominator)
+            # without materializing pairs, then register a no-op group
+            for cp in cps:
+                n = self._count_and_record(cp, recs_by_checkpoint[cp])
+                self.stats["sigs_total"] = \
+                    self.stats.get("sigs_total", 0) + n
+            group = {"job": None, "pks": [], "sigs": [], "msgs": [],
+                     "checkpoints": cps, "collected": True}
+            for cp in cps:
+                self._groups[cp] = group
+            return
+        import time as _time
+        t0 = _time.perf_counter()
+        pks, sigs, msgs = [], [], []
+        for cp in cps:
+            # per-checkpoint extraction: records each checkpoint's counted
+            # total so the Python-fallback apply can correct the
+            # denominator for records the C parser rejected (their
+            # signatures are neither paired nor counted here)
+            p_, s_, m_, total = self.pair_extractor(recs_by_checkpoint[cp])
+            pks.extend(p_)
+            sigs.extend(s_)
+            msgs.extend(m_)
+            self._counted_sigs[cp] = total
+            self.stats["sigs_total"] = \
+                self.stats.get("sigs_total", 0) + total
+        self._enqueue_group(cps, pks, sigs, msgs, t0)
+
+    def _count_and_record(self, cp, recs) -> int:
+        from stellar_core_tpu import _capply
+        n = 0
+        for r in recs:
+            try:
+                _, sig_count = _capply.scan_tx_record(self.network_id, r)
+                n += sig_count
+            except _capply.Error:
+                pass
+        self._counted_sigs[cp] = n
+        return n
+
+    def correct_total_for_fallback(self, checkpoint: int,
+                                   python_total: int) -> None:
+        """A probe-rejected checkpoint re-counts its signatures from the
+        decoded frames; replace whatever partial count the raw extraction
+        recorded for it (records the C parser rejected were uncounted)."""
+        counted = self._counted_sigs.pop(checkpoint, None)
+        if counted is None:
+            return
+        self.stats["sigs_total"] = self.stats.get("sigs_total", 0) \
+            + python_total - counted
+
+    def _enqueue_group(self, cps, pks, sigs, msgs, t0) -> None:
+        import time as _time
+
+        from ..accel.ed25519 import verify_batch_async
         job = None
         if pks:
             # tail_floor=chunk_size: one compiled shape per path, amortized
@@ -325,34 +399,67 @@ class PreverifyPipeline:
         box, ev, q = job
         t0 = _time.perf_counter()
         stale = q is not self._jobs and not ev.is_set()
+        # RACE-BOUNDED wait (round 5): with the native apply engine the
+        # device is the replay critical path, so waiting longer than the
+        # group's CPU-verify cost LOSES outright (measured: a drifted chip
+        # turned a 3s replay into 55s of collect_wait).  Bound the wait by
+        # what libsodium would charge for the group; a miss skips seeding
+        # (the engine recomputes on CPU — verdicts identical) without
+        # abandoning the worker, and repeated losses disable the pipeline
+        # for the rest of the catchup.  The FIRST collect keeps the long
+        # wedge timeout: it absorbs kernel compiles and is the only probe
+        # that can tell a wedged tunnel from a slow one.
+        if self._first_collect_done:
+            budget = min(self.COLLECT_TIMEOUT_S,
+                         max(0.25, len(group["pks"])
+                             * self.RACE_CPU_S_PER_SIG * 1.25))
+        else:
+            budget = self.COLLECT_TIMEOUT_S
         if stale:
             done = False   # stale worker generation: never going to finish
         else:
-            done = ev.wait(self.COLLECT_TIMEOUT_S)
+            done = ev.wait(budget)
         # sync stall: how long the apply cursor waited on the device —
         # ~0 when double-buffering hid the compute under earlier applies
         self.stats["collect_wait_s"] = self.stats.get("collect_wait_s", 0.0) \
             + (_time.perf_counter() - t0)
+        race_loss = (not done and not stale
+                     and budget < self.COLLECT_TIMEOUT_S)
+        first = not self._first_collect_done
+        self._first_collect_done = True
         if not done or "error" in box:
-            # tunnel wedge or device fault: fall back to on-demand CPU
-            # verification for this group (verdicts identical, just not
-            # prefetched).  The daemon worker stays blocked in its RPC
-            # harmlessly; drop it so later groups get a fresh worker.
             log.warning(
                 "preverify collect %s for checkpoints %s — falling back to "
                 "on-demand CPU verification",
-                "timed out" if not done else f"failed: {box.get('error')}",
+                ("lost the CPU race" if race_loss else "timed out")
+                if not done else f"failed: {box.get('error')}",
                 group["checkpoints"])
             self.stats["collect_fallbacks"] = \
                 self.stats.get("collect_fallbacks", 0) + 1
-            if not done and not stale:
-                # a genuine wedge: abandon this worker generation (the
+            if race_loss:
+                # the device is slower than libsodium on this group; the
+                # worker keeps running (its queue drains eventually) but
+                # repeated losses mean the chip can't win today
+                self._consecutive_losses += 1
+                self.stats["race_losses"] = \
+                    self.stats.get("race_losses", 0) + 1
+                if self._consecutive_losses >= self.MAX_CONSECUTIVE_LOSSES:
+                    self._disabled = True
+                    log.warning(
+                        "preverify pipeline DISABLED after %d consecutive "
+                        "CPU-race losses — the device is slower than "
+                        "libsodium on this rig right now; remaining "
+                        "catchup verifies on CPU", self._consecutive_losses)
+            elif not done and not stale:
+                # a genuine wedge (full timeout, incl. the first-collect
+                # compile grace): abandon this worker generation (the
                 # daemon thread stays blocked harmlessly); a stale job's
                 # current worker is healthy and keeps serving
                 self._worker = None
                 self._jobs = None
                 self._consecutive_wedges += 1
-                if self._consecutive_wedges >= self.MAX_CONSECUTIVE_WEDGES:
+                if first or self._consecutive_wedges >= \
+                        self.MAX_CONSECUTIVE_WEDGES:
                     self._disabled = True
                     log.warning(
                         "preverify pipeline DISABLED after %d consecutive "
@@ -360,6 +467,7 @@ class PreverifyPipeline:
                         self._consecutive_wedges)
             return
         self._consecutive_wedges = 0
+        self._consecutive_losses = 0
         verdicts = box["result"]
         pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
         keys.seed_verify_cache(
@@ -534,13 +642,17 @@ class CatchupManager:
                            accel=self.accel, accel_chunk=self.accel_chunk,
                            lookahead=lookahead, stats=self.stats,
                            accel_hot_threshold=self.accel_hot_threshold,
-                           # frame decode feeds only the accel pairing;
-                           # the native engine parses raw records itself
-                           decode_txs=not self.native or self.accel,
+                           # with the native engine, BOTH apply and accel
+                           # pairing parse raw records in C — Python frame
+                           # decode happens only on fallback checkpoints
+                           decode_txs=not self.native,
                            keep_raw=self.native,
                            verdict_sink=(bridge.seed_verdicts
                                          if bridge is not None and self.accel
-                                         else None))
+                                         else None),
+                           pair_extractor=(bridge.extract_pairs
+                                           if bridge is not None and
+                                           self.accel else None))
         work.start()
         try:
             while not work.done:

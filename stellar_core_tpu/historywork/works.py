@@ -145,7 +145,7 @@ class GetAndVerifyCheckpointWork(BasicWork):
                             for env in e.txSet.txs]
                 elif scan is not None:
                     try:
-                        rc = scan(self.network_id, r)
+                        rc, _ = scan(self.network_id, r)
                     except scan_err as exc:
                         raise CatchupError(str(exc)) from exc
                     if rc != 0:
@@ -223,6 +223,16 @@ class ApplyCheckpointWork(BasicWork):
                 self.download.ensure_decoded()
             except Exception as e:
                 return self._fail(f"tx decode failed on fallback: {e}")
+            if self.pipeline is not None:
+                # honest hit-rate denominator: the raw extraction did not
+                # count records the C parser rejected — re-count this
+                # checkpoint from the decoded frames
+                python_total = sum(
+                    len(f.signatures)
+                    for frames in self.download.frames.values()
+                    for f in frames)
+                self.pipeline.correct_total_for_fallback(
+                    self.download.checkpoint, python_total)
             return None
         if not bridge.active:
             bridge.import_from(mgr)
@@ -263,8 +273,13 @@ class ApplyCheckpointWork(BasicWork):
             if not self.pipeline.dispatched(cp):
                 # CatchupWork dispatches ahead; this is the standalone /
                 # degenerate path (e.g. the work used outside CatchupWork)
-                self.pipeline.dispatch({cp: self._checkpoint_frames()},
-                                       ledger_state=mgr.root)
+                if self.pipeline.pair_extractor is not None:
+                    self.pipeline.dispatch_raw(
+                        {cp: [self.download.raw_txs[seq]
+                              for seq in sorted(self.download.raw_txs)]})
+                else:
+                    self.pipeline.dispatch({cp: self._checkpoint_frames()},
+                                           ledger_state=mgr.root)
             self.pipeline.collect(cp)
             return State.RUNNING
         bridge = getattr(mgr, "native_bridge", None)
@@ -330,7 +345,7 @@ class CatchupWork(Work):
                  stats: Optional[dict] = None, coalesce: int = 4,
                  accel_hot_threshold: int = 1 << 62,
                  decode_txs: bool = True, keep_raw: bool = False,
-                 verdict_sink=None):
+                 verdict_sink=None, pair_extractor=None):
         super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.archive = archive
@@ -350,7 +365,8 @@ class CatchupWork(Work):
         self.pipeline = (PreverifyPipeline(network_id, accel_chunk,
                                            self.stats,
                                            hot_threshold=accel_hot_threshold,
-                                           verdict_sink=verdict_sink)
+                                           verdict_sink=verdict_sink,
+                                           pair_extractor=pair_extractor)
                          if accel else None)
         self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
         self._apply: Optional[ApplyCheckpointWork] = None
@@ -414,9 +430,15 @@ class CatchupWork(Work):
             groups.append(ready[i:i + self.coalesce])
             i += self.coalesce
         for g in groups:
-            self.pipeline.dispatch(
-                {cp: self._downloads[cp].all_frames() for cp in g},
-                ledger_state=self.mgr.root)
+            if self.pipeline.pair_extractor is not None:
+                self.pipeline.dispatch_raw(
+                    {cp: [self._downloads[cp].raw_txs[seq]
+                          for seq in sorted(self._downloads[cp].raw_txs)]
+                     for cp in g})
+            else:
+                self.pipeline.dispatch(
+                    {cp: self._downloads[cp].all_frames() for cp in g},
+                    ledger_state=self.mgr.root)
         self._next_dispatch = ready[-1] + CHECKPOINT_FREQUENCY
 
     def on_run(self) -> State:

@@ -97,6 +97,14 @@ class NativeApplyBridge:
                          max_seq: int) -> int:
         return self.engine.apply_checkpoint(header_recs, tx_recs, max_seq)
 
+    def extract_pairs(self, tx_recs: Sequence[Optional[bytes]]):
+        """Accel pairing without Python frame decode: returns (pks, sigs,
+        msgs, total_sigs) for every hint-pairable signature in the raw
+        records, using the engine state's account signers plus the
+        cumulative SetOptions harvest (exactly the PreverifyPipeline
+        pairing contract — unpaired signatures fall back to CPU verify)."""
+        return self.engine.extract_pairs(list(tx_recs))
+
     def seed_verdicts(self, pks, sigs, msgs, verdicts) -> None:
         """TPU preverify hook: push batch-verified signature verdicts into
         the engine's verify cache (identical to the Python seam in

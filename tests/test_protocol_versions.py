@@ -181,3 +181,139 @@ def test_muxed_account_gated_below_13():
             assert res.result.switch == X.TransactionResultCode.txSUCCESS, res
 
     for_all_versions(NID, body, versions=[12, 13])
+
+
+# --- systematic MIN_PROTOCOL sweep over every gated op frame --------------
+# (VERDICT r5 item 7: the gate matrix applied across the op-frame suite,
+# not just four hand-picked ops)
+
+def _sponsor_begin(root):
+    return X.Operation(body=X.OperationBody.beginSponsoringFutureReservesOp(
+        X.BeginSponsoringFutureReservesOp(
+            sponsoredID=X.AccountID.ed25519(b"\x61" * 32))))
+
+
+def _sponsor_end(root):
+    return X.Operation(body=X.OperationBody.endSponsoringFutureReserves())
+
+
+def _sponsor_revoke(root):
+    return X.Operation(body=X.OperationBody.revokeSponsorshipOp(
+        X.RevokeSponsorshipOp.ledgerKey(X.LedgerKey.account(
+            X.LedgerKeyAccount(accountID=root.account_id)))))
+
+
+ALL_GATED_OPS = [
+    (10, "bumpseq", lambda root: X.Operation(
+        body=X.OperationBody.bumpSequenceOp(X.BumpSequenceOp(bumpTo=1)))),
+    (11, "managebuy", lambda root: manage_buy_offer_op(
+        X.Asset.native(), make_asset("EUR", root.account_id), 10, 1, 1)),
+    (12, "ppstrictsend", lambda root: X.Operation(
+        body=X.OperationBody.pathPaymentStrictSendOp(
+            X.PathPaymentStrictSendOp(
+                sendAsset=X.Asset.native(), sendAmount=10,
+                destination=X.muxed_from_account_id(root.account_id),
+                destAsset=make_asset("EUR", root.account_id),
+                destMin=1, path=[])))),
+    (14, "claimablecreate", lambda root: X.Operation(
+        body=X.OperationBody.createClaimableBalanceOp(
+            X.CreateClaimableBalanceOp(
+                asset=X.Asset.native(), amount=100,
+                claimants=[X.Claimant.v0(X.ClaimantV0(
+                    destination=root.account_id,
+                    predicate=X.ClaimPredicate.unconditional()))])))),
+    (14, "claimableclaim", lambda root: X.Operation(
+        body=X.OperationBody.claimClaimableBalanceOp(
+            X.ClaimClaimableBalanceOp(
+                balanceID=X.ClaimableBalanceID.v0(b"\x01" * 32))))),
+    (14, "beginsponsor", _sponsor_begin),
+    (14, "endsponsor", _sponsor_end),
+    (14, "revokesponsor", _sponsor_revoke),
+    (17, "clawback", lambda root: X.Operation(
+        body=X.OperationBody.clawbackOp(X.ClawbackOp(
+            asset=make_asset("EUR", root.account_id),
+            from_=X.muxed_from_account_id(root.account_id), amount=1)))),
+    (17, "clawbackcb", lambda root: X.Operation(
+        body=X.OperationBody.clawbackClaimableBalanceOp(
+            X.ClawbackClaimableBalanceOp(
+                balanceID=X.ClaimableBalanceID.v0(b"\x01" * 32))))),
+    (17, "settlflags", lambda root: X.Operation(
+        body=X.OperationBody.setTrustLineFlagsOp(X.SetTrustLineFlagsOp(
+            trustor=X.AccountID.ed25519(b"\x62" * 32),
+            asset=make_asset("EUR", root.account_id),
+            clearFlags=0, setFlags=1)))),
+    (18, "pooldeposit", lambda root: X.Operation(
+        body=X.OperationBody.liquidityPoolDepositOp(X.LiquidityPoolDepositOp(
+            liquidityPoolID=b"\x01" * 32, maxAmountA=1, maxAmountB=1,
+            minPrice=X.Price(n=1, d=1), maxPrice=X.Price(n=1, d=1))))),
+    (18, "poolwithdraw", lambda root: X.Operation(
+        body=X.OperationBody.liquidityPoolWithdrawOp(
+            X.LiquidityPoolWithdrawOp(
+                liquidityPoolID=b"\x01" * 32, amount=1,
+                minAmountA=0, minAmountB=0)))),
+]
+
+
+@pytest.mark.parametrize("min_version,name,build", ALL_GATED_OPS,
+                         ids=[t[1] for t in ALL_GATED_OPS])
+def test_every_gated_op_rejects_below_and_dispatches_at(min_version, name,
+                                                        build):
+    """Below its introduction version every gated op returns
+    opNOT_SUPPORTED; at it, the op is dispatched (may fail for state
+    reasons, never opNOT_SUPPORTED)."""
+    def body(mgr, version):
+        root = _root(mgr)
+        fr = root.tx([build(root)])
+        arts = mgr.close_ledger([fr], 1000)
+        res = _result_of(arts, fr)
+        op_res = res.result.value[0] if res.result.value else None
+        if version < min_version:
+            assert res.result.switch in (
+                X.TransactionResultCode.txFAILED,
+                X.TransactionResultCode.txBAD_SPONSORSHIP), (name, version)
+            if res.result.switch == X.TransactionResultCode.txFAILED:
+                assert op_res.switch == X.OperationResultCode.opNOT_SUPPORTED, \
+                    (name, version, op_res)
+        else:
+            assert op_res is None or \
+                op_res.switch != X.OperationResultCode.opNOT_SUPPORTED, \
+                (name, version, op_res)
+
+    for_all_versions(NID, body, versions=[min_version - 1, min_version])
+
+
+def test_starting_sequence_number_all_versions():
+    """Created accounts start at ledgerSeq << 32 under every protocol
+    (reference: getStartingSequenceNumber)."""
+    def body(mgr, version):
+        root = _root(mgr)
+        dest = X.AccountID.ed25519(b"\x63" * 32)
+        arts = mgr.close_ledger([root.tx([create_account_op(dest, 10**10)])],
+                                1000)
+        e = mgr.root.get_entry(X.LedgerKey.account(
+            X.LedgerKeyAccount(accountID=dest)).to_xdr())
+        assert e.data.value.seqNum == mgr.last_closed_ledger_seq << 32, \
+            version
+
+    for_all_versions(NID, body)
+
+
+def test_zero_balance_create_account_gate_at_14():
+    """startingBalance == 0 is MALFORMED below v14 (CAP-33) and
+    LOW_RESERVE (unsponsored) from v14 on."""
+    def body(mgr, version):
+        root = _root(mgr)
+        dest = X.AccountID.ed25519(b"\x64" * 32)
+        fr = root.tx([create_account_op(dest, 0)])
+        arts = mgr.close_ledger([fr], 1000)
+        res = _result_of(arts, fr)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        code = res.result.value[0].value.value.switch
+        if version < 14:
+            assert code == \
+                X.CreateAccountResultCode.CREATE_ACCOUNT_MALFORMED, version
+        else:
+            assert code == \
+                X.CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE, version
+
+    for_all_versions(NID, body, versions=[13, 14])
