@@ -1,0 +1,113 @@
+"""MXU int32-limb probe (VERDICT r5 item 5, SURVEY §7 hard-parts).
+
+Question: can the field mults inside the Ed25519 scan use the MXU?
+
+Algebra first: a general batched field mul c[n] = a[n]*b[n] is a
+per-element limb convolution — BILINEAR in two per-element operands, so
+it cannot be phrased as X @ W with a shared W (the MXU contract).  The
+one shape that CAN: multiplying every element by a SHARED constant p
+(e.g. one base/table point coordinate): c[n,k] = sum_i a[n,i] * p[k-i]
+is (N,L) @ Toeplitz(p) — a real matmul.  Exactness bounds the operand
+radix: int8 limbs (radix 2^8, 32 limbs) keep products in int16 and a
+63-column accumulation under 2^21 « int32.
+
+So the question reduces to: does THIS backend run int8xint8->int32
+matmuls at MXU rate?  This probe measures 32-step dependent chains
+inside ONE jit dispatch (the tunnel's ~0.3s launch latency would swamp
+per-matmul timing otherwise):
+  1. (32768,64) int8 @ (64,64) const int8 -> int32 -> re-narrowed int8
+  2. same chain in bf16 (MXU reference rate)
+  3. the production VPU int64 radix-16 field mul, 32 dependent muls
+and reports chain-steps/s for each route, interleaved medians of 5.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = 32768
+STEPS = 32
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from stellar_core_tpu.accel import field as F
+
+    rng = np.random.default_rng(5)
+    a8 = jnp.asarray(rng.integers(0, 127, (N, 64), dtype=np.int8))
+    t8 = jnp.asarray(rng.integers(0, 127, (64, 64), dtype=np.int8))
+    abf = a8.astype(jnp.bfloat16)
+    tbf = t8.astype(jnp.bfloat16)
+
+    @jax.jit
+    def chain_int8(x, w):
+        def step(i, acc):
+            prod = jax.lax.dot_general(
+                acc, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return (prod & 0x7F).astype(jnp.int8)   # renarrow: stay integer
+        return jax.lax.fori_loop(0, STEPS, step, x)
+
+    @jax.jit
+    def chain_bf16(x, w):
+        def step(i, acc):
+            prod = jax.lax.dot_general(
+                acc, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return (prod % 127.0).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, STEPS, step, x)
+
+    av = jnp.asarray(rng.integers(0, 1 << 16, (N, F.NLIMB), dtype=np.int64))
+    bv = jnp.asarray(rng.integers(0, 1 << 16, (N, F.NLIMB), dtype=np.int64))
+
+    @jax.jit
+    def chain_vpu(x, y):
+        def step(i, acc):
+            return F.fe_mul(acc, y)
+        return jax.lax.fori_loop(0, STEPS, step, x)
+
+    # one-shot exactness check of the int8->int32 matmul
+    one = jax.jit(lambda x, w: jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32))
+    got = np.asarray(one(a8, t8))
+    want = np.asarray(a8, dtype=np.int32) @ np.asarray(t8, dtype=np.int32)
+    print(f"int8->int32 matmul exact: {bool((got == want).all())}",
+          flush=True)
+
+    np.asarray(chain_int8(a8, t8))     # compiles + warm
+    np.asarray(chain_bf16(abf, tbf))
+    np.asarray(chain_vpu(av, bv))
+
+    reps = {"int8_chain": [], "bf16_chain": [], "vpu_int64_chain": []}
+    for r in range(5):
+        t0 = time.perf_counter()
+        np.asarray(chain_int8(a8, t8))
+        reps["int8_chain"].append(STEPS * N / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        np.asarray(chain_bf16(abf, tbf))
+        reps["bf16_chain"].append(STEPS * N / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        np.asarray(chain_vpu(av, bv))
+        reps["vpu_int64_chain"].append(STEPS * N / (time.perf_counter() - t0))
+        print(f"round {r}: " + "  ".join(
+            f"{k}={v[-1]/1e6:.2f}M steps/s" for k, v in reps.items()),
+            flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    print("MEDIANS (chain steps/s; one step = one 'mul by shared const'):")
+    for k, v in reps.items():
+        print(f"  {k}: {med(v)/1e6:.2f}M/s")
+    print(f"int8 vs vpu: "
+          f"{med(reps['int8_chain'])/med(reps['vpu_int64_chain']):.2f}x "
+          f"(applies to shared-constant muls only; the general a*b muls "
+          f"of the double-scalarmult are bilinear and stay on the VPU)")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,13 +25,14 @@ def main():
         print("archive ledgers:", n, flush=True)
         keys.clear_verify_cache()
         cmw = CatchupManager(nid, "bench network", accel=True,
-                             accel_chunk=8192)
+                             accel_chunk=8192, accel_hot_threshold=4)
         cmw.catchup_complete(archive, to_ledger=127)
         print("warmed", flush=True)
         rates = {"cpu": [], "accel": [], "py_cpu": []}
         for r in range(3):
             for name, kw in (("cpu", dict(accel=False)),
-                             ("accel", dict(accel=True, accel_chunk=8192)),
+                             ("accel", dict(accel=True, accel_chunk=8192,
+                                            accel_hot_threshold=4)),
                              ("py_cpu", dict(accel=False, native=False))):
                 keys.clear_verify_cache()
                 cm = CatchupManager(nid, "bench network", **kw)
@@ -48,7 +49,8 @@ def main():
                         f"{cm.stats.get('collect_wait_s', 0):.2f}"
                         f" dispatch={cm.stats.get('dispatch_s', 0):.2f}"
                         f" sodium="
-                        f"{cm.stats.get('native_libsodium_verifies')}")
+                        f"{cm.stats.get('native_libsodium_verifies')}"
+                        f" losses={cm.stats.get('race_losses', 0)}")
                 print(f"round {r} {name}: {n/dt:.1f} l/s ({dt:.2f}s){extra}",
                       flush=True)
         med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731

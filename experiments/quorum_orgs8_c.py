@@ -1,0 +1,44 @@
+"""Measure the NATIVE C enumerator on the asym orgs=8 map with a hard
+interrupt cap (VERDICT r4 item 2: the promised native-C orgs=8 number was
+never recorded — record it, or an honest TIMEOUT).
+
+CPU-only: safe to run while the chip is busy elsewhere, but keep other
+host load off (1-core host).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(cap_s=1200.0):
+    from stellar_core_tpu.herder.quorum_intersection import (
+        InterruptedError_, QuorumIntersectionChecker, _cquorum)
+    from stellar_core_tpu.testutils import asym_org_qmap
+
+    assert _cquorum is not None, "build the native engine first"
+    qmap = asym_org_qmap(8)
+    t0 = time.perf_counter()
+
+    def interrupt():
+        return time.perf_counter() - t0 > cap_s
+
+    checker = QuorumIntersectionChecker(qmap, interrupt=interrupt)
+    try:
+        res = checker.check()
+        dt = time.perf_counter() - t0
+        print(f"orgs=8 native C: {dt:.1f}s intersects={res.intersects} "
+              f"max_quorums={res.max_quorums_found}", flush=True)
+        if dt > 900.0:
+            print("NOTE: above the 900s operational budget", flush=True)
+    except InterruptedError_:
+        dt = time.perf_counter() - t0
+        print(f"orgs=8 native C: TIMEOUT > {cap_s:.0f}s "
+              f"(interrupted at {dt:.1f}s; 900s operational budget blown)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 1200.0)

@@ -485,7 +485,11 @@ class TPUQuorumIntersectionChecker:
     # hundreds of thousands: falling off the resident path there costs
     # hundreds of chunked dispatches per depth (W is 1-2 words, so even
     # 1M rows is only ~8 MB of frontier).
-    CAPACITY_BUCKETS = (1024, 4096, 16384, 65536, 262144, 1048576)
+    # top bucket 4M rows (r5): at orgs=8 the frontier outgrows 1M and the
+    # resident path fell back to 65536-row host chunks for most depths
+    # (r4: 1995s).  A 4M-row frontier is 16 MB/word-column in HBM —
+    # trivial against 16 GB — and keeps orgs=8 device-resident.
+    CAPACITY_BUCKETS = (1024, 4096, 16384, 65536, 262144, 1048576, 4194304)
 
     def _run_resident(self, bits_all, rems_all, process_witness
                       ) -> Optional[QuorumIntersectionResult]:
