@@ -1065,15 +1065,51 @@ parse_op(Rd *r, COp *op, CTx *tx)
         if (at == 0) {
             /* native asset */
         } else if (at == 1) {
-            rd_skip(r, 4); rd_skip(r, 4 + 32);
+            rd_skip(r, 4);
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }
+            rd_skip(r, 32);
         } else if (at == 2) {
-            rd_skip(r, 12); rd_skip(r, 4 + 32);
+            rd_skip(r, 12);
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }
+            rd_skip(r, 32);
         } else { r->err = 1; return -1; }
         rd_skip(r, 8);
-        if (at != 0)
-            return 1;           /* parseable but unsupported: credit asset */
         break;
     }
+    case 6: {                                 /* CHANGE_TRUST */
+        uint32_t lt = rd_u32(r);
+        if (lt == 0) {
+            /* native line: applies natively (MALFORMED result) */
+        } else if (lt == 1 || lt == 2) {
+            rd_skip(r, lt == 1 ? 4 : 12);
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }
+            rd_skip(r, 32);
+        } else if (lt == 3) {
+            return 1;           /* pool-share trustline: fall back */
+        } else { r->err = 1; return -1; }
+        rd_skip(r, 8);
+        break;
+    }
+    case 8: {                                 /* ACCOUNT_MERGE */
+        uint32_t mt = rd_u32(r);
+        if (mt == 0x100) { tx->has_muxed = 1; rd_skip(r, 8); }
+        else if (mt != 0) { r->err = 1; return -1; }
+        rd_skip(r, 32);
+        break;
+    }
+    case 10: {                                /* MANAGE_DATA */
+        uint32_t sl;
+        if (!rd_varopaque(r, 64, &sl)) return -1;
+        uint32_t hv = rd_u32(r);
+        if (hv > 1) { r->err = 1; return -1; }
+        if (hv) {
+            if (!rd_varopaque(r, 64, &sl)) return -1;
+        }
+        break;
+    }
+    case 11:                                  /* BUMP_SEQUENCE */
+        rd_skip(r, 8);
+        break;
     case 5: {                                 /* SET_OPTIONS */
         /* 4 optionals u32-ish + homeDomain + signer */
         uint32_t p;
@@ -2427,6 +2463,13 @@ remove_one_time_signers_c(Engine *e, CTx *tx)
     return 0;
 }
 
+/* round-5 widened op set (defined below the checkpoint machinery) */
+static int op_payment_credit(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_change_trust(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_manage_data(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_bump_sequence(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_account_merge(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+
 /* apply one tx; appends its TransactionResult XDR to `out`.  Mirrors
  * TransactionFrame.apply: all-or-nothing via tx_delta. */
 static int
@@ -2470,9 +2513,17 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
     for (int i = 0; i < tx->n_ops; i++) {
         COp *op = &tx->ops[i];
         const uint8_t *op_src = op->has_source ? op->source : tx->source;
-        /* op.check_valid: version gate (all three native ops are v0+),
-         * then signature check at the op's threshold, then static checks
-         * + apply fused in the op functions */
+        /* op.check_valid: version gate, then signature check at the op's
+         * threshold, then static checks + apply fused in the op
+         * functions */
+        /* version gates run FIRST (mirror OperationFrame.check_valid:
+         * MIN_PROTOCOL_VERSION precedes the signature check) —
+         * BumpSequence is v10+ */
+        if (op->op_type == 11 && h->ledger_version < 10) {
+            if (res_outer(&ops_buf, -3) < 0) { rc = -1; goto done; }
+            ok = 0;
+            continue;
+        }
         CAccount op_acc;
         int got = eng_get_account(e, op_src, &op_acc);
         if (got < 0) { rc = -1; goto done; }
@@ -2481,7 +2532,11 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
             ok = 0;
             continue;
         }
-        int threshold_level = op->op_type == 5 ? 3 : 2;  /* HIGH : MED */
+        /* thresholds: SetOptions/AccountMerge HIGH, BumpSequence LOW,
+         * everything else MED (mirror the op frames' threshold_level) */
+        int threshold_level =
+            (op->op_type == 5 || op->op_type == 8) ? 3 :
+            (op->op_type == 11) ? 1 : 2;
         if (!check_account_sig(&ck, &op_acc, threshold_level)) {
             if (res_outer(&ops_buf, -1) < 0) { rc = -1; goto done; }
             ok = 0;
@@ -2490,8 +2545,23 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
         int r;
         switch (op->op_type) {
         case 0: r = op_create_account(e, tx, op, op_src, &ops_buf); break;
-        case 1: r = op_payment(e, tx, op, op_src, &ops_buf); break;
+        case 1: {
+            /* dispatch on asset arm (native vs credit) */
+            Rd ar;
+            rd_init(&ar, op->body, op->body_len);
+            uint32_t mt = rd_u32(&ar);
+            if (mt == 0x100) rd_skip(&ar, 8);
+            rd_skip(&ar, 32);
+            uint32_t at = rd_u32(&ar);
+            r = at == 0 ? op_payment(e, tx, op, op_src, &ops_buf)
+                        : op_payment_credit(e, tx, op, op_src, &ops_buf);
+            break;
+        }
         case 5: r = op_set_options(e, tx, op, op_src, &ops_buf); break;
+        case 6: r = op_change_trust(e, tx, op, op_src, &ops_buf); break;
+        case 8: r = op_account_merge(e, tx, op, op_src, &ops_buf); break;
+        case 10: r = op_manage_data(e, tx, op, op_src, &ops_buf); break;
+        case 11: r = op_bump_sequence(e, tx, op, op_src, &ops_buf); break;
         default: r = -1; break;
         }
         if (r < 0) { rc = -1; goto done; }
@@ -3539,4 +3609,726 @@ PyInit__capply(void)
     PyModule_AddObject(m, "Error", CapplyError);
     load_sodium();
     return m;
+}
+
+/* ---- TrustLine / Data entries (round-5 widening) ---------------------- */
+
+typedef struct {
+    /* LedgerEntry level */
+    uint32_t last_modified;
+    int entry_ext_v1;
+    int has_sponsor;
+    uint8_t sponsor[32];
+    /* TrustLineEntry */
+    uint8_t account_id[32];
+    uint32_t asset_type;        /* 1 alphanum4 / 2 alphanum12 (native and
+                                   pool-share never stored natively) */
+    uint8_t asset_code[12];
+    uint8_t issuer[32];
+    int64_t balance;
+    int64_t limit;
+    uint32_t flags;
+    int ext_level;              /* 0 v0; 1 v1; 2 v1+v2 */
+    int64_t liab_buying, liab_selling;
+    int32_t pool_use_count;
+} CTrustLine;
+
+static int
+parse_trustline_entry(const uint8_t *data, int len, CTrustLine *t)
+{
+    memset(t, 0, sizeof(*t));
+    Rd r;
+    rd_init(&r, data, len);
+    t->last_modified = rd_u32(&r);
+    if (rd_u32(&r) != 1 || r.err)       /* data tag TRUSTLINE */
+        return -1;
+    if (parse_account_id(&r, t->account_id) < 0)
+        return -1;
+    t->asset_type = rd_u32(&r);
+    if (r.err)
+        return -1;
+    if (t->asset_type == 1) {
+        const uint8_t *c = rd_take(&r, 4);
+        if (!c) return -1;
+        memcpy(t->asset_code, c, 4);
+        if (parse_account_id(&r, t->issuer) < 0) return -1;
+    } else if (t->asset_type == 2) {
+        const uint8_t *c = rd_take(&r, 12);
+        if (!c) return -1;
+        memcpy(t->asset_code, c, 12);
+        if (parse_account_id(&r, t->issuer) < 0) return -1;
+    } else {
+        return -1;              /* native/pool-share: not native-applied */
+    }
+    t->balance = rd_i64(&r);
+    t->limit = rd_i64(&r);
+    t->flags = rd_u32(&r);
+    int32_t ext = rd_i32(&r);
+    if (r.err || (ext != 0 && ext != 1))
+        return -1;
+    if (ext == 1) {
+        t->ext_level = 1;
+        t->liab_buying = rd_i64(&r);
+        t->liab_selling = rd_i64(&r);
+        int32_t e1 = rd_i32(&r);
+        if (r.err || (e1 != 0 && e1 != 2))
+            return -1;
+        if (e1 == 2) {
+            t->ext_level = 2;
+            t->pool_use_count = rd_i32(&r);
+            if (rd_i32(&r) != 0 || r.err)
+                return -1;
+        }
+    }
+    int32_t lext = rd_i32(&r);
+    if (r.err || (lext != 0 && lext != 1))
+        return -1;
+    t->entry_ext_v1 = (int)lext;
+    if (lext == 1) {
+        uint32_t sp = rd_u32(&r);
+        if (r.err || sp > 1)
+            return -1;
+        t->has_sponsor = (int)sp;
+        if (sp && parse_account_id(&r, t->sponsor) < 0)
+            return -1;
+        if (rd_i32(&r) != 0 || r.err)
+            return -1;
+    }
+    return (r.err || r.off != r.len) ? -1 : 0;
+}
+
+static int
+write_tl_asset(Buf *b, uint32_t asset_type, const uint8_t code[12],
+               const uint8_t issuer[32])
+{
+    if (buf_u32(b, asset_type) < 0)
+        return -1;
+    if (buf_put(b, code, asset_type == 1 ? 4 : 12) < 0)
+        return -1;
+    return write_account_id(b, issuer);
+}
+
+static int
+serialize_trustline_entry(const CTrustLine *t, Buf *b)
+{
+    if (buf_u32(b, t->last_modified) < 0 ||
+        buf_u32(b, 1) < 0 ||
+        write_account_id(b, t->account_id) < 0 ||
+        write_tl_asset(b, t->asset_type, t->asset_code, t->issuer) < 0 ||
+        buf_i64(b, t->balance) < 0 ||
+        buf_i64(b, t->limit) < 0 ||
+        buf_u32(b, t->flags) < 0 ||
+        buf_i32(b, t->ext_level >= 1 ? 1 : 0) < 0)
+        return -1;
+    if (t->ext_level >= 1) {
+        if (buf_i64(b, t->liab_buying) < 0 ||
+            buf_i64(b, t->liab_selling) < 0 ||
+            buf_i32(b, t->ext_level >= 2 ? 2 : 0) < 0)
+            return -1;
+        if (t->ext_level >= 2) {
+            if (buf_i32(b, t->pool_use_count) < 0 || buf_i32(b, 0) < 0)
+                return -1;
+        }
+    }
+    if (buf_i32(b, t->entry_ext_v1) < 0)
+        return -1;
+    if (t->entry_ext_v1) {
+        if (buf_u32(b, (uint32_t)t->has_sponsor) < 0)
+            return -1;
+        if (t->has_sponsor && write_account_id(b, t->sponsor) < 0)
+            return -1;
+        if (buf_i32(b, 0) < 0)
+            return -1;
+    }
+    return 0;
+}
+
+/* trustline LedgerKey XDR: tag 1 + accountID + TrustLineAsset */
+static int
+trustline_key_xdr_c(const uint8_t acc[32], uint32_t asset_type,
+                    const uint8_t code[12], const uint8_t issuer[32],
+                    Buf *b)
+{
+    if (buf_u32(b, 1) < 0 || write_account_id(b, acc) < 0)
+        return -1;
+    return write_tl_asset(b, asset_type, code, issuer);
+}
+
+/* mirror utils.add_trustline_balance */
+static int
+add_tl_balance_c(CTrustLine *t, int64_t delta)
+{
+    i128 nb = (i128)t->balance + delta;
+    if (nb < 0 || nb > t->limit)
+        return 0;
+    if (delta < 0 && nb < t->liab_selling)
+        return 0;
+    if (delta > 0 && nb > (i128)t->limit - t->liab_buying)
+        return 0;
+    t->balance = (int64_t)nb;
+    return 1;
+}
+
+/* mirror utils.asset_valid for alphanum codes */
+static int
+asset_code_valid(uint32_t asset_type, const uint8_t *code)
+{
+    int maxlen = asset_type == 1 ? 4 : 12;
+    int n = maxlen;
+    while (n > 0 && code[n - 1] == 0)
+        n--;
+    if (n == 0)
+        return 0;
+    for (int i = 0; i < n; i++) {
+        uint8_t c = code[i];
+        if (c == 0)
+            return 0;                   /* embedded NUL before padding */
+        if (!((c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+              (c >= 'a' && c <= 'z')))
+            return 0;
+    }
+    if (asset_type == 1)
+        return n <= 4;
+    return n >= 5;
+}
+
+/* ---- round-5 widened op set ------------------------------------------- */
+
+/* shared: release a sponsored entry's reserve units from its sponsor
+ * (mirror sponsorship.release_entry_sponsorship sponsor side; the owner
+ * side (numSponsored) is the caller's CAccount). Returns -1 on count
+ * underflow (fail-stop, like the oracle's RuntimeError). */
+static int
+release_entry_sponsor(Engine *e, const uint8_t sponsor[32], int mult,
+                      CAccount *owner)
+{
+    CAccount sp;
+    int g = eng_get_account(e, sponsor, &sp);
+    if (g < 0)
+        return -1;
+    if (g) {
+        if ((int)sp.num_sponsoring < mult)
+            return -1;
+        sp.num_sponsoring -= (uint32_t)mult;
+        sp.last_modified = e->header.ledger_seq;
+        if (eng_put_account(e, &e->tx_delta, &sp) < 0)
+            return -1;
+    }
+    if (owner != NULL) {
+        if ((int)owner->num_sponsored < mult)
+            return -1;
+        owner->num_sponsored -= (uint32_t)mult;
+    }
+    return 0;
+}
+
+static int
+is_issuer_c(const uint8_t acc[32], uint32_t asset_type,
+            const uint8_t issuer[32])
+{
+    (void)asset_type;
+    return memcmp(acc, issuer, 32) == 0;
+}
+
+/* one side of a credit payment: load/auth/adjust/store the trustline of
+ * `acc`.  Returns 1 ok, 0 failed (fail_code written as the op result),
+ * -1 engine error.  no_trust/not_auth/balance_fail are the side's result
+ * codes (src: -3/-4/-2; dest: -6/-7/-8). */
+static int
+payment_tl_side(Engine *e, Buf *rb, const uint8_t acc[32],
+                uint32_t asset_type, const uint8_t code[12],
+                const uint8_t issuer[32], int64_t delta,
+                int no_trust, int not_auth, int balance_fail)
+{
+    Buf kb = {0};
+    if (trustline_key_xdr_c(acc, asset_type, code, issuer, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    int rc = -1;
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        rc = res_inner(rb, 1, no_trust) < 0 ? -1 : 0;
+        goto out;
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0)
+        goto out;
+    if (!(tl.flags & 1)) {                        /* AUTHORIZED */
+        rc = res_inner(rb, 1, not_auth) < 0 ? -1 : 0;
+        goto out;
+    }
+    if (!add_tl_balance_c(&tl, delta)) {
+        rc = res_inner(rb, 1, balance_fail) < 0 ? -1 : 0;
+        goto out;
+    }
+    tl.last_modified = e->header.ledger_seq;
+    Buf eb = {0};
+    if (serialize_trustline_entry(&tl, &eb) < 0) {
+        PyMem_Free(eb.p);
+        goto out;
+    }
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    if (!val || eng_put(e, &e->tx_delta, kb.p, kb.len, val) < 0)
+        goto out;
+    rc = 1;
+out:
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* credit-asset arm of PaymentOpFrame (native arm lives in op_payment) */
+static int
+op_payment_credit(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+                  Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t mt = rd_u32(&r);
+    if (mt == 0x100)
+        rd_skip(&r, 8);
+    const uint8_t *dest = rd_take(&r, 32);
+    uint32_t at = rd_u32(&r);
+    uint8_t code[12] = {0};
+    uint8_t issuer[32];
+    const uint8_t *c = rd_take(&r, at == 1 ? 4 : 12);
+    if (!c) return -1;
+    memcpy(code, c, at == 1 ? 4 : 12);
+    if (rd_u32(&r) != 0) { return -1; }          /* PK type */
+    const uint8_t *iq = rd_take(&r, 32);
+    if (!iq) return -1;
+    memcpy(issuer, iq, 32);
+    int64_t amount = rd_i64(&r);
+    if (!dest || r.err)
+        return -1;
+
+    /* do_check_valid: amount > 0, asset code valid */
+    if (amount <= 0 || !asset_code_valid(at, code))
+        return res_inner(rb, 1, -1) < 0 ? -1 : 0;    /* MALFORMED */
+
+    CAccount dst_acc;
+    int got = eng_get_account(e, dest, &dst_acc);
+    if (got < 0)
+        return -1;
+    if (!got)
+        return res_inner(rb, 1, -5) < 0 ? -1 : 0;    /* NO_DESTINATION */
+
+    /* source side (SRC_NO_TRUST/SRC_NOT_AUTHORIZED/UNDERFUNDED) */
+    if (!is_issuer_c(src_id, at, issuer)) {
+        int rc2 = payment_tl_side(e, rb, src_id, at, code, issuer, -amount,
+                                  -3, -4, -2);
+        if (rc2 <= 0)
+            return rc2;
+    }
+    /* destination side (NO_TRUST/NOT_AUTHORIZED/LINE_FULL) */
+    if (!is_issuer_c(dest, at, issuer)) {
+        int rc2 = payment_tl_side(e, rb, dest, at, code, issuer, amount,
+                                  -6, -7, -8);
+        if (rc2 <= 0)
+            return rc2;
+    }
+    return res_inner(rb, 1, 0) < 0 ? -1 : 1;
+}
+
+/* mirror ChangeTrustOpFrame, classic-asset arm (pool share probe-rejected) */
+static int
+op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+                Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t lt = rd_u32(&r);
+    uint8_t code[12] = {0};
+    uint8_t issuer[32] = {0};
+    if (lt == 1 || lt == 2) {
+        const uint8_t *c = rd_take(&r, lt == 1 ? 4 : 12);
+        if (!c) return -1;
+        memcpy(code, c, lt == 1 ? 4 : 12);
+        if (rd_u32(&r) != 0) return -1;
+        const uint8_t *iq = rd_take(&r, 32);
+        if (!iq) return -1;
+        memcpy(issuer, iq, 32);
+    } else if (lt != 0) {
+        return -1;              /* pool share: probe rejected */
+    }
+    int64_t limit = rd_i64(&r);
+    if (r.err)
+        return -1;
+    CHeader *h = &e->header;
+
+    /* do_check_valid */
+    if (lt == 0)
+        return res_inner(rb, 6, -1) < 0 ? -1 : 0;   /* native: MALFORMED */
+    if (!asset_code_valid(lt, code) || limit < 0)
+        return res_inner(rb, 6, -1) < 0 ? -1 : 0;
+    if (is_issuer_c(src_id, lt, issuer))
+        return res_inner(rb, 6, -5) < 0 ? -1 : 0;   /* SELF_NOT_ALLOWED */
+
+    Buf kb = {0};
+    if (trustline_key_xdr_c(src_id, lt, code, issuer, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    uint8_t ik[40];
+    account_key_xdr_c(issuer, ik);
+
+#define CT_FAIL(code_) do { \
+        int rr = res_inner(rb, 6, (code_)); \
+        PyMem_Free(kb.p); \
+        return rr < 0 ? -1 : 0; \
+    } while (0)
+
+    if (rec == NULL) {
+        if (limit == 0)
+            CT_FAIL(-3);                             /* INVALID_LIMIT */
+        RB *issuer_rec = eng_get(e, ik, 40);
+        if (issuer_rec == NULL)
+            CT_FAIL(-2);                             /* NO_ISSUER */
+        CAccount iss;
+        if (parse_account_entry(issuer_rec->bytes, issuer_rec->len,
+                                &iss) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (!add_num_entries_c(h, &src, 1))
+            CT_FAIL(-4);                             /* LOW_RESERVE */
+        uint32_t flags = 0;
+        if (!(iss.flags & 0x1))                      /* AUTH_REQUIRED */
+            flags |= 1;                              /* AUTHORIZED */
+        if (iss.flags & 0x8)                         /* CLAWBACK_ENABLED */
+            flags |= 4;                              /* TL_CLAWBACK */
+        if (eng_put_account(e, &e->tx_delta, &src) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        CTrustLine tl;
+        memset(&tl, 0, sizeof(tl));
+        tl.last_modified = h->ledger_seq;
+        memcpy(tl.account_id, src_id, 32);
+        tl.asset_type = lt;
+        memcpy(tl.asset_code, code, 12);
+        memcpy(tl.issuer, issuer, 32);
+        tl.limit = limit;
+        tl.flags = flags;
+        Buf eb = {0};
+        if (serialize_trustline_entry(&tl, &eb) < 0) {
+            PyMem_Free(kb.p); PyMem_Free(eb.p);
+            return -1;
+        }
+        RB *val = rb_new(eb.p, eb.len);
+        PyMem_Free(eb.p);
+        int rc2 = val ? eng_put(e, &e->tx_delta, kb.p, kb.len, val) : -1;
+        PyMem_Free(kb.p);
+        if (rc2 < 0)
+            return -1;
+        return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+    }
+
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (limit == 0) {
+        if (tl.balance != 0)
+            CT_FAIL(-3);                             /* INVALID_LIMIT */
+        if (tl.liab_buying || tl.liab_selling)
+            CT_FAIL(-7);                             /* CANNOT_DELETE */
+        if (eng_put(e, &e->tx_delta, kb.p, kb.len, NULL) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (tl.has_sponsor) {
+            if (release_entry_sponsor(e, tl.sponsor, 1, &src) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+            src.num_sub -= 1;
+        } else {
+            add_num_entries_c(h, &src, -1);
+        }
+        int rc2 = eng_put_account(e, &e->tx_delta, &src);
+        PyMem_Free(kb.p);
+        if (rc2 < 0)
+            return -1;
+        return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+    }
+    if ((i128)limit < (i128)tl.balance + tl.liab_buying)
+        CT_FAIL(-3);                                 /* INVALID_LIMIT */
+    if (eng_get(e, ik, 40) == NULL)
+        CT_FAIL(-2);                                 /* NO_ISSUER */
+    tl.limit = limit;
+    tl.last_modified = h->ledger_seq;
+    Buf eb = {0};
+    if (serialize_trustline_entry(&tl, &eb) < 0) {
+        PyMem_Free(kb.p); PyMem_Free(eb.p);
+        return -1;
+    }
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    int rc2 = val ? eng_put(e, &e->tx_delta, kb.p, kb.len, val) : -1;
+    PyMem_Free(kb.p);
+    if (rc2 < 0)
+        return -1;
+    return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+#undef CT_FAIL
+}
+
+/* mirror ManageDataOpFrame */
+static int
+op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+               Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t name_len;
+    const uint8_t *name = rd_varopaque(&r, 64, &name_len);
+    if (!name)
+        return -1;
+    uint32_t has_val = rd_u32(&r);
+    if (r.err || has_val > 1)
+        return -1;
+    const uint8_t *val = NULL;
+    uint32_t val_len = 0;
+    if (has_val) {
+        val = rd_varopaque(&r, 64, &val_len);
+        if (!val)
+            return -1;
+    }
+    CHeader *h = &e->header;
+
+    /* do_check_valid: 1..64 ascii bytes */
+    if (name_len == 0)
+        return res_inner(rb, 10, -4) < 0 ? -1 : 0;   /* INVALID_NAME */
+    for (uint32_t i = 0; i < name_len; i++)
+        if (name[i] > 0x7F)
+            return res_inner(rb, 10, -4) < 0 ? -1 : 0;
+
+    /* data LedgerKey: tag 3 + accountID + string64 name */
+    Buf kb = {0};
+    if (buf_u32(&kb, 3) < 0 || write_account_id(&kb, src_id) < 0 ||
+        buf_varopaque(&kb, name, (int)name_len) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+
+    if (!has_val) {                                  /* delete */
+        if (rec == NULL) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 10, -2) < 0 ? -1 : 0;  /* NAME_NOT_FOUND */
+        }
+        /* entry-level sponsor lives in the LedgerEntry ext: parse the
+         * tail.  DataEntry layout: lastMod + tag3 + acct + name + value
+         * + ext0 + entry-ext.  Walk it. */
+        Rd dr;
+        rd_init(&dr, rec->bytes, rec->len);
+        rd_skip(&dr, 8);                             /* lastMod + tag */
+        rd_skip(&dr, 36);                            /* accountID */
+        uint32_t nl, vl;
+        if (!rd_varopaque(&dr, 64, &nl) || !rd_varopaque(&dr, 64, &vl) ||
+            rd_i32(&dr) != 0 || dr.err) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        int32_t lext = rd_i32(&dr);
+        int sponsored = 0;
+        uint8_t sponsor[32];
+        if (dr.err || (lext != 0 && lext != 1)) {
+            PyMem_Free(kb.p);
+            return -1;           /* corrupt stored entry: fail-stop */
+        }
+        if (lext == 1) {
+            uint32_t sp = rd_u32(&dr);
+            if (dr.err || sp > 1) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+            if (sp == 1) {
+                if (rd_u32(&dr) != 0) {           /* PK type */
+                    PyMem_Free(kb.p);
+                    return -1;
+                }
+                const uint8_t *q = rd_take(&dr, 32);
+                if (!q || rd_i32(&dr) != 0 || dr.err) {
+                    PyMem_Free(kb.p);
+                    return -1;
+                }
+                memcpy(sponsor, q, 32);
+                sponsored = 1;
+            } else if (rd_i32(&dr) != 0 || dr.err) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+        }
+        if (eng_put(e, &e->tx_delta, kb.p, kb.len, NULL) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (sponsored) {
+            if (release_entry_sponsor(e, sponsor, 1, &src) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+            src.num_sub -= 1;
+        } else {
+            add_num_entries_c(h, &src, -1);
+        }
+        int rc2 = eng_put_account(e, &e->tx_delta, &src);
+        PyMem_Free(kb.p);
+        if (rc2 < 0)
+            return -1;
+        return res_inner(rb, 10, 0) < 0 ? -1 : 1;
+    }
+
+    Buf eb = {0};
+    int rc2;
+    if (rec == NULL) {                               /* create */
+        if (!add_num_entries_c(h, &src, 1)) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 10, -3) < 0 ? -1 : 0;  /* LOW_RESERVE */
+        }
+        if (eng_put_account(e, &e->tx_delta, &src) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+    } else {
+        /* update: preserve the entry-level ext (sponsorship) */
+        Rd dr;
+        rd_init(&dr, rec->bytes, rec->len);
+        rd_skip(&dr, 8 + 36);
+        uint32_t nl, vl;
+        if (!rd_varopaque(&dr, 64, &nl) || !rd_varopaque(&dr, 64, &vl) ||
+            rd_i32(&dr) != 0 || dr.err) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        int ext_off = dr.off;
+        if (buf_u32(&eb, h->ledger_seq) < 0 || buf_u32(&eb, 3) < 0 ||
+            write_account_id(&eb, src_id) < 0 ||
+            buf_varopaque(&eb, name, (int)name_len) < 0 ||
+            buf_varopaque(&eb, val, (int)val_len) < 0 ||
+            buf_i32(&eb, 0) < 0 ||
+            buf_put(&eb, rec->bytes + ext_off, rec->len - ext_off) < 0) {
+            PyMem_Free(kb.p); PyMem_Free(eb.p);
+            return -1;
+        }
+        RB *v = rb_new(eb.p, eb.len);
+        PyMem_Free(eb.p);
+        rc2 = v ? eng_put(e, &e->tx_delta, kb.p, kb.len, v) : -1;
+        PyMem_Free(kb.p);
+        if (rc2 < 0)
+            return -1;
+        return res_inner(rb, 10, 0) < 0 ? -1 : 1;
+    }
+    if (buf_u32(&eb, h->ledger_seq) < 0 || buf_u32(&eb, 3) < 0 ||
+        write_account_id(&eb, src_id) < 0 ||
+        buf_varopaque(&eb, name, (int)name_len) < 0 ||
+        buf_varopaque(&eb, val, (int)val_len) < 0 ||
+        buf_i32(&eb, 0) < 0 || buf_i32(&eb, 0) < 0) {
+        PyMem_Free(kb.p); PyMem_Free(eb.p);
+        return -1;
+    }
+    RB *v = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    rc2 = v ? eng_put(e, &e->tx_delta, kb.p, kb.len, v) : -1;
+    PyMem_Free(kb.p);
+    if (rc2 < 0)
+        return -1;
+    return res_inner(rb, 10, 0) < 0 ? -1 : 1;
+}
+
+/* mirror BumpSequenceOpFrame (LOW threshold, v10+) */
+static int
+op_bump_sequence(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+                 Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    int64_t bump_to = rd_i64(&r);
+    if (r.err)
+        return -1;
+    if (bump_to < 0)
+        return res_inner(rb, 11, -1) < 0 ? -1 : 0;   /* BAD_SEQ */
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0)
+        return -1;
+    if (bump_to > src.seq_num) {
+        src.seq_num = bump_to;
+        src.last_modified = e->header.ledger_seq;
+        if (eng_put_account(e, &e->tx_delta, &src) < 0)
+            return -1;
+    }
+    return res_inner(rb, 11, 0) < 0 ? -1 : 1;
+}
+
+/* mirror AccountMergeOpFrame (HIGH threshold); success carries i64 */
+static int
+op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+                 Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t mt = rd_u32(&r);
+    if (mt == 0x100)
+        rd_skip(&r, 8);
+    else if (mt != 0)
+        return -1;
+    const uint8_t *dest = rd_take(&r, 32);
+    if (!dest || r.err)
+        return -1;
+    CHeader *h = &e->header;
+
+    if (memcmp(dest, src_id, 32) == 0)
+        return res_inner(rb, 8, -1) < 0 ? -1 : 0;    /* MALFORMED */
+    CAccount dst;
+    int got = eng_get_account(e, dest, &dst);
+    if (got < 0)
+        return -1;
+    if (!got)
+        return res_inner(rb, 8, -2) < 0 ? -1 : 0;    /* NO_ACCOUNT */
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0)
+        return -1;
+    if (src.flags & 0x4)
+        return res_inner(rb, 8, -3) < 0 ? -1 : 0;    /* IMMUTABLE_SET */
+    if (src.num_sub != 0)
+        return res_inner(rb, 8, -4) < 0 ? -1 : 0;    /* HAS_SUB_ENTRIES */
+    if (src.num_sponsoring != 0)
+        return res_inner(rb, 8, -7) < 0 ? -1 : 0;    /* IS_SPONSOR */
+    if (src.seq_num >= (((int64_t)h->ledger_seq + 1) << 32) - 1 &&
+        src.seq_num == INT64_MAXV)
+        return res_inner(rb, 8, -5) < 0 ? -1 : 0;    /* SEQNUM_TOO_FAR */
+    int64_t balance = src.balance;
+    if (!add_balance_c(h, &dst, balance, 0))
+        return res_inner(rb, 8, -6) < 0 ? -1 : 0;    /* DEST_FULL */
+    dst.last_modified = h->ledger_seq;
+    if (eng_put_account(e, &e->tx_delta, &dst) < 0)
+        return -1;
+    if (src.entry_ext_v1 && src.has_sponsor) {
+        /* the dying account's entry releases its sponsor's 2 units */
+        if (release_entry_sponsor(e, src.sponsor, 2, NULL) < 0)
+            return -1;
+    }
+    uint8_t kx[40];
+    account_key_xdr_c(src_id, kx);
+    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+        return -1;
+    /* success arm carries sourceAccountBalance (i64) */
+    if (buf_i32(rb, 0) < 0 || buf_i32(rb, 8) < 0 ||
+        buf_i32(rb, 0) < 0 || buf_i64(rb, balance) < 0)
+        return -1;
+    return 1;
 }

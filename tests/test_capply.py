@@ -21,7 +21,7 @@ from stellar_core_tpu.ledger.native_apply import (NativeApplyBridge,
 from stellar_core_tpu.testutils import (TestAccount, build_tx,
                                         change_trust_op, create_account_op,
                                         make_asset, native_payment_op,
-                                        network_id)
+                                        network_id, payment_op)
 
 pytestmark = pytest.mark.skipif(not native_apply_available(),
                                 reason="_capply not built (make native)")
@@ -156,22 +156,30 @@ def test_multisig_setoptions_and_failures_native_equals_python():
 
 
 def test_mixed_unsupported_traffic_falls_back_mid_stream():
-    """Checkpoints containing ops outside the native set (trustlines)
-    force the per-checkpoint Python fallback; the export/import round
-    trips must be hash-exact."""
+    """Checkpoints containing ops outside the native set (offers) force
+    the per-checkpoint Python fallback; the export/import round trips
+    must be hash-exact.  Trustline create/payment traffic is NATIVE as of
+    the r5 widening and must not fall back."""
+    from stellar_core_tpu.testutils import manage_sell_offer_op
+
     rng = random.Random(5)
 
     def traffic(close, accounts, root):
         issuer = accounts[0]
         asset = make_asset("USD", issuer.account_id)
-        # checkpoint 1: payments (native-appliable)
+        # checkpoint 1: payments + trustlines + credit payments — ALL
+        # native-appliable after the r5 widening
         for _ in range(4):
             close([a.tx([native_payment_op(accounts[2].account_id, 5000)])
                    for a in accounts[3:9]])
-        # spill into unsupported traffic: trustlines (python fallback)
         for batch in range(2):
             close([a.tx([change_trust_op(asset)])
                    for a in accounts[10 + 5 * batch:15 + 5 * batch]])
+        close([issuer.tx([payment_op(accounts[11].account_id, asset,
+                                     70000)])])
+        # unsupported traffic: an offer (python fallback checkpoint)
+        close([accounts[11].tx([manage_sell_offer_op(
+            asset, X.Asset.native(), 500, 1, 2)])])
         # ... 60+ more native-only ledgers so a later whole checkpoint is
         # native again after the fallback one
         for _ in range(66):
@@ -269,3 +277,90 @@ def test_engine_rejects_corrupt_records():
         from stellar_core_tpu.catchup.catchup import CatchupError
         with pytest.raises(CatchupError):
             cm.catchup_complete(archive)
+
+
+def test_randomized_traffic_differential_fuzz():
+    """Deterministic fuzz: random mixes of the widened native op set
+    (payments native+credit, trustline create/update/delete, manage-data,
+    bump-sequence, set-options signers, account merges) plus deliberate
+    failure shapes, replayed through BOTH engines — identical hashes and
+    stores on every seed."""
+    from stellar_core_tpu.testutils import payment_op
+
+    for seed in (11, 23, 47):
+        rng = random.Random(seed)
+
+        def traffic(close, accounts, root, rng=rng):
+            issuer = accounts[0]
+            asset = make_asset("FZZ", issuer.account_id)
+            trusted = set()
+            data_names = {}
+            merged = set()
+            for _ in range(30):
+                frames = []
+                for _ in range(rng.randrange(1, 6)):
+                    alive = [i for i in range(1, len(accounts))
+                             if i not in merged]
+                    if len(alive) < 3:
+                        break
+                    i = rng.choice(alive)
+                    a = accounts[i]
+                    roll = rng.random()
+                    if roll < 0.25:
+                        j = rng.choice(alive)
+                        frames.append(a.tx([native_payment_op(
+                            accounts[j].account_id,
+                            rng.randrange(1, 10 ** 10))]))
+                    elif roll < 0.40:
+                        frames.append(a.tx([change_trust_op(
+                            asset, limit=rng.randrange(0, 10 ** 12))]))
+                        trusted.add(i)
+                    elif roll < 0.55 and i in trusted:
+                        frames.append(issuer.tx([payment_op(
+                            a.account_id, asset,
+                            rng.randrange(1, 10 ** 6))]))
+                    elif roll < 0.70:
+                        name = bytes([97 + rng.randrange(4)]) * 3
+                        val = (None if rng.random() < 0.3 and
+                               data_names.get((i, name)) else
+                               rng.randbytes(8))
+                        frames.append(a.tx([X.Operation(
+                            body=X.OperationBody.manageDataOp(
+                                X.ManageDataOp(dataName=name,
+                                               dataValue=val)))]))
+                        data_names[(i, name)] = val is not None
+                    elif roll < 0.80:
+                        frames.append(a.tx([X.Operation(
+                            body=X.OperationBody.bumpSequenceOp(
+                                X.BumpSequenceOp(bumpTo=rng.randrange(
+                                    0, 2 ** 40))))]))
+                    elif roll < 0.85:
+                        extra = SecretKey(rng.randbytes(32))
+                        frames.append(a.tx([X.Operation(
+                            body=X.OperationBody.setOptionsOp(
+                                X.SetOptionsOp(signer=X.Signer(
+                                    key=X.SignerKey.ed25519(
+                                        extra.public_key.ed25519),
+                                    weight=rng.randrange(0, 3)))))]))
+                    elif roll < 0.92 and len(alive) > 6 and i > 12:
+                        # merge a tail account away (may fail with
+                        # HAS_SUB_ENTRIES etc. — failures differential too)
+                        j = rng.choice([x for x in alive if x != i])
+                        frames.append(a.tx([X.Operation(
+                            body=X.OperationBody.destination(
+                                X.muxed_from_account_id(
+                                    accounts[j].account_id)))]))
+                        merged.add(i)
+                    else:
+                        # deliberate failure: overdrawn payment
+                        j = rng.choice(alive)
+                        frames.append(a.tx([native_payment_op(
+                            accounts[j].account_id, 10 ** 18)]))
+                if frames:
+                    close(frames)
+
+        with tempfile.TemporaryDirectory() as d:
+            archive, mgr = _archive(d, traffic)
+            cm = _assert_replays_agree(archive, mgr)
+            # the whole fuzz mix is inside the native set: no fallbacks
+            assert cm.stats["native_ledgers_applied"] > 20, cm.stats
