@@ -1090,6 +1090,16 @@ parse_op(Rd *r, COp *op, CTx *tx)
         rd_skip(r, 8);
         break;
     }
+    case 7: {                                 /* ALLOW_TRUST */
+        if (rd_u32(r) != 0) { r->err = 1; return -1; }   /* PK type */
+        rd_skip(r, 32);
+        uint32_t at = rd_u32(r);
+        if (at == 1) rd_skip(r, 4);
+        else if (at == 2) rd_skip(r, 12);
+        else { r->err = 1; return -1; }
+        rd_skip(r, 4);                         /* authorize */
+        break;
+    }
     case 8: {                                 /* ACCOUNT_MERGE */
         uint32_t mt = rd_u32(r);
         if (mt == 0x100) { tx->has_muxed = 1; rd_skip(r, 8); }
@@ -1097,6 +1107,8 @@ parse_op(Rd *r, COp *op, CTx *tx)
         rd_skip(r, 32);
         break;
     }
+    case 9:                                   /* INFLATION (void body) */
+        break;
     case 10: {                                /* MANAGE_DATA */
         uint32_t sl;
         if (!rd_varopaque(r, 64, &sl)) return -1;
@@ -1110,6 +1122,27 @@ parse_op(Rd *r, COp *op, CTx *tx)
     case 11:                                  /* BUMP_SEQUENCE */
         rd_skip(r, 8);
         break;
+    case 19: {                                /* CLAWBACK */
+        uint32_t at = rd_u32(r);
+        if (at == 1) { rd_skip(r, 4); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at == 2) { rd_skip(r, 12); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at != 0) { r->err = 1; return -1; }
+        uint32_t mt = rd_u32(r);
+        if (mt == 0x100) { tx->has_muxed = 1; rd_skip(r, 8); }
+        else if (mt != 0) { r->err = 1; return -1; }
+        rd_skip(r, 32 + 8);
+        break;
+    }
+    case 21: {                                /* SET_TRUST_LINE_FLAGS */
+        if (rd_u32(r) != 0) { r->err = 1; return -1; }
+        rd_skip(r, 32);
+        uint32_t at = rd_u32(r);
+        if (at == 1) { rd_skip(r, 4); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at == 2) { rd_skip(r, 12); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at != 0) { r->err = 1; return -1; }
+        rd_skip(r, 8);                         /* clear + set */
+        break;
+    }
     case 5: {                                 /* SET_OPTIONS */
         /* 4 optionals u32-ish + homeDomain + signer */
         uint32_t p;
@@ -2469,6 +2502,9 @@ static int op_change_trust(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_manage_data(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_bump_sequence(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_account_merge(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_allow_trust(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_set_tl_flags(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_clawback(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 
 /* apply one tx; appends its TransactionResult XDR to `out`.  Mirrors
  * TransactionFrame.apply: all-or-nothing via tx_delta. */
@@ -2518,8 +2554,10 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
          * functions */
         /* version gates run FIRST (mirror OperationFrame.check_valid:
          * MIN_PROTOCOL_VERSION precedes the signature check) —
-         * BumpSequence is v10+ */
-        if (op->op_type == 11 && h->ledger_version < 10) {
+         * BumpSequence v10+, Clawback/SetTrustLineFlags v17+ */
+        if ((op->op_type == 11 && h->ledger_version < 10) ||
+            ((op->op_type == 19 || op->op_type == 21) &&
+             h->ledger_version < 17)) {
             if (res_outer(&ops_buf, -3) < 0) { rc = -1; goto done; }
             ok = 0;
             continue;
@@ -2536,7 +2574,8 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
          * everything else MED (mirror the op frames' threshold_level) */
         int threshold_level =
             (op->op_type == 5 || op->op_type == 8) ? 3 :
-            (op->op_type == 11) ? 1 : 2;
+            (op->op_type == 11 || op->op_type == 7 ||
+             op->op_type == 21) ? 1 : 2;
         if (!check_account_sig(&ck, &op_acc, threshold_level)) {
             if (res_outer(&ops_buf, -1) < 0) { rc = -1; goto done; }
             ok = 0;
@@ -2559,9 +2598,16 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
         }
         case 5: r = op_set_options(e, tx, op, op_src, &ops_buf); break;
         case 6: r = op_change_trust(e, tx, op, op_src, &ops_buf); break;
+        case 7: r = op_allow_trust(e, tx, op, op_src, &ops_buf); break;
         case 8: r = op_account_merge(e, tx, op, op_src, &ops_buf); break;
+        case 9:
+            /* Inflation: NOT_TIME always (protocol >= 12 semantics) */
+            r = res_inner(&ops_buf, 9, -1) < 0 ? -1 : 0;
+            break;
         case 10: r = op_manage_data(e, tx, op, op_src, &ops_buf); break;
         case 11: r = op_bump_sequence(e, tx, op, op_src, &ops_buf); break;
+        case 19: r = op_clawback(e, tx, op, op_src, &ops_buf); break;
+        case 21: r = op_set_tl_flags(e, tx, op, op_src, &ops_buf); break;
         default: r = -1; break;
         }
         if (r < 0) { rc = -1; goto done; }
@@ -3769,6 +3815,48 @@ add_tl_balance_c(CTrustLine *t, int64_t delta)
     return 1;
 }
 
+/* parse an alphanum4/12 Asset arm: type already read as `at`.  Fills
+ * code (zero-padded 12) + issuer.  Returns -1 on malformed. */
+static int
+parse_alphanum(Rd *r, uint32_t at, uint8_t code[12], uint8_t issuer[32])
+{
+    memset(code, 0, 12);
+    const uint8_t *c = rd_take(r, at == 1 ? 4 : 12);
+    if (!c)
+        return -1;
+    memcpy(code, c, at == 1 ? 4 : 12);
+    if (rd_u32(r) != 0)                       /* PK type */
+        { r->err = 1; return -1; }
+    const uint8_t *iq = rd_take(r, 32);
+    if (!iq)
+        return -1;
+    memcpy(issuer, iq, 32);
+    return 0;
+}
+
+/* stamp + serialize + store a trustline under key `kb` (which is freed).
+ * Returns the op-function contract: 1 stored+success-result written,
+ * -1 engine error. */
+static int
+store_trustline(Engine *e, Buf *kb, CTrustLine *tl, Buf *rb,
+                int32_t op_type)
+{
+    tl->last_modified = e->header.ledger_seq;
+    Buf eb = {0};
+    int rc = -1;
+    if (serialize_trustline_entry(tl, &eb) < 0)
+        goto out;
+    RB *val = rb_new(eb.p, eb.len);
+    if (!val || eng_put(e, &e->tx_delta, kb->p, kb->len, val) < 0)
+        goto out;
+    rc = res_inner(rb, op_type, 0) < 0 ? -1 : 1;
+out:
+    PyMem_Free(eb.p);
+    PyMem_Free(kb->p);
+    kb->p = NULL;
+    return rc;
+}
+
 /* mirror utils.asset_valid for alphanum codes */
 static int
 asset_code_valid(uint32_t asset_type, const uint8_t *code)
@@ -3872,7 +3960,7 @@ payment_tl_side(Engine *e, Buf *rb, const uint8_t acc[32],
     PyMem_Free(eb.p);
     if (!val || eng_put(e, &e->tx_delta, kb.p, kb.len, val) < 0)
         goto out;
-    rc = 1;
+    rc = 1;                      /* caller writes the shared success result */
 out:
     PyMem_Free(kb.p);
     return rc;
@@ -3890,15 +3978,9 @@ op_payment_credit(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         rd_skip(&r, 8);
     const uint8_t *dest = rd_take(&r, 32);
     uint32_t at = rd_u32(&r);
-    uint8_t code[12] = {0};
-    uint8_t issuer[32];
-    const uint8_t *c = rd_take(&r, at == 1 ? 4 : 12);
-    if (!c) return -1;
-    memcpy(code, c, at == 1 ? 4 : 12);
-    if (rd_u32(&r) != 0) { return -1; }          /* PK type */
-    const uint8_t *iq = rd_take(&r, 32);
-    if (!iq) return -1;
-    memcpy(issuer, iq, 32);
+    uint8_t code[12], issuer[32];
+    if (r.err || parse_alphanum(&r, at, code, issuer) < 0)
+        return -1;
     int64_t amount = rd_i64(&r);
     if (!dest || r.err)
         return -1;
@@ -3942,13 +4024,8 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     uint8_t code[12] = {0};
     uint8_t issuer[32] = {0};
     if (lt == 1 || lt == 2) {
-        const uint8_t *c = rd_take(&r, lt == 1 ? 4 : 12);
-        if (!c) return -1;
-        memcpy(code, c, lt == 1 ? 4 : 12);
-        if (rd_u32(&r) != 0) return -1;
-        const uint8_t *iq = rd_take(&r, 32);
-        if (!iq) return -1;
-        memcpy(issuer, iq, 32);
+        if (parse_alphanum(&r, lt, code, issuer) < 0)
+            return -1;
     } else if (lt != 0) {
         return -1;              /* pool share: probe rejected */
     }
@@ -4010,25 +4087,13 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         }
         CTrustLine tl;
         memset(&tl, 0, sizeof(tl));
-        tl.last_modified = h->ledger_seq;
         memcpy(tl.account_id, src_id, 32);
         tl.asset_type = lt;
         memcpy(tl.asset_code, code, 12);
         memcpy(tl.issuer, issuer, 32);
         tl.limit = limit;
         tl.flags = flags;
-        Buf eb = {0};
-        if (serialize_trustline_entry(&tl, &eb) < 0) {
-            PyMem_Free(kb.p); PyMem_Free(eb.p);
-            return -1;
-        }
-        RB *val = rb_new(eb.p, eb.len);
-        PyMem_Free(eb.p);
-        int rc2 = val ? eng_put(e, &e->tx_delta, kb.p, kb.len, val) : -1;
-        PyMem_Free(kb.p);
-        if (rc2 < 0)
-            return -1;
-        return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+        return store_trustline(e, &kb, &tl, rb, 6);
     }
 
     CTrustLine tl;
@@ -4065,19 +4130,7 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     if (eng_get(e, ik, 40) == NULL)
         CT_FAIL(-2);                                 /* NO_ISSUER */
     tl.limit = limit;
-    tl.last_modified = h->ledger_seq;
-    Buf eb = {0};
-    if (serialize_trustline_entry(&tl, &eb) < 0) {
-        PyMem_Free(kb.p); PyMem_Free(eb.p);
-        return -1;
-    }
-    RB *val = rb_new(eb.p, eb.len);
-    PyMem_Free(eb.p);
-    int rc2 = val ? eng_put(e, &e->tx_delta, kb.p, kb.len, val) : -1;
-    PyMem_Free(kb.p);
-    if (rc2 < 0)
-        return -1;
-    return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+    return store_trustline(e, &kb, &tl, rb, 6);
 #undef CT_FAIL
 }
 
@@ -4331,4 +4384,178 @@ op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         buf_i32(rb, 0) < 0 || buf_i64(rb, balance) < 0)
         return -1;
     return 1;
+}
+
+/* mirror AllowTrustOpFrame (LOW threshold; issuer = op source) */
+static int
+op_allow_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+               Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint8_t trustor[32];
+    if (parse_account_id(&r, trustor) < 0)
+        return -1;
+    uint32_t at = rd_u32(&r);
+    if (r.err || (at != 1 && at != 2))
+        return -1;
+    uint8_t code[12] = {0};
+    const uint8_t *c = rd_take(&r, at == 1 ? 4 : 12);
+    if (!c)
+        return -1;
+    memcpy(code, c, at == 1 ? 4 : 12);  /* AssetCode union: code only */
+    uint32_t authorize = rd_u32(&r);
+    if (r.err)
+        return -1;
+
+    /* do_check_valid */
+    if (authorize > 3 || (authorize & 1 && authorize & 2))
+        return res_inner(rb, 7, -1) < 0 ? -1 : 0;    /* MALFORMED */
+    if (!asset_code_valid(at, code))
+        return res_inner(rb, 7, -1) < 0 ? -1 : 0;
+    if (memcmp(trustor, src_id, 32) == 0)
+        return res_inner(rb, 7, -5) < 0 ? -1 : 0;    /* SELF_NOT_ALLOWED */
+
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0)
+        return -1;
+    if (!(src.flags & 0x2) && authorize != 1)        /* AUTH_REVOCABLE */
+        return res_inner(rb, 7, -4) < 0 ? -1 : 0;    /* CANT_REVOKE */
+    Buf kb = {0};
+    if (trustline_key_xdr_c(trustor, at, code, src_id, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 7, -2) < 0 ? -1 : 0;    /* NO_TRUST_LINE */
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    tl.flags = (tl.flags & ~3u) | authorize;
+    return store_trustline(e, &kb, &tl, rb, 7);
+}
+
+/* mirror SetTrustLineFlagsOpFrame (v17+, LOW threshold) */
+static int
+op_set_tl_flags(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
+                Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint8_t trustor[32];
+    if (parse_account_id(&r, trustor) < 0)
+        return -1;
+    uint32_t at = rd_u32(&r);
+    uint8_t code[12] = {0};
+    uint8_t issuer[32] = {0};
+    if (at == 1 || at == 2) {
+        if (parse_alphanum(&r, at, code, issuer) < 0)
+            return -1;
+    } else if (at != 0) {
+        return -1;
+    }
+    uint32_t clear_flags = rd_u32(&r);
+    uint32_t set_flags = rd_u32(&r);
+    if (r.err)
+        return -1;
+
+    /* do_check_valid */
+    if (at == 0 || !asset_code_valid(at, code) ||
+        !is_issuer_c(src_id, at, issuer) ||
+        memcmp(trustor, src_id, 32) == 0 ||
+        (set_flags & clear_flags) ||
+        ((set_flags | clear_flags) & ~7u) ||
+        (set_flags & 4u) ||
+        ((set_flags & 1) && (set_flags & 2)))
+        return res_inner(rb, 21, -1) < 0 ? -1 : 0;   /* MALFORMED */
+
+    CAccount src;
+    if (eng_get_account(e, src_id, &src) <= 0)
+        return -1;
+    int revoking = (clear_flags & 3u) != 0;
+    if (revoking && !(src.flags & 0x2))
+        return res_inner(rb, 21, -3) < 0 ? -1 : 0;   /* CANT_REVOKE */
+    Buf kb = {0};
+    if (trustline_key_xdr_c(trustor, at, code, issuer, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 21, -2) < 0 ? -1 : 0;   /* NO_TRUST_LINE */
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    uint32_t new_flags = (tl.flags & ~clear_flags) | set_flags;
+    if ((new_flags & 3u) == 3u) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 21, -4) < 0 ? -1 : 0;   /* INVALID_STATE */
+    }
+    tl.flags = new_flags;
+    return store_trustline(e, &kb, &tl, rb, 21);
+}
+
+/* mirror ClawbackOpFrame (v17+, MED threshold) */
+static int
+op_clawback(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32], Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t at = rd_u32(&r);
+    uint8_t code[12] = {0};
+    uint8_t issuer[32] = {0};
+    if (at == 1 || at == 2) {
+        if (parse_alphanum(&r, at, code, issuer) < 0)
+            return -1;
+    } else if (at != 0) {
+        return -1;
+    }
+    uint32_t mt = rd_u32(&r);
+    if (mt == 0x100)
+        rd_skip(&r, 8);
+    else if (mt != 0)
+        return -1;
+    const uint8_t *from = rd_take(&r, 32);
+    int64_t amount = rd_i64(&r);
+    if (!from || r.err)
+        return -1;
+
+    /* do_check_valid */
+    if (amount <= 0 || at == 0 || !asset_code_valid(at, code) ||
+        !is_issuer_c(src_id, at, issuer))
+        return res_inner(rb, 19, -1) < 0 ? -1 : 0;   /* MALFORMED */
+
+    Buf kb = {0};
+    if (trustline_key_xdr_c(from, at, code, issuer, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 19, -3) < 0 ? -1 : 0;   /* NO_TRUST */
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (!(tl.flags & 4u)) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 19, -2) < 0 ? -1 : 0;   /* NOT_CLAWBACK_ENABLED */
+    }
+    if (!add_tl_balance_c(&tl, -amount)) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 19, -4) < 0 ? -1 : 0;   /* UNDERFUNDED */
+    }
+    return store_trustline(e, &kb, &tl, rb, 19);
 }

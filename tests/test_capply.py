@@ -285,8 +285,6 @@ def test_randomized_traffic_differential_fuzz():
     bump-sequence, set-options signers, account merges) plus deliberate
     failure shapes, replayed through BOTH engines — identical hashes and
     stores on every seed."""
-    from stellar_core_tpu.testutils import payment_op
-
     for seed in (11, 23, 47):
         rng = random.Random(seed)
 
@@ -296,6 +294,12 @@ def test_randomized_traffic_differential_fuzz():
             trusted = set()
             data_names = {}
             merged = set()
+            # issuer flags: revocable + clawback so AllowTrust /
+            # SetTrustLineFlags / Clawback exercise their real arms
+            close([issuer.tx([X.Operation(
+                body=X.OperationBody.setOptionsOp(X.SetOptionsOp(
+                    setFlags=X.AccountFlags.AUTH_REVOCABLE_FLAG
+                    | X.AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG)))])])
             for _ in range(30):
                 frames = []
                 for _ in range(rng.randrange(1, 6)):
@@ -329,11 +333,43 @@ def test_randomized_traffic_differential_fuzz():
                                 X.ManageDataOp(dataName=name,
                                                dataValue=val)))]))
                         data_names[(i, name)] = val is not None
-                    elif roll < 0.80:
+                    elif roll < 0.74:
                         frames.append(a.tx([X.Operation(
                             body=X.OperationBody.bumpSequenceOp(
                                 X.BumpSequenceOp(bumpTo=rng.randrange(
                                     0, 2 ** 40))))]))
+                    elif roll < 0.78 and i in trusted:
+                        which = rng.random()
+                        if which < 0.34:
+                            frames.append(issuer.tx([X.Operation(
+                                body=X.OperationBody.allowTrustOp(
+                                    X.AllowTrustOp(
+                                        trustor=a.account_id,
+                                        asset=X.AssetCode.assetCode4(
+                                            b"FZZ\x00"),
+                                        authorize=rng.choice((0, 1, 2)))))]))
+                        elif which < 0.67:
+                            clear = rng.choice((0, 1, 2, 4))
+                            sett = rng.choice((0, 1, 2))
+                            frames.append(issuer.tx([X.Operation(
+                                body=X.OperationBody.setTrustLineFlagsOp(
+                                    X.SetTrustLineFlagsOp(
+                                        trustor=a.account_id, asset=asset,
+                                        clearFlags=clear,
+                                        setFlags=sett
+                                        if not (sett & clear) else 0)))]))
+                        else:
+                            frames.append(issuer.tx([X.Operation(
+                                body=X.OperationBody.clawbackOp(
+                                    X.ClawbackOp(
+                                        asset=asset,
+                                        from_=X.muxed_from_account_id(
+                                            a.account_id),
+                                        amount=rng.randrange(
+                                            1, 10 ** 5))))]))
+                    elif roll < 0.80:
+                        frames.append(a.tx([X.Operation(
+                            body=X.OperationBody.inflation())]))
                     elif roll < 0.85:
                         extra = SecretKey(rng.randbytes(32))
                         frames.append(a.tx([X.Operation(
