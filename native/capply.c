@@ -1076,6 +1076,26 @@ parse_op(Rd *r, COp *op, CTx *tx)
         rd_skip(r, 8);
         break;
     }
+    case 3: case 12: {                        /* MANAGE_SELL/BUY_OFFER */
+        for (int k = 0; k < 2; k++) {          /* selling + buying */
+            uint32_t at = rd_u32(r);
+            if (at == 1) { rd_skip(r, 4); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+            else if (at == 2) { rd_skip(r, 12); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+            else if (at != 0) { r->err = 1; return -1; }
+        }
+        rd_skip(r, 8 + 4 + 4 + 8);             /* amount, price, offerID */
+        break;
+    }
+    case 4: {                                 /* CREATE_PASSIVE_SELL_OFFER */
+        for (int k = 0; k < 2; k++) {
+            uint32_t at = rd_u32(r);
+            if (at == 1) { rd_skip(r, 4); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+            else if (at == 2) { rd_skip(r, 12); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+            else if (at != 0) { r->err = 1; return -1; }
+        }
+        rd_skip(r, 8 + 4 + 4);                 /* amount, price */
+        break;
+    }
     case 6: {                                 /* CHANGE_TRUST */
         uint32_t lt = rd_u32(r);
         if (lt == 0) {
@@ -2505,6 +2525,7 @@ static int op_account_merge(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_allow_trust(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_set_tl_flags(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_clawback(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_manage_offer(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 
 /* apply one tx; appends its TransactionResult XDR to `out`.  Mirrors
  * TransactionFrame.apply: all-or-nothing via tx_delta. */
@@ -2519,6 +2540,10 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
         return tx_result_void(out, fee, TXC_BAD_SEQ);
 
     map_clear(&e->tx_delta);
+    /* header.idPool is bumped by offer creation inside ops; a failed tx
+     * rolls it back along with the entry delta (the oracle's inner
+     * LedgerTxn holds the header mutation until commit) */
+    uint64_t saved_id_pool = h->id_pool;
 
     CAccount src;
     int src_found;
@@ -2556,6 +2581,7 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
          * MIN_PROTOCOL_VERSION precedes the signature check) —
          * BumpSequence v10+, Clawback/SetTrustLineFlags v17+ */
         if ((op->op_type == 11 && h->ledger_version < 10) ||
+            (op->op_type == 12 && h->ledger_version < 11) ||
             ((op->op_type == 19 || op->op_type == 21) &&
              h->ledger_version < 17)) {
             if (res_outer(&ops_buf, -3) < 0) { rc = -1; goto done; }
@@ -2596,6 +2622,9 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
                         : op_payment_credit(e, tx, op, op_src, &ops_buf);
             break;
         }
+        case 3: case 4: case 12:
+            r = op_manage_offer(e, tx, op, op_src, &ops_buf);
+            break;
         case 5: r = op_set_options(e, tx, op, op_src, &ops_buf); break;
         case 6: r = op_change_trust(e, tx, op, op_src, &ops_buf); break;
         case 7: r = op_allow_trust(e, tx, op, op_src, &ops_buf); break;
@@ -2621,6 +2650,7 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
                                tx->extra_signers[i].key, 1 };
             if (!checker_check(&ck, &s, 1, 1)) {
                 eng_rollback_tx(e);
+                h->id_pool = saved_id_pool;
                 PyMem_Free(ops_buf.p);
                 return tx_result_void(out, fee, TXC_BAD_AUTH_EXTRA);
             }
@@ -2628,11 +2658,13 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
     }
     if (ok && !checker_all_used(&ck)) {
         eng_rollback_tx(e);
+        h->id_pool = saved_id_pool;
         PyMem_Free(ops_buf.p);
         return tx_result_void(out, fee, TXC_BAD_AUTH_EXTRA);
     }
     if (!ok) {
         eng_rollback_tx(e);
+        h->id_pool = saved_id_pool;
         rc = tx_result_ops(out, fee, TXC_FAILED, &ops_buf, tx->n_ops);
         PyMem_Free(ops_buf.p);
         return rc;
@@ -4558,4 +4590,1057 @@ op_clawback(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32], Buf *rb)
         return res_inner(rb, 19, -4) < 0 ? -1 : 0;   /* UNDERFUNDED */
     }
     return store_trustline(e, &kb, &tl, rb, 19);
+}
+
+/* ---- offers: entries, exchange math, liabilities (round 5) ------------ *
+ *
+ * Mirrors transactions/offer_exchange.py exactly: exchangeV10 rounding,
+ * the 1% price-error thresholds, adjustOffer, liabilities bookkeeping and
+ * the convertWithOffers sweep.  All amount math in __int128 (the oracle
+ * uses python ints; products are <= 2^94, bound sums <= 2^101).
+ */
+
+typedef struct {
+    uint32_t type;              /* 0 native, 1 alphanum4, 2 alphanum12 */
+    uint8_t code[12];
+    uint8_t issuer[32];
+} CAssetC;
+
+static int
+parse_asset(Rd *r, CAssetC *a)
+{
+    memset(a, 0, sizeof(*a));
+    a->type = rd_u32(r);
+    if (r->err)
+        return -1;
+    if (a->type == 0)
+        return 0;
+    if (a->type != 1 && a->type != 2) {
+        r->err = 1;
+        return -1;
+    }
+    return parse_alphanum(r, a->type, a->code, a->issuer);
+}
+
+static int
+write_asset(Buf *b, const CAssetC *a)
+{
+    if (buf_u32(b, a->type) < 0)
+        return -1;
+    if (a->type == 0)
+        return 0;
+    if (buf_put(b, a->code, a->type == 1 ? 4 : 12) < 0)
+        return -1;
+    return write_account_id(b, a->issuer);
+}
+
+static int
+asset_eq(const CAssetC *a, const CAssetC *b)
+{
+    if (a->type != b->type)
+        return 0;
+    if (a->type == 0)
+        return 1;
+    return memcmp(a->code, b->code, 12) == 0 &&
+           memcmp(a->issuer, b->issuer, 32) == 0;
+}
+
+static int
+asset_valid_c(const CAssetC *a)
+{
+    if (a->type == 0)
+        return 1;
+    return asset_code_valid(a->type, a->code);
+}
+
+static int
+is_issuer_asset(const uint8_t acc[32], const CAssetC *a)
+{
+    return a->type != 0 && memcmp(a->issuer, acc, 32) == 0;
+}
+
+typedef struct {
+    uint32_t last_modified;
+    int entry_ext_v1;
+    int has_sponsor;
+    uint8_t sponsor[32];
+    uint8_t seller[32];
+    int64_t offer_id;
+    CAssetC selling, buying;
+    int64_t amount;
+    int32_t price_n, price_d;
+    uint32_t flags;
+} COffer;
+
+static int
+parse_offer_entry(const uint8_t *data, int len, COffer *o)
+{
+    memset(o, 0, sizeof(*o));
+    Rd r;
+    rd_init(&r, data, len);
+    o->last_modified = rd_u32(&r);
+    if (rd_u32(&r) != 2 || r.err)       /* data tag OFFER */
+        return -1;
+    if (parse_account_id(&r, o->seller) < 0)
+        return -1;
+    o->offer_id = rd_i64(&r);
+    if (parse_asset(&r, &o->selling) < 0 || parse_asset(&r, &o->buying) < 0)
+        return -1;
+    o->amount = rd_i64(&r);
+    o->price_n = rd_i32(&r);
+    o->price_d = rd_i32(&r);
+    o->flags = rd_u32(&r);
+    if (rd_i32(&r) != 0 || r.err)       /* OfferEntry ext v0 */
+        return -1;
+    int32_t lext = rd_i32(&r);
+    if (r.err || (lext != 0 && lext != 1))
+        return -1;
+    o->entry_ext_v1 = (int)lext;
+    if (lext == 1) {
+        uint32_t sp = rd_u32(&r);
+        if (r.err || sp > 1)
+            return -1;
+        o->has_sponsor = (int)sp;
+        if (sp && parse_account_id(&r, o->sponsor) < 0)
+            return -1;
+        if (rd_i32(&r) != 0 || r.err)
+            return -1;
+    }
+    return (r.err || r.off != r.len) ? -1 : 0;
+}
+
+/* serialize just the OfferEntry body (shared by the ledger entry and the
+ * ManageOfferSuccessResult offer arm) */
+static int
+write_offer_body(const COffer *o, Buf *b)
+{
+    if (write_account_id(b, o->seller) < 0 ||
+        buf_i64(b, o->offer_id) < 0 ||
+        write_asset(b, &o->selling) < 0 ||
+        write_asset(b, &o->buying) < 0 ||
+        buf_i64(b, o->amount) < 0 ||
+        buf_i32(b, o->price_n) < 0 ||
+        buf_i32(b, o->price_d) < 0 ||
+        buf_u32(b, o->flags) < 0 ||
+        buf_i32(b, 0) < 0)
+        return -1;
+    return 0;
+}
+
+static int
+serialize_offer_entry(const COffer *o, Buf *b)
+{
+    if (buf_u32(b, o->last_modified) < 0 || buf_u32(b, 2) < 0 ||
+        write_offer_body(o, b) < 0 ||
+        buf_i32(b, o->entry_ext_v1) < 0)
+        return -1;
+    if (o->entry_ext_v1) {
+        if (buf_u32(b, (uint32_t)o->has_sponsor) < 0)
+            return -1;
+        if (o->has_sponsor && write_account_id(b, o->sponsor) < 0)
+            return -1;
+        if (buf_i32(b, 0) < 0)
+            return -1;
+    }
+    return 0;
+}
+
+/* offer LedgerKey XDR: tag 2 + sellerID + offerID */
+static void
+offer_key_xdr_c(const uint8_t seller[32], int64_t offer_id, uint8_t out[48])
+{
+    out[0] = 0; out[1] = 0; out[2] = 0; out[3] = 2;
+    out[4] = 0; out[5] = 0; out[6] = 0; out[7] = 0;
+    memcpy(out + 8, seller, 32);
+    uint64_t v = (uint64_t)offer_id;
+    for (int i = 0; i < 8; i++)
+        out[40 + i] = (uint8_t)(v >> (56 - 8 * i));
+}
+
+/* ---- exchangeV10 (exact integer crossing math) ------------------------ */
+
+#define RND_NORMAL 0
+#define RND_PATH_STRICT_RECEIVE 1
+#define RND_PATH_STRICT_SEND 2
+
+typedef struct {
+    int wheat_stays;
+    int64_t wheat_received;
+    int64_t sheep_send;
+} CExchange;
+
+static i128
+i128_min(i128 a, i128 b) { return a < b ? a : b; }
+
+static int64_t
+div_round_128(i128 num, i128 den, int round_up)
+{
+    i128 q = num / den;
+    if (round_up && num % den)
+        q += 1;
+    return (int64_t)q;
+}
+
+static int
+check_price_error_bound_c(int32_t n, int32_t d, int64_t wheat_receive,
+                          int64_t sheep_send, int can_favor_wheat)
+{
+    i128 k = (i128)wheat_receive * n;
+    i128 v = (i128)sheep_send * d;
+    if (100 * v < 99 * k)
+        return 0;
+    if (!can_favor_wheat && 100 * v > 101 * k)
+        return 0;
+    return 1;
+}
+
+static CExchange
+apply_price_error_thresholds_c(int32_t n, int32_t d, int64_t wheat_receive,
+                               int64_t sheep_send, int wheat_stays,
+                               int rounding)
+{
+    if (wheat_receive > 0 && sheep_send > 0) {
+        if (rounding == RND_NORMAL &&
+            !check_price_error_bound_c(n, d, wheat_receive, sheep_send, 0))
+            wheat_receive = sheep_send = 0;
+        else if (rounding == RND_PATH_STRICT_RECEIVE &&
+                 !check_price_error_bound_c(n, d, wheat_receive, sheep_send,
+                                            1))
+            wheat_receive = sheep_send = 0;
+    }
+    if (wheat_receive == 0 || sheep_send == 0)
+        wheat_receive = sheep_send = 0;
+    CExchange ex = { wheat_stays, wheat_receive, sheep_send };
+    return ex;
+}
+
+static CExchange
+exchange_v10_c(int32_t n, int32_t d, int64_t max_wheat_send,
+               int64_t max_wheat_receive, int64_t max_sheep_send,
+               int64_t max_sheep_receive, int rounding)
+{
+    i128 wheat_value = i128_min((i128)max_wheat_send * n,
+                                (i128)max_sheep_receive * d);
+    i128 sheep_value = i128_min((i128)max_sheep_send * d,
+                                (i128)max_wheat_receive * n);
+    if (wheat_value <= 0 || sheep_value <= 0) {
+        CExchange ex = { wheat_value > 0, 0, 0 };
+        return ex;
+    }
+    int wheat_stays = wheat_value > sheep_value;
+    int64_t wheat_receive, sheep_send;
+    if (wheat_stays) {
+        wheat_receive = div_round_128(sheep_value, n, 0);
+        if (rounding == RND_PATH_STRICT_SEND)
+            sheep_send = max_sheep_send;
+        else
+            sheep_send = div_round_128((i128)wheat_receive * n, d, 1);
+    } else {
+        wheat_receive = div_round_128(wheat_value, n, 0);
+        sheep_send = div_round_128(wheat_value, d, 1);
+    }
+    return apply_price_error_thresholds_c(n, d, wheat_receive, sheep_send,
+                                          wheat_stays, rounding);
+}
+
+static int64_t
+adjust_offer_c(int32_t n, int32_t d, int64_t max_wheat_send,
+               int64_t max_sheep_receive)
+{
+    CExchange ex = exchange_v10_c(n, d, max_wheat_send, INT64_MAXV,
+                                  INT64_MAXV, max_sheep_receive, RND_NORMAL);
+    return ex.wheat_received;
+}
+
+static int64_t
+offer_selling_liab_c(int32_t n, int32_t d, int64_t amount)
+{
+    return adjust_offer_c(n, d, amount, INT64_MAXV);
+}
+
+static int64_t
+offer_buying_liab_c(int32_t n, int32_t d, int64_t amount)
+{
+    CExchange ex = exchange_v10_c(n, d, amount, INT64_MAXV, INT64_MAXV,
+                                  INT64_MAXV, RND_NORMAL);
+    return ex.sheep_send;
+}
+
+/* ---- liabilities bookkeeping + transfers ------------------------------ */
+
+/* mirror _add_liab for the native-asset (account) arm; mutates acc */
+static int
+account_add_liab(const CHeader *h, CAccount *acc, int64_t d_buying,
+                 int64_t d_selling)
+{
+    i128 nb = (i128)acc->liab_buying + d_buying;
+    i128 ns = (i128)acc->liab_selling + d_selling;
+    if (nb < 0 || ns < 0)
+        return 0;
+    if (ns > (i128)acc->balance - min_balance_128(h, acc))
+        return 0;
+    if (nb > (i128)INT64_MAXV - acc->balance)
+        return 0;
+    acc->liab_buying = (int64_t)nb;
+    acc->liab_selling = (int64_t)ns;
+    if (acc->ext_level < 1)
+        acc->ext_level = 1;
+    return 1;
+}
+
+/* mirror _add_liab for the trustline arm */
+static int
+tl_add_liab(CTrustLine *tl, int64_t d_buying, int64_t d_selling)
+{
+    i128 nb = (i128)tl->liab_buying + d_buying;
+    i128 ns = (i128)tl->liab_selling + d_selling;
+    if (nb < 0 || ns < 0)
+        return 0;
+    if (ns > tl->balance)
+        return 0;
+    if (nb > (i128)tl->limit - tl->balance)
+        return 0;
+    tl->liab_buying = (int64_t)nb;
+    tl->liab_selling = (int64_t)ns;
+    if (tl->ext_level < 1)
+        tl->ext_level = 1;
+    return 1;
+}
+
+/* load+mutate+store one liability adjustment for `acc`'s side of `asset`.
+ * Returns 1 ok, 0 constraint violated, -1 engine error, 2 = trustline
+ * missing (caller decides).  Issuers carry no liabilities. */
+static int
+adjust_side_liab(Engine *e, const uint8_t acc[32], const CAssetC *asset,
+                 int64_t d_buying, int64_t d_selling)
+{
+    if (asset->type == 0) {
+        CAccount a;
+        int got = eng_get_account(e, acc, &a);
+        if (got < 0)
+            return -1;
+        if (!got)
+            return 0;
+        if (!account_add_liab(&e->header, &a, d_buying, d_selling))
+            return 0;
+        return eng_put_account(e, &e->tx_delta, &a) < 0 ? -1 : 1;
+    }
+    if (is_issuer_asset(acc, asset))
+        return 1;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(acc, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        PyMem_Free(kb.p);
+        return 2;
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (!tl_add_liab(&tl, d_buying, d_selling)) {
+        PyMem_Free(kb.p);
+        return 0;
+    }
+    Buf eb = {0};
+    int rc = -1;
+    if (serialize_trustline_entry(&tl, &eb) == 0) {
+        RB *val = rb_new(eb.p, eb.len);
+        rc = (val && eng_put(e, &e->tx_delta, kb.p, kb.len, val) == 0)
+             ? 1 : -1;
+    }
+    PyMem_Free(eb.p);
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* mirror acquire_or_release_offer_liabilities: 1 ok / 0 failed / -1 err */
+static int
+offer_liabilities(Engine *e, const COffer *o, int acquire)
+{
+    int sign = acquire ? 1 : -1;
+    int64_t selling_liab = offer_selling_liab_c(o->price_n, o->price_d,
+                                                o->amount);
+    int64_t buying_liab = offer_buying_liab_c(o->price_n, o->price_d,
+                                              o->amount);
+    int rc = adjust_side_liab(e, o->seller, &o->selling, 0,
+                              sign * selling_liab);
+    if (rc == 2)
+        return 0;              /* missing non-issuer trustline */
+    if (rc != 1)
+        return rc;
+    rc = adjust_side_liab(e, o->seller, &o->buying, sign * buying_liab, 0);
+    if (rc == 2)
+        return 0;
+    return rc;
+}
+
+/* mirror _can_sell_at_most */
+static int64_t
+can_sell_at_most_c(Engine *e, const uint8_t acc[32], const CAssetC *asset)
+{
+    if (asset->type == 0) {
+        CAccount a;
+        if (eng_get_account(e, acc, &a) != 1)
+            return 0;
+        i128 avail = (i128)a.balance - min_balance_128(&e->header, &a)
+                     - a.liab_selling;
+        return avail > 0 ? (int64_t)avail : 0;
+    }
+    if (is_issuer_asset(acc, asset))
+        return INT64_MAXV;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(acc, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return 0;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int64_t out = 0;
+    if (rec) {
+        CTrustLine tl;
+        if (parse_trustline_entry(rec->bytes, rec->len, &tl) == 0 &&
+            (tl.flags & 1)) {
+            int64_t v = tl.balance - tl.liab_selling;
+            out = v > 0 ? v : 0;
+        }
+    }
+    PyMem_Free(kb.p);
+    return out;
+}
+
+/* mirror _can_buy_at_most */
+static int64_t
+can_buy_at_most_c(Engine *e, const uint8_t acc[32], const CAssetC *asset)
+{
+    if (asset->type == 0) {
+        CAccount a;
+        if (eng_get_account(e, acc, &a) != 1)
+            return 0;
+        i128 cap = (i128)INT64_MAXV - a.balance - a.liab_buying;
+        return cap > 0 ? (int64_t)cap : 0;
+    }
+    if (is_issuer_asset(acc, asset))
+        return INT64_MAXV;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(acc, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return 0;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int64_t out = 0;
+    if (rec) {
+        CTrustLine tl;
+        if (parse_trustline_entry(rec->bytes, rec->len, &tl) == 0 &&
+            (tl.flags & 1)) {
+            i128 v = (i128)tl.limit - tl.balance - tl.liab_buying;
+            out = v > 0 ? (int64_t)v : 0;
+        }
+    }
+    PyMem_Free(kb.p);
+    return out;
+}
+
+/* mirror _transfer: 1 ok / 0 failed / -1 err */
+static int
+transfer_c(Engine *e, const uint8_t acc[32], const CAssetC *asset,
+           int64_t delta)
+{
+    if (asset->type != 0 && is_issuer_asset(acc, asset))
+        return 1;
+    if (asset->type == 0) {
+        CAccount a;
+        int got = eng_get_account(e, acc, &a);
+        if (got < 0)
+            return -1;
+        if (!got)
+            return 0;
+        if (!add_balance_c(&e->header, &a, delta, 1))
+            return 0;
+        return eng_put_account(e, &e->tx_delta, &a) < 0 ? -1 : 1;
+    }
+    Buf kb = {0};
+    if (trustline_key_xdr_c(acc, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    if (!rec) {
+        PyMem_Free(kb.p);
+        return 0;
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (!add_tl_balance_c(&tl, delta)) {
+        PyMem_Free(kb.p);
+        return 0;
+    }
+    Buf eb = {0};
+    int rc = -1;
+    if (serialize_trustline_entry(&tl, &eb) == 0) {
+        RB *val = rb_new(eb.p, eb.len);
+        rc = (val && eng_put(e, &e->tx_delta, kb.p, kb.len, val) == 0)
+             ? 1 : -1;
+    }
+    PyMem_Free(eb.p);
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* ---- book scan + convertWithOffers ------------------------------------ */
+
+typedef struct {
+    COffer *offers;
+    int n, cap;
+} CBook;
+
+static int
+book_push(CBook *bk, const COffer *o)
+{
+    if (bk->n == bk->cap) {
+        int nc = bk->cap ? bk->cap * 2 : 16;
+        COffer *np = PyMem_Realloc(bk->offers, nc * sizeof(COffer));
+        if (!np) { PyErr_NoMemory(); return -1; }
+        bk->offers = np;
+        bk->cap = nc;
+    }
+    bk->offers[bk->n++] = *o;
+    return 0;
+}
+
+static int
+offer_cmp(const void *pa, const void *pb)
+{
+    const COffer *a = pa, *b = pb;
+    i128 lhs = (i128)a->price_n * b->price_d;
+    i128 rhs = (i128)b->price_n * a->price_d;
+    if (lhs != rhs)
+        return lhs < rhs ? -1 : 1;
+    if (a->offer_id != b->offer_id)
+        return a->offer_id < b->offer_id ? -1 : 1;
+    return 0;
+}
+
+/* all current offers selling `wheat` for `sheep`, sorted by (price,
+ * offerID) — mirror load_best_offers over the 3-level overlay.  Caller
+ * frees bk->offers. */
+static int
+scan_book(Engine *e, const CAssetC *wheat, const CAssetC *sheep, CBook *bk)
+{
+    memset(bk, 0, sizeof(*bk));
+    Map seen;
+    if (map_init(&seen, 256) < 0)
+        return -1;
+    Map *layers[3] = { &e->tx_delta, &e->ledger_delta, &e->store };
+    for (int li = 0; li < 3; li++) {
+        Map *m = layers[li];
+        for (int i = 0; i < m->cap; i++) {
+            MapSlot *s = &m->slots[i];
+            if (s->state != 1)
+                continue;
+            if (s->key->len < 4 || s->key->bytes[0] != 0 ||
+                s->key->bytes[1] != 0 || s->key->bytes[2] != 0 ||
+                s->key->bytes[3] != 2)
+                continue;       /* not an OFFER key */
+            int present;
+            map_get(&seen, s->key->bytes, s->key->len, &present);
+            if (present)
+                continue;
+            if (map_put(&seen, rb_ref(s->key), NULL) < 0)
+                goto fail;
+            RB *rec = eng_get(e, s->key->bytes, s->key->len);
+            if (!rec)
+                continue;       /* deleted in an upper layer */
+            COffer o;
+            if (parse_offer_entry(rec->bytes, rec->len, &o) < 0)
+                goto fail;      /* corrupt stored offer: fail-stop */
+            if (asset_eq(&o.selling, wheat) && asset_eq(&o.buying, sheep)) {
+                if (book_push(bk, &o) < 0)
+                    goto fail;
+            }
+        }
+    }
+    map_free(&seen);
+    if (bk->n)
+        qsort(bk->offers, bk->n, sizeof(COffer), offer_cmp);
+    return 0;
+fail:
+    map_free(&seen);
+    PyMem_Free(bk->offers);
+    memset(bk, 0, sizeof(*bk));
+    return -1;
+}
+
+/* erase an offer + subentry/sponsorship bookkeeping (mirror _erase_offer) */
+static int
+erase_offer_c(Engine *e, const COffer *o)
+{
+    uint8_t kx[48];
+    offer_key_xdr_c(o->seller, o->offer_id, kx);
+    /* re-read the CURRENT entry for its sponsor (o may be a snapshot) */
+    RB *rec = eng_get(e, kx, 48);
+    int sponsored = 0;
+    uint8_t sponsor[32];
+    if (rec) {
+        COffer cur;
+        if (parse_offer_entry(rec->bytes, rec->len, &cur) < 0)
+            return -1;
+        if (cur.entry_ext_v1 && cur.has_sponsor) {
+            sponsored = 1;
+            memcpy(sponsor, cur.sponsor, 32);
+        }
+    }
+    if (eng_put(e, &e->tx_delta, kx, 48, NULL) < 0)
+        return -1;
+    CAccount acc;
+    if (eng_get_account(e, o->seller, &acc) <= 0)
+        return -1;
+    if (sponsored) {
+        if (release_entry_sponsor(e, sponsor, 1, &acc) < 0)
+            return -1;
+    }
+    acc.num_sub -= 1;
+    return eng_put_account(e, &e->tx_delta, &acc);
+}
+
+typedef struct {
+    int result;                 /* CONVERT_OK/PARTIAL/FILTER_STOP */
+    int self_cross;
+    int64_t wheat_received;
+    int64_t sheep_sent;
+    Buf claims;                 /* concatenated ClaimAtom XDR */
+    int n_claims;
+} CCross;
+
+#define CVT_OK 0
+#define CVT_PARTIAL 1
+#define CVT_FILTER_STOP 2
+
+/* price_bound for manage-offer crossing (mirror `crossable`): maker.n *
+ * price.n <= maker.d * price.d, strict when passive */
+static int
+crossable_c(const COffer *maker, int32_t pn, int32_t pd, int passive)
+{
+    i128 lhs = (i128)maker->price_n * pn;
+    i128 rhs = (i128)maker->price_d * pd;
+    if (lhs < rhs)
+        return 1;
+    return lhs == rhs && !passive;
+}
+
+/* mirror convert_with_offers.  bound_pn/pd < 0 disables the price bound.
+ * Returns 0 ok / -1 engine error; *cr filled. */
+static int
+convert_with_offers_c(Engine *e, const CAssetC *sheep, const CAssetC *wheat,
+                      int64_t max_wheat_receive, int64_t max_sheep_send,
+                      const uint8_t taker[32], int rounding,
+                      int32_t bound_pn, int32_t bound_pd, int passive,
+                      CCross *cr)
+{
+    memset(cr, 0, sizeof(*cr));
+    cr->result = CVT_OK;
+    int64_t need_wheat = max_wheat_receive;
+    int64_t have_sheep = max_sheep_send;
+    CBook bk;
+    if (scan_book(e, wheat, sheep, &bk) < 0)
+        return -1;
+    int rc = 0;
+    for (int i = 0; i < bk.n; i++) {
+        COffer *o = &bk.offers[i];
+        if (need_wheat <= 0 || have_sheep <= 0)
+            break;
+        if (bound_pn >= 0 &&
+            !crossable_c(o, bound_pn, bound_pd, passive)) {
+            cr->result = CVT_FILTER_STOP;
+            break;
+        }
+        if (memcmp(o->seller, taker, 32) == 0) {
+            cr->self_cross = 1;
+            cr->result = CVT_FILTER_STOP;
+            break;
+        }
+        int lr = offer_liabilities(e, o, 0);     /* release */
+        if (lr < 0) { rc = -1; break; }
+        if (lr == 0)
+            continue;          /* inconsistent offer: skip defensively */
+        int64_t mws = can_sell_at_most_c(e, o->seller, wheat);
+        if (o->amount < mws)
+            mws = o->amount;
+        int64_t msr = can_buy_at_most_c(e, o->seller, sheep);
+        CExchange ex = exchange_v10_c(o->price_n, o->price_d, mws,
+                                      need_wheat, have_sheep, msr,
+                                      rounding);
+        if (ex.wheat_received > 0) {
+            if (transfer_c(e, o->seller, wheat, -ex.wheat_received) != 1 ||
+                transfer_c(e, o->seller, sheep, ex.sheep_send) != 1) {
+                rc = -1;       /* oracle asserts here: fail-stop */
+                break;
+            }
+            /* ClaimAtom.orderBook */
+            if (buf_u32(&cr->claims, 1) < 0 ||
+                write_account_id(&cr->claims, o->seller) < 0 ||
+                buf_i64(&cr->claims, o->offer_id) < 0 ||
+                write_asset(&cr->claims, wheat) < 0 ||
+                buf_i64(&cr->claims, ex.wheat_received) < 0 ||
+                write_asset(&cr->claims, sheep) < 0 ||
+                buf_i64(&cr->claims, ex.sheep_send) < 0) {
+                rc = -1;
+                break;
+            }
+            cr->n_claims++;
+            cr->wheat_received += ex.wheat_received;
+            cr->sheep_sent += ex.sheep_send;
+            need_wheat -= ex.wheat_received;
+            have_sheep -= ex.sheep_send;
+        }
+        if (ex.wheat_stays) {
+            int64_t rem = o->amount - ex.wheat_received;
+            int64_t cs = can_sell_at_most_c(e, o->seller, wheat);
+            if (cs < rem)
+                rem = cs;
+            int64_t new_amount = adjust_offer_c(
+                o->price_n, o->price_d, rem,
+                can_buy_at_most_c(e, o->seller, sheep));
+            if (new_amount > 0) {
+                uint8_t kx[48];
+                offer_key_xdr_c(o->seller, o->offer_id, kx);
+                RB *rec = eng_get(e, kx, 48);
+                if (!rec) { rc = -1; break; }
+                COffer cur;
+                if (parse_offer_entry(rec->bytes, rec->len, &cur) < 0) {
+                    rc = -1;
+                    break;
+                }
+                cur.amount = new_amount;
+                Buf eb = {0};
+                if (serialize_offer_entry(&cur, &eb) < 0) {
+                    PyMem_Free(eb.p);
+                    rc = -1;
+                    break;
+                }
+                RB *val = rb_new(eb.p, eb.len);
+                PyMem_Free(eb.p);
+                if (!val || eng_put(e, &e->tx_delta, kx, 48, val) < 0) {
+                    rc = -1;
+                    break;
+                }
+                if (offer_liabilities(e, &cur, 1) != 1) {
+                    rc = -1;   /* oracle asserts re-acquire succeeds */
+                    break;
+                }
+            } else {
+                if (erase_offer_c(e, o) < 0) { rc = -1; break; }
+            }
+            break;             /* taker exhausted */
+        } else {
+            if (erase_offer_c(e, o) < 0) { rc = -1; break; }
+        }
+    }
+    PyMem_Free(bk.offers);
+    if (rc < 0) {
+        PyMem_Free(cr->claims.p);
+        memset(cr, 0, sizeof(*cr));
+        return -1;
+    }
+    if (need_wheat > 0 && have_sheep > 0 && cr->result == CVT_OK)
+        cr->result = CVT_PARTIAL;
+    return 0;
+}
+
+/* ---- manage-offer op family (mirror offer_ops._apply_manage) ---------- */
+
+/* write the op-success result: opINNER + op_type + code0 +
+ * ManageOfferSuccessResult{claims, offer-union} */
+static int
+manage_success(Buf *rb, int32_t op_type, const CCross *cr, int effect,
+               const COffer *offer_body)
+{
+    if (buf_i32(rb, 0) < 0 || buf_i32(rb, op_type) < 0 ||
+        buf_i32(rb, 0) < 0 ||
+        buf_u32(rb, (uint32_t)cr->n_claims) < 0 ||
+        buf_put(rb, cr->claims.p, cr->claims.len) < 0 ||
+        buf_i32(rb, effect) < 0)
+        return -1;
+    if (effect != 2 && write_offer_body(offer_body, rb) < 0)
+        return -1;
+    return 0;
+}
+
+/* one _check_offer_valid side; returns 0 ok, else the failure already
+ * written (1) or engine error (-1) */
+static int
+offer_side_valid(Engine *e, Buf *rb, int32_t op_type, const uint8_t src[32],
+                 const CAssetC *asset, int no_trust, int not_auth)
+{
+    if (asset->type == 0 || is_issuer_asset(src, asset))
+        return 0;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(src, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int rc = 0;
+    if (!rec) {
+        rc = res_inner(rb, op_type, no_trust) < 0 ? -1 : 1;
+    } else {
+        CTrustLine tl;
+        if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0)
+            rc = -1;
+        else if (!(tl.flags & 1))
+            rc = res_inner(rb, op_type, not_auth) < 0 ? -1 : 1;
+    }
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* the shared create/update/delete + crossing flow.  is_buy carries the
+ * ManageBuyOffer amount semantics (buy_amount + original buy price);
+ * `pn/pd` is the STORED price (inverted for buy offers). */
+static int
+apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
+               const uint8_t src[32], const CAssetC *selling,
+               const CAssetC *buying, int32_t pn, int32_t pd,
+               int64_t offer_id, int64_t sell_amount, int passive,
+               int is_buy, int64_t buy_amount, int32_t buy_pn,
+               int32_t buy_pd)
+{
+    CHeader *h = &e->header;
+    int rc = offer_side_valid(e, rb, op_type, src, selling, -2, -4);
+    if (rc)
+        return rc < 0 ? -1 : 0;
+    rc = offer_side_valid(e, rb, op_type, src, buying, -3, -5);
+    if (rc)
+        return rc < 0 ? -1 : 0;
+
+    int creating = offer_id == 0;
+    COffer old;
+    int old_ext_v1 = 0, old_sponsored = 0;
+    uint8_t old_sponsor[32];
+    uint8_t kx[48];
+    if (!creating) {
+        offer_key_xdr_c(src, offer_id, kx);
+        RB *rec = eng_get(e, kx, 48);
+        if (!rec)
+            return res_inner(rb, op_type, -11) < 0 ? -1 : 0;  /* NOT_FOUND */
+        if (parse_offer_entry(rec->bytes, rec->len, &old) < 0)
+            return -1;
+        old_ext_v1 = old.entry_ext_v1;
+        old_sponsored = old.entry_ext_v1 && old.has_sponsor;
+        if (old_sponsored)
+            memcpy(old_sponsor, old.sponsor, 32);
+        if (offer_liabilities(e, &old, 0) != 1)
+            return -1;          /* oracle asserts the release succeeds */
+        if (eng_put(e, &e->tx_delta, kx, 48, NULL) < 0)
+            return -1;
+        if (sell_amount == 0) {
+            CAccount acc;
+            if (eng_get_account(e, src, &acc) <= 0)
+                return -1;
+            if (old_sponsored) {
+                if (release_entry_sponsor(e, old_sponsor, 1, &acc) < 0)
+                    return -1;
+            }
+            acc.num_sub -= 1;
+            if (eng_put_account(e, &e->tx_delta, &acc) < 0)
+                return -1;
+            CCross none;
+            memset(&none, 0, sizeof(none));
+            return manage_success(rb, op_type, &none, 2, NULL) < 0 ? -1 : 1;
+        }
+    }
+
+    int64_t max_sheep = can_sell_at_most_c(e, src, selling);
+    if (sell_amount < max_sheep)
+        max_sheep = sell_amount;
+    int64_t max_wheat;
+    if (is_buy) {
+        max_wheat = can_buy_at_most_c(e, src, buying);
+        if (buy_amount < max_wheat)
+            max_wheat = buy_amount;
+    } else {
+        max_wheat = can_buy_at_most_c(e, src, buying);
+    }
+    CCross cross;
+    if (convert_with_offers_c(e, selling, buying, max_wheat, max_sheep,
+                              src, RND_NORMAL, pn, pd, passive,
+                              &cross) < 0)
+        return -1;
+
+#define MG_FAIL(code_) do { \
+        int rr = res_inner(rb, op_type, (code_)); \
+        PyMem_Free(cross.claims.p); \
+        return rr < 0 ? -1 : 0; \
+    } while (0)
+
+    if (cross.self_cross)
+        MG_FAIL(-8);                                  /* CROSS_SELF */
+    rc = transfer_c(e, src, selling, -cross.sheep_sent);
+    if (rc < 0) { PyMem_Free(cross.claims.p); return -1; }
+    if (rc == 0)
+        MG_FAIL(-7);                                  /* UNDERFUNDED */
+    rc = transfer_c(e, src, buying, cross.wheat_received);
+    if (rc < 0) { PyMem_Free(cross.claims.p); return -1; }
+    if (rc == 0)
+        MG_FAIL(-6);                                  /* LINE_FULL */
+
+    i128 residual;
+    if (is_buy) {
+        i128 left = (i128)buy_amount - cross.wheat_received;
+        residual = left <= 0 ? 0
+            : ((left * buy_pn) + buy_pd - 1) / buy_pd;  /* ceil */
+    } else {
+        residual = (i128)sell_amount - cross.sheep_sent;
+    }
+    int effect = creating ? 0 : 1;                    /* CREATED : UPDATED */
+    int64_t cs = can_sell_at_most_c(e, src, selling);
+    int64_t bounded = residual < cs ? (int64_t)residual : cs;
+    int64_t new_amount = adjust_offer_c(pn, pd, bounded,
+                                        can_buy_at_most_c(e, src, buying));
+    if (new_amount <= 0) {
+        if (!creating) {
+            CAccount acc;
+            if (eng_get_account(e, src, &acc) <= 0) {
+                PyMem_Free(cross.claims.p);
+                return -1;
+            }
+            if (old_sponsored) {
+                if (release_entry_sponsor(e, old_sponsor, 1, &acc) < 0) {
+                    PyMem_Free(cross.claims.p);
+                    return -1;
+                }
+            }
+            acc.num_sub -= 1;
+            if (eng_put_account(e, &e->tx_delta, &acc) < 0) {
+                PyMem_Free(cross.claims.p);
+                return -1;
+            }
+        }
+        int rr = manage_success(rb, op_type, &cross, 2, NULL);
+        PyMem_Free(cross.claims.p);
+        return rr < 0 ? -1 : 1;
+    }
+
+    COffer off;
+    memset(&off, 0, sizeof(off));
+    off.last_modified = h->ledger_seq;
+    memcpy(off.seller, src, 32);
+    off.offer_id = offer_id;
+    off.selling = *selling;
+    off.buying = *buying;
+    off.amount = new_amount;
+    off.price_n = pn;
+    off.price_d = pd;
+    off.flags = passive ? 1 : 0;
+    if (creating) {
+        CAccount acc;
+        if (eng_get_account(e, src, &acc) <= 0) {
+            PyMem_Free(cross.claims.p);
+            return -1;
+        }
+        if (!add_num_entries_c(h, &acc, 1))
+            MG_FAIL(-12);                             /* LOW_RESERVE */
+        if (eng_put_account(e, &e->tx_delta, &acc) < 0) {
+            PyMem_Free(cross.claims.p);
+            return -1;
+        }
+        h->id_pool += 1;
+        off.offer_id = (int64_t)h->id_pool;
+    } else if (old_ext_v1) {
+        /* the oracle carries existing.ext VERBATIM (incl. a v1 ext with
+         * a null sponsoringID) */
+        off.entry_ext_v1 = 1;
+        off.has_sponsor = old_sponsored;
+        if (old_sponsored)
+            memcpy(off.sponsor, old_sponsor, 32);
+    }
+    Buf eb = {0};
+    if (serialize_offer_entry(&off, &eb) < 0) {
+        PyMem_Free(eb.p);
+        PyMem_Free(cross.claims.p);
+        return -1;
+    }
+    offer_key_xdr_c(off.seller, off.offer_id, kx);
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    if (!val || eng_put(e, &e->tx_delta, kx, 48, val) < 0) {
+        PyMem_Free(cross.claims.p);
+        return -1;
+    }
+    rc = offer_liabilities(e, &off, 1);
+    if (rc < 0) { PyMem_Free(cross.claims.p); return -1; }
+    if (rc == 0)
+        MG_FAIL(-6);                                  /* LINE_FULL */
+    int rr = manage_success(rb, op_type, &cross, effect, &off);
+    PyMem_Free(cross.claims.p);
+    return rr < 0 ? -1 : 1;
+#undef MG_FAIL
+}
+
+/* op frames: ManageSellOffer (3) / CreatePassiveSellOffer (4) /
+ * ManageBuyOffer (12) */
+static int
+op_manage_offer(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                Buf *rb)
+{
+    int32_t op_type = op->op_type;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    CAssetC selling, buying;
+    if (parse_asset(&r, &selling) < 0 || parse_asset(&r, &buying) < 0)
+        return -1;
+    int64_t amount = rd_i64(&r);
+    int32_t pn = rd_i32(&r);
+    int32_t pd = rd_i32(&r);
+    int64_t offer_id = 0;
+    if (op_type != 4)
+        offer_id = rd_i64(&r);
+    if (r.err)
+        return -1;
+    int passive = op_type == 4;
+    int is_buy = op_type == 12;
+
+    /* do_check_valid (per frame) */
+    int price_ok = pn > 0 && pd > 0;
+    int assets_ok = asset_valid_c(&selling) && asset_valid_c(&buying) &&
+                    !asset_eq(&selling, &buying);
+    int malformed;
+    if (op_type == 4)
+        malformed = amount <= 0 || !price_ok || !assets_ok;
+    else
+        malformed = amount < 0 || !price_ok || !assets_ok ||
+                    offer_id < 0 || (amount == 0 && offer_id == 0);
+    if (malformed)
+        return res_inner(rb, op_type, -1) < 0 ? -1 : 0;
+
+    int64_t sell_amount = amount;
+    int32_t use_pn = pn, use_pd = pd;
+    int64_t buy_amount = 0;
+    if (is_buy) {
+        buy_amount = amount;
+        use_pn = pd;                      /* stored price is inverted */
+        use_pd = pn;
+        if (buy_amount == 0) {
+            sell_amount = 0;
+        } else {
+            i128 sa = ((i128)buy_amount * pn + pd - 1) / pd;  /* ceil */
+            if (sa > INT64_MAXV)
+                return res_inner(rb, op_type, -1) < 0 ? -1 : 0;
+            sell_amount = (int64_t)sa;
+        }
+    }
+    return apply_manage_c(e, rb, op_type, src, &selling, &buying,
+                          use_pn, use_pd, offer_id, sell_amount, passive,
+                          is_buy, buy_amount, pn, pd);
 }

@@ -156,11 +156,13 @@ def test_multisig_setoptions_and_failures_native_equals_python():
 
 
 def test_mixed_unsupported_traffic_falls_back_mid_stream():
-    """Checkpoints containing ops outside the native set (offers) force
-    the per-checkpoint Python fallback; the export/import round trips
-    must be hash-exact.  Trustline create/payment traffic is NATIVE as of
-    the r5 widening and must not fall back."""
-    from stellar_core_tpu.testutils import manage_sell_offer_op
+    """Checkpoints containing ops outside the native set (pool-share
+    trustlines) force the per-checkpoint Python fallback; the
+    export/import round trips must be hash-exact.  Trustline, payment AND
+    offer traffic is NATIVE as of the r5 widening and must not fall
+    back."""
+    from stellar_core_tpu.testutils import (change_trust_pool_op,
+                                            manage_sell_offer_op)
 
     rng = random.Random(5)
 
@@ -177,9 +179,16 @@ def test_mixed_unsupported_traffic_falls_back_mid_stream():
                    for a in accounts[10 + 5 * batch:15 + 5 * batch]])
         close([issuer.tx([payment_op(accounts[11].account_id, asset,
                                      70000)])])
-        # unsupported traffic: an offer (python fallback checkpoint)
+        # offers are native too (r5): rest one + cross it partially
         close([accounts[11].tx([manage_sell_offer_op(
             asset, X.Asset.native(), 500, 1, 2)])])
+        close([accounts[12].tx([change_trust_op(asset)]),
+               accounts[13].tx([change_trust_op(asset)])])
+        close([accounts[12].tx([manage_sell_offer_op(
+            X.Asset.native(), asset, 300, 2, 1)])])
+        # unsupported traffic: a pool-share trustline (python fallback)
+        close([accounts[14].tx([change_trust_pool_op(
+            X.Asset.native(), asset)])])
         # ... 60+ more native-only ledgers so a later whole checkpoint is
         # native again after the fallback one
         for _ in range(66):
@@ -400,3 +409,97 @@ def test_randomized_traffic_differential_fuzz():
             cm = _assert_replays_agree(archive, mgr)
             # the whole fuzz mix is inside the native set: no fallbacks
             assert cm.stats["native_ledgers_applied"] > 20, cm.stats
+
+
+def test_offer_crossing_differential():
+    """Order-book crossing through the native engine: resting offers,
+    partial fills, full consumption, passive offers, buy offers, updates
+    and deletes — identical results/hashes vs the Python crossing engine
+    (the r5 C port of exchangeV10 + convertWithOffers)."""
+    from stellar_core_tpu.testutils import (create_passive_sell_offer_op,
+                                            manage_buy_offer_op,
+                                            manage_sell_offer_op)
+
+    for seed in (7, 19):
+        rng = random.Random(seed)
+
+        def traffic(close, accounts, root, rng=rng):
+            issuer = accounts[0]
+            usd = make_asset("USD", issuer.account_id)
+            eur = make_asset("EURO5", issuer.account_id)
+            traders = accounts[1:13]
+            close([t.tx([change_trust_op(usd)]) for t in traders])
+            close([t.tx([change_trust_op(eur)]) for t in traders])
+            close([issuer.tx([payment_op(t.account_id, usd, 10 ** 9)])
+                   for t in traders[:6]])
+            close([issuer.tx([payment_op(t.account_id, eur, 10 ** 9)])
+                   for t in traders[6:]])
+            pairs = [(X.Asset.native(), usd), (usd, X.Asset.native()),
+                     (usd, eur), (eur, usd)]
+            for _ in range(26):
+                frames = []
+                for _ in range(rng.randrange(1, 5)):
+                    t = traders[rng.randrange(len(traders))]
+                    selling, buying = pairs[rng.randrange(len(pairs))]
+                    n = rng.randrange(1, 8)
+                    d = rng.randrange(1, 8)
+                    amt = rng.randrange(1, 10 ** 6)
+                    roll = rng.random()
+                    if roll < 0.55:
+                        frames.append(t.tx([manage_sell_offer_op(
+                            selling, buying, amt, n, d)]))
+                    elif roll < 0.75:
+                        frames.append(t.tx([manage_buy_offer_op(
+                            selling, buying, amt, n, d)]))
+                    elif roll < 0.9:
+                        frames.append(t.tx([create_passive_sell_offer_op(
+                            selling, buying, amt, n, d)]))
+                    else:
+                        # delete/update a random own offer id (often
+                        # NOT_FOUND — failure differential)
+                        frames.append(t.tx([manage_sell_offer_op(
+                            selling, buying,
+                            rng.choice((0, amt)), n, d,
+                            offer_id=rng.randrange(1, 60))]))
+                if frames:
+                    close(frames)
+
+        with tempfile.TemporaryDirectory() as d:
+            archive, mgr = _archive(d, traffic)
+            cm = _assert_replays_agree(archive, mgr)
+            assert cm.stats["native_ledgers_applied"] > 25, cm.stats
+
+
+def test_offer_deterministic_fill_differential():
+    """A deterministic partial + full fill: maker rests 1000 USD @ 2/1,
+    taker buys 400 (partial), second taker sweeps the rest (full,
+    deleting the offer).  Verifies resting-offer shrink, claim atoms, and
+    idPool evolution through the native engine."""
+    from stellar_core_tpu.testutils import manage_sell_offer_op
+
+    def traffic(close, accounts, root):
+        issuer, maker, t1, t2 = accounts[0], accounts[1], accounts[2], \
+            accounts[3]
+        usd = make_asset("USD", issuer.account_id)
+        close([a.tx([change_trust_op(usd)]) for a in (maker, t1, t2)])
+        close([issuer.tx([payment_op(maker.account_id, usd, 10 ** 7)])])
+        # maker sells 1000 USD for XLM at price 2 XLM/USD
+        close([maker.tx([manage_sell_offer_op(
+            usd, X.Asset.native(), 1000, 2, 1)])])
+        # taker 1 sells 800 XLM for USD at 1/2 USD-per-XLM -> crosses 400
+        close([t1.tx([manage_sell_offer_op(
+            X.Asset.native(), usd, 800, 1, 2)])])
+        # taker 2 sweeps the remaining 600 with headroom
+        close([t2.tx([manage_sell_offer_op(
+            X.Asset.native(), usd, 5000, 1, 2)])])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        cm = _assert_replays_agree(archive, mgr)
+        assert cm.stats["native_ledgers_applied"] > 0
+        # the maker's USD offer is gone; taker 2's residual XLM offer rests
+        offers = [e for e in mgr.root._entries.values()
+                  if e.data.switch == X.LedgerEntryType.OFFER]
+        assert len(offers) == 1, offers
+        rest = offers[0].data.value
+        assert rest.selling.switch == X.AssetType.ASSET_TYPE_NATIVE
