@@ -1,0 +1,41 @@
+"""Config 1/4 at their stated scale (10k ledgers) under the round-5
+native engine: one interleaved cpu/accel pair + a python-engine pass."""
+import sys, tempfile, time
+sys.path.insert(0, "/root/repo")
+import bench
+from stellar_core_tpu.catchup.catchup import CatchupManager
+from stellar_core_tpu.crypto import keys
+from stellar_core_tpu.testutils import network_id
+
+if not bench.probe_device(timeout_s=120, attempts=2):
+    print("DEVICE DOWN"); sys.exit(1)
+nid = network_id("bench network")
+with tempfile.TemporaryDirectory() as d:
+    t0 = time.perf_counter()
+    archive, mgr = bench.build_archive(nid, "bench network", d + "/a",
+                                       n_payment_ledgers=10000)
+    n = mgr.last_closed_ledger_seq
+    print(f"archive {n} ledgers built in {time.perf_counter()-t0:.0f}s",
+          flush=True)
+    keys.clear_verify_cache()
+    cmw = CatchupManager(nid, "bench network", accel=True, accel_chunk=8192,
+                         accel_hot_threshold=4)
+    cmw.catchup_complete(archive, to_ledger=127)
+    print("warmed", flush=True)
+    for name, kw in (("native-cpu", dict(accel=False)),
+                     ("native-accel", dict(accel=True, accel_chunk=8192,
+                                           accel_hot_threshold=4)),
+                     ("python-cpu", dict(accel=False, native=False))):
+        keys.clear_verify_cache()
+        cm = CatchupManager(nid, "bench network", **kw)
+        t0 = time.perf_counter()
+        m = cm.catchup_complete(archive)
+        dt = time.perf_counter() - t0
+        assert m.lcl_hash == mgr.lcl_hash, name + " diverged"
+        extra = ""
+        if "accel" in name:
+            extra = (f" hit={cm.offload_hit_rate():.3f}"
+                     f" wait={cm.stats.get('collect_wait_s', 0):.1f}"
+                     f" losses={cm.stats.get('race_losses', 0)}"
+                     f" sodium={cm.stats.get('native_libsodium_verifies')}")
+        print(f"{name}: {n/dt:.1f} l/s ({dt:.1f}s){extra}", flush=True)
