@@ -1019,6 +1019,8 @@ typedef struct {
     int supported;              /* everything parseable by the native ops */
 } CTx;
 
+static int skip_predicate(Rd *r, int depth);
+
 /* parse one Operation; returns -1 on parse error */
 static int
 parse_op(Rd *r, COp *op, CTx *tx)
@@ -1141,6 +1143,26 @@ parse_op(Rd *r, COp *op, CTx *tx)
     }
     case 11:                                  /* BUMP_SEQUENCE */
         rd_skip(r, 8);
+        break;
+    case 14: {                                /* CREATE_CLAIMABLE_BALANCE */
+        uint32_t at = rd_u32(r);
+        if (at == 1) { rd_skip(r, 4); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at == 2) { rd_skip(r, 12); if (rd_u32(r) != 0) { r->err = 1; return -1; } rd_skip(r, 32); }
+        else if (at != 0) { r->err = 1; return -1; }
+        rd_skip(r, 8);                         /* amount */
+        uint32_t nc = rd_u32(r);
+        if (r->err || nc > 10) { r->err = 1; return -1; }
+        for (uint32_t i = 0; i < nc; i++) {
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }  /* CLAIMANT_V0 */
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }  /* PK type */
+            rd_skip(r, 32);
+            if (skip_predicate(r, 0) < 0) { r->err = 1; return -1; }
+        }
+        break;
+    }
+    case 15: case 20:                         /* CLAIM / CLAWBACK_CB */
+        if (rd_u32(r) != 0) { r->err = 1; return -1; }      /* bid v0 */
+        rd_skip(r, 32);
         break;
     case 19: {                                /* CLAWBACK */
         uint32_t at = rd_u32(r);
@@ -2526,6 +2548,9 @@ static int op_allow_trust(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_set_tl_flags(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_clawback(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_manage_offer(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_create_cb(Engine *, CTx *, COp *, int, const uint8_t *, Buf *);
+static int op_claim_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_clawback_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 
 /* apply one tx; appends its TransactionResult XDR to `out`.  Mirrors
  * TransactionFrame.apply: all-or-nothing via tx_delta. */
@@ -2582,8 +2607,10 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
          * BumpSequence v10+, Clawback/SetTrustLineFlags v17+ */
         if ((op->op_type == 11 && h->ledger_version < 10) ||
             (op->op_type == 12 && h->ledger_version < 11) ||
-            ((op->op_type == 19 || op->op_type == 21) &&
-             h->ledger_version < 17)) {
+            ((op->op_type == 14 || op->op_type == 15) &&
+             h->ledger_version < 14) ||
+            ((op->op_type == 19 || op->op_type == 20 ||
+              op->op_type == 21) && h->ledger_version < 17)) {
             if (res_outer(&ops_buf, -3) < 0) { rc = -1; goto done; }
             ok = 0;
             continue;
@@ -2635,7 +2662,10 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
             break;
         case 10: r = op_manage_data(e, tx, op, op_src, &ops_buf); break;
         case 11: r = op_bump_sequence(e, tx, op, op_src, &ops_buf); break;
+        case 14: r = op_create_cb(e, tx, op, i, op_src, &ops_buf); break;
+        case 15: r = op_claim_cb(e, tx, op, op_src, &ops_buf); break;
         case 19: r = op_clawback(e, tx, op, op_src, &ops_buf); break;
+        case 20: r = op_clawback_cb(e, tx, op, op_src, &ops_buf); break;
         case 21: r = op_set_tl_flags(e, tx, op, op_src, &ops_buf); break;
         default: r = -1; break;
         }
@@ -5643,4 +5673,526 @@ op_manage_offer(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
     return apply_manage_c(e, rb, op_type, src, &selling, &buying,
                           use_pn, use_pd, offer_id, sell_amount, passive,
                           is_buy, buy_amount, pn, pd);
+}
+
+/* ---- claimable balances (round 5) ------------------------------------- */
+
+/* recursive ClaimPredicate walk: skip + structural bounds (depth <= 4,
+ * AND/OR arity == 2 mirrors _predicate_valid; rel/abs >= 0 checked at
+ * CREATE time only).  Returns 0 ok / -1 malformed. */
+static int
+skip_predicate(Rd *r, int depth)
+{
+    if (depth > 4)
+        return -1;
+    int32_t t = rd_i32(r);
+    if (r->err)
+        return -1;
+    switch (t) {
+    case 0:                                   /* UNCONDITIONAL */
+        return 0;
+    case 1: case 2: {                         /* AND / OR: vec<=2 */
+        uint32_t n = rd_u32(r);
+        if (r->err || n > 2)
+            return -1;
+        for (uint32_t i = 0; i < n; i++)
+            if (skip_predicate(r, depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    case 3: {                                 /* NOT: optional */
+        uint32_t p = rd_u32(r);
+        if (r->err || p > 1)
+            return -1;
+        return p ? skip_predicate(r, depth + 1) : 0;
+    }
+    case 4: case 5:                           /* abs/rel before */
+        rd_skip(r, 8);
+        return r->err ? -1 : 0;
+    default:
+        return -1;
+    }
+}
+
+/* _predicate_valid: structural rules for CREATE (arity exactly 2,
+ * NOT non-null, times >= 0) */
+static int
+predicate_valid_c(Rd *r, int depth)
+{
+    if (depth > 4)
+        return 0;
+    int32_t t = rd_i32(r);
+    if (r->err)
+        return 0;
+    switch (t) {
+    case 0:
+        return 1;
+    case 1: case 2: {
+        uint32_t n = rd_u32(r);
+        if (r->err || n != 2)
+            return 0;
+        for (uint32_t i = 0; i < 2; i++)
+            if (!predicate_valid_c(r, depth + 1))
+                return 0;
+        return 1;
+    }
+    case 3: {
+        uint32_t p = rd_u32(r);
+        if (r->err || p != 1)
+            return 0;
+        return predicate_valid_c(r, depth + 1);
+    }
+    case 4: case 5: {
+        int64_t v = rd_i64(r);
+        return !r->err && v >= 0;
+    }
+    default:
+        return 0;
+    }
+}
+
+/* predicate_satisfied(pred, close_time, created_time=0) */
+static int
+predicate_satisfied_c(Rd *r, uint64_t close_time)
+{
+    int32_t t = rd_i32(r);
+    if (r->err)
+        return 0;
+    switch (t) {
+    case 0:
+        return 1;
+    case 1: {                                 /* AND */
+        uint32_t n = rd_u32(r);
+        int ok = 1;
+        for (uint32_t i = 0; i < n && !r->err; i++)
+            if (!predicate_satisfied_c(r, close_time))
+                ok = 0;
+        return ok && !r->err;
+    }
+    case 2: {                                 /* OR */
+        uint32_t n = rd_u32(r);
+        int ok = 0;
+        for (uint32_t i = 0; i < n && !r->err; i++)
+            if (predicate_satisfied_c(r, close_time))
+                ok = 1;
+        return ok && !r->err;
+    }
+    case 3: {                                 /* NOT */
+        uint32_t p = rd_u32(r);
+        if (r->err || !p)
+            return 0;          /* oracle: not predicate_satisfied(None) is
+                                  unreachable for valid stored predicates */
+        return !predicate_satisfied_c(r, close_time) && !r->err;
+    }
+    case 4: {                                 /* BEFORE_ABSOLUTE_TIME */
+        int64_t v = rd_i64(r);
+        return !r->err && (int64_t)close_time < v;
+    }
+    case 5: {                                 /* BEFORE_RELATIVE_TIME:
+                                  created_time approximated as 0 */
+        int64_t v = rd_i64(r);
+        return !r->err && (int64_t)close_time < v;
+    }
+    default:
+        return 0;
+    }
+}
+
+/* mirror utils.add_num_sponsoring (incl. v2 materialization with padded
+ * signerSponsoringIDs).  Returns 1 ok / 0 reserve-or-underflow fail. */
+static int
+add_num_sponsoring_c(const CHeader *h, CAccount *a, int delta)
+{
+    i128 nc = (i128)a->num_sponsoring + delta;
+    if (nc < 0)
+        return 0;
+    if (delta > 0) {
+        i128 need = ((i128)2 + a->num_sub + nc - a->num_sponsored)
+                    * (i128)h->base_reserve;
+        if ((i128)a->balance < need + a->liab_selling)
+            return 0;
+    }
+    if (a->ext_level < 2) {
+        a->ext_level = 2;
+        while (a->n_ssids < a->n_signers) {
+            a->ssids[a->n_ssids].present = 0;
+            a->n_ssids++;
+        }
+    }
+    a->num_sponsoring = (uint32_t)nc;
+    return 1;
+}
+
+/* release the CB's per-claimant reserve from its recorded sponsor
+ * (mirror _release_claimable_balance_reserve) — the shared
+ * release_entry_sponsor already implements the load / missing-no-op /
+ * underflow-fail-stop / decrement / store sequence. */
+static int
+release_cb_reserve(Engine *e, const uint8_t sponsor[32], int n_claimants)
+{
+    return release_entry_sponsor(e, sponsor, n_claimants, NULL) < 0
+        ? -1 : 1;
+}
+
+/* parsed view of a stored ClaimableBalanceEntry (claimant slices kept
+ * raw; asset parsed; ext sponsor from the LedgerEntry wrapper) */
+typedef struct {
+    uint8_t balance_id[32];
+    int n_claimants;
+    struct { uint8_t dest[32]; const uint8_t *pred; int pred_len; }
+        claimants[10];
+    CAssetC asset;
+    int64_t amount;
+    uint32_t cb_flags;          /* ext v1 flags, 0 when v0 */
+    int has_sponsor;
+    uint8_t sponsor[32];
+} CClaimable;
+
+static int
+parse_cb_entry(const uint8_t *data, int len, CClaimable *cb)
+{
+    memset(cb, 0, sizeof(*cb));
+    Rd r;
+    rd_init(&r, data, len);
+    rd_skip(&r, 4);                           /* lastModified */
+    if (rd_u32(&r) != 4 || r.err)             /* data tag CLAIMABLE_BALANCE */
+        return -1;
+    if (rd_u32(&r) != 0 || r.err)             /* balanceID v0 */
+        return -1;
+    const uint8_t *bid = rd_take(&r, 32);
+    if (!bid)
+        return -1;
+    memcpy(cb->balance_id, bid, 32);
+    uint32_t nc = rd_u32(&r);
+    if (r.err || nc > 10)
+        return -1;
+    cb->n_claimants = (int)nc;
+    for (uint32_t i = 0; i < nc; i++) {
+        if (rd_u32(&r) != 0 || r.err)         /* CLAIMANT_TYPE_V0 */
+            return -1;
+        if (parse_account_id(&r, cb->claimants[i].dest) < 0)
+            return -1;
+        int pstart = r.off;
+        if (skip_predicate(&r, 0) < 0)
+            return -1;
+        cb->claimants[i].pred = data + pstart;
+        cb->claimants[i].pred_len = r.off - pstart;
+    }
+    if (parse_asset(&r, &cb->asset) < 0)
+        return -1;
+    cb->amount = rd_i64(&r);
+    int32_t ext = rd_i32(&r);
+    if (r.err || (ext != 0 && ext != 1))
+        return -1;
+    if (ext == 1) {
+        if (rd_i32(&r) != 0 || r.err)         /* v1 ext v0 */
+            return -1;
+        cb->cb_flags = rd_u32(&r);
+    }
+    int32_t lext = rd_i32(&r);
+    if (r.err || (lext != 0 && lext != 1))
+        return -1;
+    if (lext == 1) {
+        uint32_t sp = rd_u32(&r);
+        if (r.err || sp > 1)
+            return -1;
+        cb->has_sponsor = (int)sp;
+        if (sp && parse_account_id(&r, cb->sponsor) < 0)
+            return -1;
+        if (rd_i32(&r) != 0 || r.err)
+            return -1;
+    }
+    return (r.err || r.off != r.len) ? -1 : 0;
+}
+
+/* cb LedgerKey: tag 4 + ClaimableBalanceID (tag 0 + hash) */
+static void
+cb_key_xdr_c(const uint8_t bid[32], uint8_t out[40])
+{
+    memset(out, 0, 8);
+    out[3] = 4;
+    memcpy(out + 8, bid, 32);
+}
+
+/* mirror CreateClaimableBalanceOpFrame (v14+, MED threshold) */
+static int
+op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
+             const uint8_t src[32], Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    CAssetC asset;
+    if (parse_asset(&r, &asset) < 0)
+        return -1;
+    int64_t amount = rd_i64(&r);
+    uint32_t nc = rd_u32(&r);
+    if (r.err || nc > 10)
+        return -1;
+    uint8_t dests[10][32];
+    const uint8_t *claimants_start = op->body + r.off;
+    int preds_valid = 1;
+    for (uint32_t i = 0; i < nc; i++) {
+        if (rd_u32(&r) != 0 || r.err)
+            return -1;
+        if (parse_account_id(&r, dests[i]) < 0)
+            return -1;
+        Rd pr = r;                        /* validate from here */
+        if (!predicate_valid_c(&pr, 0))
+            preds_valid = 0;
+        if (skip_predicate(&r, 0) < 0)
+            return -1;
+    }
+    int claimants_len = (int)(op->body + r.off - claimants_start);
+    if (r.err)
+        return -1;
+
+    /* do_check_valid: amount>0, asset valid, claimants nonempty+unique,
+     * predicates structurally valid */
+    int malformed = amount <= 0 || !asset_valid_c(&asset) || nc == 0 ||
+                    !preds_valid;
+    for (uint32_t i = 0; !malformed && i < nc; i++)
+        for (uint32_t j = i + 1; j < nc; j++)
+            if (memcmp(dests[i], dests[j], 32) == 0) {
+                malformed = 1;
+                break;
+            }
+    if (malformed)
+        return res_inner(rb, 14, -1) < 0 ? -1 : 0;   /* MALFORMED */
+
+    CAccount srca;
+    if (eng_get_account(e, src, &srca) <= 0)
+        return -1;
+    /* the source sponsors its own creation (no sandwich natively) */
+    if (!add_num_sponsoring_c(h, &srca, (int)nc))
+        return res_inner(rb, 14, -2) < 0 ? -1 : 0;   /* LOW_RESERVE */
+    if (asset.type == 0) {
+        if (!add_balance_c(h, &srca, -amount, 1))
+            return res_inner(rb, 14, -5) < 0 ? -1 : 0;  /* UNDERFUNDED */
+    } else if (!is_issuer_asset(src, &asset)) {
+        /* write the sponsoring-count change FIRST so the trustline arm's
+         * failure codes match the oracle's sequencing (oracle mutates the
+         * same src_e object; both sides commit only on success) */
+        Buf kb = {0};
+        if (trustline_key_xdr_c(src, asset.type, asset.code, asset.issuer,
+                                &kb) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        RB *rec = eng_get(e, kb.p, kb.len);
+        if (!rec) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 14, -3) < 0 ? -1 : 0;  /* NO_TRUST */
+        }
+        CTrustLine tl;
+        if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (!(tl.flags & 1)) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 14, -4) < 0 ? -1 : 0;  /* NOT_AUTHORIZED */
+        }
+        if (!add_tl_balance_c(&tl, -amount)) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 14, -5) < 0 ? -1 : 0;  /* UNDERFUNDED */
+        }
+        int rc = store_trustline(e, &kb, &tl, rb, 14);
+        if (rc < 0)
+            return -1;
+        /* store_trustline wrote a success result we don't want yet —
+         * rewind it (12 bytes: opINNER + type + code); the clawback flag
+         * comes from the re-probe below, mirroring the oracle's second
+         * load_trustline */
+        rb->len -= 12;
+    }
+
+    /* balanceID = sha256(HashIDPreimage.operationID) with the TX source */
+    Buf pre = {0};
+    uint8_t bid[32];
+    if (buf_u32(&pre, 6) < 0 ||                   /* ENVELOPE_TYPE_OP_ID */
+        write_account_id(&pre, tx->source) < 0 ||
+        buf_i64(&pre, tx->seq_num) < 0 ||
+        buf_u32(&pre, (uint32_t)op_index) < 0) {
+        PyMem_Free(pre.p);
+        return -1;
+    }
+    sha256_of(pre.p, pre.len, bid);
+    PyMem_Free(pre.p);
+
+    /* clawback flag propagates from the source trustline (re-probe the
+     * CURRENT state, as the oracle does) */
+    uint32_t cb_flags = 0;
+    if (asset.type != 0 && !is_issuer_asset(src, &asset)) {
+        Buf kb = {0};
+        if (trustline_key_xdr_c(src, asset.type, asset.code, asset.issuer,
+                                &kb) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        RB *rec = eng_get(e, kb.p, kb.len);
+        PyMem_Free(kb.p);
+        if (rec) {
+            CTrustLine tl;
+            if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0)
+                return -1;
+            if (tl.flags & 4u)
+                cb_flags = 1;      /* CLAIMABLE_BALANCE_CLAWBACK_ENABLED */
+        }
+    }
+    srca.last_modified = h->ledger_seq;
+    if (eng_put_account(e, &e->tx_delta, &srca) < 0)
+        return -1;
+    /* build the CB LedgerEntry */
+    Buf eb = {0};
+    if (buf_u32(&eb, h->ledger_seq) < 0 || buf_u32(&eb, 4) < 0 ||
+        buf_u32(&eb, 0) < 0 || buf_put(&eb, bid, 32) < 0 ||
+        buf_u32(&eb, nc) < 0 ||
+        buf_put(&eb, claimants_start, claimants_len) < 0 ||
+        write_asset(&eb, &asset) < 0 ||
+        buf_i64(&eb, amount) < 0) {
+        PyMem_Free(eb.p);
+        return -1;
+    }
+    if (cb_flags) {
+        if (buf_i32(&eb, 1) < 0 || buf_i32(&eb, 0) < 0 ||
+            buf_u32(&eb, cb_flags) < 0) {
+            PyMem_Free(eb.p);
+            return -1;
+        }
+    } else if (buf_i32(&eb, 0) < 0) {
+        PyMem_Free(eb.p);
+        return -1;
+    }
+    /* LedgerEntry ext v1 with sponsoringID = source */
+    if (buf_i32(&eb, 1) < 0 || buf_u32(&eb, 1) < 0 ||
+        write_account_id(&eb, src) < 0 || buf_i32(&eb, 0) < 0) {
+        PyMem_Free(eb.p);
+        return -1;
+    }
+    uint8_t kx[40];
+    cb_key_xdr_c(bid, kx);
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    if (!val || eng_put(e, &e->tx_delta, kx, 40, val) < 0)
+        return -1;
+    /* success carries the balance id */
+    if (buf_i32(rb, 0) < 0 || buf_i32(rb, 14) < 0 || buf_i32(rb, 0) < 0 ||
+        buf_u32(rb, 0) < 0 || buf_put(rb, bid, 32) < 0)
+        return -1;
+    return 1;
+}
+
+/* mirror ClaimClaimableBalanceOpFrame (v14+) */
+static int
+op_claim_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    if (rd_u32(&r) != 0 || r.err)             /* balanceID v0 */
+        return -1;
+    const uint8_t *bid = rd_take(&r, 32);
+    if (!bid || r.err)
+        return -1;
+    uint8_t kx[40];
+    cb_key_xdr_c(bid, kx);
+    RB *rec = eng_get(e, kx, 40);
+    if (!rec)
+        return res_inner(rb, 15, -1) < 0 ? -1 : 0;  /* DOES_NOT_EXIST */
+    CClaimable cb;
+    if (parse_cb_entry(rec->bytes, rec->len, &cb) < 0)
+        return -1;
+    int ci = -1;
+    for (int i = 0; i < cb.n_claimants; i++)
+        if (memcmp(cb.claimants[i].dest, src, 32) == 0) {
+            ci = i;
+            break;
+        }
+    int satisfied = 0;
+    if (ci >= 0) {
+        Rd pr;
+        rd_init(&pr, cb.claimants[ci].pred, cb.claimants[ci].pred_len);
+        satisfied = predicate_satisfied_c(&pr, h->close_time);
+    }
+    if (ci < 0 || !satisfied)
+        return res_inner(rb, 15, -2) < 0 ? -1 : 0;  /* CANNOT_CLAIM */
+    if (cb.asset.type == 0) {
+        CAccount acc;
+        if (eng_get_account(e, src, &acc) <= 0)
+            return -1;
+        if (!add_balance_c(h, &acc, cb.amount, 0))
+            return res_inner(rb, 15, -3) < 0 ? -1 : 0;  /* LINE_FULL */
+        acc.last_modified = h->ledger_seq;
+        if (eng_put_account(e, &e->tx_delta, &acc) < 0)
+            return -1;
+    } else if (!is_issuer_asset(src, &cb.asset)) {
+        Buf kb = {0};
+        if (trustline_key_xdr_c(src, cb.asset.type, cb.asset.code,
+                                cb.asset.issuer, &kb) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        RB *trec = eng_get(e, kb.p, kb.len);
+        if (!trec) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 15, -4) < 0 ? -1 : 0;  /* NO_TRUST */
+        }
+        CTrustLine tl;
+        if (parse_trustline_entry(trec->bytes, trec->len, &tl) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (!(tl.flags & 1)) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 15, -5) < 0 ? -1 : 0;  /* NOT_AUTHORIZED */
+        }
+        if (!add_tl_balance_c(&tl, cb.amount)) {
+            PyMem_Free(kb.p);
+            return res_inner(rb, 15, -3) < 0 ? -1 : 0;  /* LINE_FULL */
+        }
+        int rc = store_trustline(e, &kb, &tl, rb, 15);
+        if (rc < 0)
+            return -1;
+        rb->len -= 12;            /* rewind the helper's success result */
+    }
+    if (cb.has_sponsor) {
+        if (release_cb_reserve(e, cb.sponsor, cb.n_claimants) < 0)
+            return -1;
+    }
+    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+        return -1;
+    return res_inner(rb, 15, 0) < 0 ? -1 : 1;
+}
+
+/* mirror ClawbackClaimableBalanceOpFrame (v17+) */
+static int
+op_clawback_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    if (rd_u32(&r) != 0 || r.err)
+        return -1;
+    const uint8_t *bid = rd_take(&r, 32);
+    if (!bid || r.err)
+        return -1;
+    uint8_t kx[40];
+    cb_key_xdr_c(bid, kx);
+    RB *rec = eng_get(e, kx, 40);
+    if (!rec)
+        return res_inner(rb, 20, -1) < 0 ? -1 : 0;  /* DOES_NOT_EXIST */
+    CClaimable cb;
+    if (parse_cb_entry(rec->bytes, rec->len, &cb) < 0)
+        return -1;
+    if (!is_issuer_asset(src, &cb.asset))
+        return res_inner(rb, 20, -2) < 0 ? -1 : 0;  /* NOT_ISSUER */
+    if (!(cb.cb_flags & 1u))
+        return res_inner(rb, 20, -3) < 0 ? -1 : 0;  /* NOT_CLAWBACK_ENABLED */
+    if (cb.has_sponsor) {
+        if (release_cb_reserve(e, cb.sponsor, cb.n_claimants) < 0)
+            return -1;
+    }
+    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+        return -1;
+    return res_inner(rb, 20, 0) < 0 ? -1 : 1;
 }

@@ -342,11 +342,23 @@ def test_randomized_traffic_differential_fuzz():
                                 X.ManageDataOp(dataName=name,
                                                dataValue=val)))]))
                         data_names[(i, name)] = val is not None
-                    elif roll < 0.74:
+                    elif roll < 0.72:
                         frames.append(a.tx([X.Operation(
                             body=X.OperationBody.bumpSequenceOp(
                                 X.BumpSequenceOp(bumpTo=rng.randrange(
                                     0, 2 ** 40))))]))
+                    elif roll < 0.74:
+                        # OP-SOURCED payment: op.sourceAccount != tx
+                        # source (distinct signature-check target and
+                        # lastModified stamping path); the op source must
+                        # co-sign
+                        j = rng.choice([x for x in alive if x != i])
+                        frames.append(build_tx(
+                            NID, a.secret, a.next_seq(),
+                            [native_payment_op(
+                                accounts[0].account_id, 999,
+                                source=accounts[j].account_id)],
+                            extra_signers=[accounts[j].secret]))
                     elif roll < 0.78 and i in trusted:
                         which = rng.random()
                         if which < 0.34:
@@ -503,3 +515,103 @@ def test_offer_deterministic_fill_differential():
         assert len(offers) == 1, offers
         rest = offers[0].data.value
         assert rest.selling.switch == X.AssetType.ASSET_TYPE_NATIVE
+
+
+def test_claimable_balance_differential():
+    """Create / claim / clawback claimable balances (native + credit
+    assets, conditional predicates, the per-claimant sponsored reserve)
+    through the native engine — identical hashes/stores vs the oracle."""
+    def traffic(close, accounts, root):
+        issuer, a, b, c_ = accounts[0], accounts[1], accounts[2], accounts[3]
+        usd = make_asset("USD", issuer.account_id)
+        close([issuer.tx([X.Operation(
+            body=X.OperationBody.setOptionsOp(X.SetOptionsOp(
+                setFlags=X.AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG)))])])
+        close([x.tx([change_trust_op(usd)]) for x in (a, b)])
+        close([issuer.tx([payment_op(a.account_id, usd, 10 ** 7)])])
+
+        def cb_op(acct, asset, amount, claimants):
+            return acct.tx([X.Operation(
+                body=X.OperationBody.createClaimableBalanceOp(
+                    X.CreateClaimableBalanceOp(
+                        asset=asset, amount=amount, claimants=claimants)))])
+
+        uncond = X.ClaimPredicate.unconditional()
+        before = X.ClaimPredicate.absBefore(1_600_009_999)
+        after_not = X.ClaimPredicate.notPredicate(
+            X.ClaimPredicate.absBefore(1))
+        # native CB with two claimants (conditional + unconditional)
+        close([cb_op(c_, X.Asset.native(), 5_000_000, [
+            X.Claimant.v0(X.ClaimantV0(destination=b.account_id,
+                                       predicate=before)),
+            X.Claimant.v0(X.ClaimantV0(destination=a.account_id,
+                                       predicate=after_not))])])
+        # credit CB from a clawback-enabled trustline
+        close([cb_op(a, usd, 70_000, [
+            X.Claimant.v0(X.ClaimantV0(destination=b.account_id,
+                                       predicate=uncond))])])
+        # b claims the native one (predicate satisfied: closeTime < abs)
+        ids = [e.data.value.balanceID
+               for e in mgr_entries_cb()]
+        # claims happen by scanning current CB entries
+        for bid in ids:
+            close([b.tx([X.Operation(
+                body=X.OperationBody.claimClaimableBalanceOp(
+                    X.ClaimClaimableBalanceOp(balanceID=bid)))])])
+        # recreate a credit CB and claw it back as the issuer
+        close([cb_op(a, usd, 50_000, [
+            X.Claimant.v0(X.ClaimantV0(destination=c_.account_id,
+                                       predicate=uncond))])])
+        bid2 = mgr_entries_cb()[0].data.value.balanceID
+        close([issuer.tx([X.Operation(
+            body=X.OperationBody.clawbackClaimableBalanceOp(
+                X.ClawbackClaimableBalanceOp(balanceID=bid2)))])])
+        # a failing claim: wrong claimant
+        close([cb_op(c_, X.Asset.native(), 1_000, [
+            X.Claimant.v0(X.ClaimantV0(destination=a.account_id,
+                                       predicate=uncond))])])
+        bid3 = mgr_entries_cb()[0].data.value.balanceID
+        close([b.tx([X.Operation(
+            body=X.OperationBody.claimClaimableBalanceOp(
+                X.ClaimClaimableBalanceOp(balanceID=bid3)))])])
+
+    with tempfile.TemporaryDirectory() as d:
+        mgr0 = LedgerManager(NID, invariant_manager=None)
+        mgr0.start_new_ledger()
+
+        def mgr_entries_cb():
+            return [e for e in mgr0.root._entries.values()
+                    if e.data.switch == X.LedgerEntryType.CLAIMABLE_BALANCE]
+        archive = FileHistoryArchive(d + "/archive")
+        history = HistoryManager(mgr0, PASS, [archive])
+        rk = mgr0.root_account_secret()
+        e0 = mgr0.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(
+                rk.public_key.ed25519))).to_xdr())
+        root = TestAccount(mgr0, rk, e0.data.value.seqNum)
+        ct = [1_600_000_000]
+
+        def close(frames):
+            ct[0] += 5
+            history.ledger_closed(mgr0.close_ledger(frames, ct[0]))
+
+        sks = [SecretKey(bytes([140 + i]) * 32) for i in range(4)]
+        close([root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 10 ** 11)
+            for sk in sks])])
+        accounts = []
+        for sk in sks:
+            en = mgr0.root.get_entry(X.LedgerKey.account(
+                X.LedgerKeyAccount(accountID=X.AccountID.ed25519(
+                    sk.public_key.ed25519))).to_xdr())
+            accounts.append(TestAccount(mgr0, sk, en.data.value.seqNum))
+        traffic(close, accounts, root)
+        while not history.published_checkpoints or \
+                history.published_checkpoints[-1] != \
+                mgr0.last_closed_ledger_seq:
+            close([])
+        cm = _assert_replays_agree(archive, mgr0)
+        assert cm.stats["native_ledgers_applied"] > 0
+        # the whole CB mix must be native (no fallbacks)
+        assert cm.stats["native_ledgers_applied"] == \
+            mgr0.last_closed_ledger_seq - 1, cm.stats
