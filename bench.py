@@ -412,7 +412,7 @@ def bench_quorum(budget_s: float = 700.0):
     matrix["asym7_py_s"] = "SKIPPED(>900s measured r3)"
     run_c("asym7", a7, 110, expect=True)
     run("asym7", "tpu", lambda: check_intersection_tpu(a7, batch_size=8192),
-        280, expect=True)
+        260, expect=True)
     matrix["quorum_matrix_budget_s"] = budget_s
     matrix["quorum_matrix_spent_s"] = round(time.perf_counter() - t_start, 1)
     return matrix
