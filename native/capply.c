@@ -994,7 +994,7 @@ typedef struct {
 
 #define MAX_OPS 100
 
-typedef struct {
+typedef struct CTx_ {
     const uint8_t *env;         /* raw envelope record */
     int env_len;
     int is_v0;
@@ -1014,6 +1014,11 @@ typedef struct {
     int n_sigs;
     CDecSig sigs[20];
     uint8_t content_hash[32];
+    /* fee bump (reference: FeeBumpTransactionFrame): source is the FEE
+     * source, fee64 the Int64 outer bid, inner the wrapped v1 frame */
+    int is_feebump;
+    int64_t fee64;
+    struct CTx_ *inner;
     /* fee phase result */
     int bad_seq;
     int supported;              /* everything parseable by the native ops */
@@ -1232,8 +1237,82 @@ parse_envelope_rd(Rd *outer, const uint8_t network_id[32], CTx *tx)
     uint32_t etype = rd_u32(&r);
     if (r.err)
         return -1;
-    if (etype == 5)
-        return 1;               /* fee bump: fall back */
+    if (etype == 5) {
+        /* FeeBumpTransactionEnvelope: feeSource, fee(i64), innerTx
+         * (union tag ENVELOPE_TYPE_TX + TransactionV1Envelope — byte-
+         * identical to a standalone v1 envelope, so recurse), ext, sigs */
+        tx->is_feebump = 1;
+        int fb_start = r.off;           /* feeBumpTx slice starts here */
+        uint32_t mt = rd_u32(&r);
+        if (mt == 0x100) { tx->source_muxed = 1; tx->has_muxed = 1; rd_skip(&r, 8); }
+        else if (mt != 0) return -1;
+        const uint8_t *q = rd_take(&r, 32);
+        if (!q)
+            return -1;
+        memcpy(tx->source, q, 32);
+        tx->fee64 = rd_i64(&r);
+        if (r.err)
+            return -1;
+        /* peek: the innerTx union tag must be ENVELOPE_TYPE_TX */
+        if (r.off + 4 > r.len ||
+            !(env[r.off] == 0 && env[r.off + 1] == 0 &&
+              env[r.off + 2] == 0 && env[r.off + 3] == 2))
+            return -1;
+        tx->inner = PyMem_Malloc(sizeof(CTx));
+        if (!tx->inner) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        Rd ir;
+        rd_init(&ir, env + r.off, len - r.off);
+        int irc = parse_envelope_rd(&ir, network_id, tx->inner);
+        if (irc != 0) {
+            PyMem_Free(tx->inner);
+            tx->inner = NULL;
+            return irc;
+        }
+        r.off += ir.off;
+        if (rd_i32(&r) != 0 || r.err)   /* FeeBumpTransactionExt v0 */
+            return -1;
+        int fb_end = r.off;
+        uint32_t n_sigs = rd_u32(&r);
+        if (r.err || n_sigs > 20)
+            return -1;
+        tx->n_sigs = (int)n_sigs;
+        for (uint32_t i = 0; i < n_sigs; i++) {
+            const uint8_t *hint = rd_take(&r, 4);
+            if (!hint)
+                return -1;
+            uint32_t sl;
+            const uint8_t *sig = rd_varopaque(&r, 64, &sl);
+            if (!sig)
+                return -1;
+            tx->sigs[i].hint = hint;
+            tx->sigs[i].sig = sig;
+            tx->sigs[i].sig_len = (int)sl;
+            tx->sigs[i].used = 0;
+        }
+        if (r.err)
+            return -1;
+        tx->env_len = r.off;
+        outer->off += r.off;
+        /* outer hash = sha256(nid || ENVELOPE_TYPE_TX_FEE_BUMP ||
+         * feeBumpTx bytes) */
+        Sha256 s5;
+        sha_init(&s5);
+        sha_update(&s5, network_id, 32);
+        static const uint8_t tag_fb[4] = {0, 0, 0, 5};
+        sha_update(&s5, tag_fb, 4);
+        sha_update(&s5, env + fb_start, fb_end - fb_start);
+        sha_final(&s5, tx->content_hash);
+        /* fee-bump view fields: seq from the inner tx (apply order),
+         * ops/conditions live on the inner frame */
+        tx->seq_num = tx->inner->seq_num;
+        if (tx->inner->has_muxed)
+            tx->has_muxed = 1;
+        tx->supported = 1;
+        return 0;
+    }
     if (etype != 0 && etype != 2)
         return -1;
     tx->is_v0 = etype == 0;
@@ -2373,6 +2452,11 @@ op_set_options(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
 static int64_t
 fee_charged_c(const CTx *tx, const CHeader *h)
 {
+    if (tx->is_feebump) {
+        /* numOperations = inner ops + 1 (the bump itself) */
+        int64_t min_fee = ((int64_t)tx->inner->n_ops + 1) * h->base_fee;
+        return tx->fee64 < min_fee ? tx->fee64 : min_fee;
+    }
     int64_t min_fee = (int64_t)tx->n_ops * h->base_fee;
     return (int64_t)tx->fee < min_fee ? (int64_t)tx->fee : min_fee;
 }
@@ -2419,6 +2503,36 @@ static int
 fee_phase_c(Engine *e, CTx *tx)
 {
     CHeader *h = &e->header;
+    if (tx->is_feebump) {
+        /* fee from the fee source; seq consumed on the INNER source with
+         * no chain check (mirror FeeBumpTransactionFrame.
+         * process_fee_seq_num; _bad_seq is never set) */
+        CAccount fa;
+        int got = eng_get_account(e, tx->source, &fa);
+        if (got < 0)
+            return -1;
+        if (!got)
+            return 0;
+        int64_t fc = fee_charged_c(tx, h);
+        int64_t avail = fa.balance > 0 ? fa.balance : 0;
+        int64_t fee = fc < avail ? fc : avail;
+        fa.balance -= fee;
+        h->fee_pool += fee;
+        fa.last_modified = h->ledger_seq;
+        if (eng_put_account(e, &e->ledger_delta, &fa) < 0)
+            return -1;
+        CAccount ia;
+        got = eng_get_account(e, tx->inner->source, &ia);
+        if (got < 0)
+            return -1;
+        if (got) {
+            ia.seq_num = tx->inner->seq_num;
+            ia.last_modified = h->ledger_seq;
+            if (eng_put_account(e, &e->ledger_delta, &ia) < 0)
+                return -1;
+        }
+        return 0;
+    }
     CAccount acc;
     int got = eng_get_account(e, tx->source, &acc);
     if (got < 0)
@@ -2552,10 +2666,10 @@ static int op_create_cb(Engine *, CTx *, COp *, int, const uint8_t *, Buf *);
 static int op_claim_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_clawback_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 
-/* apply one tx; appends its TransactionResult XDR to `out`.  Mirrors
- * TransactionFrame.apply: all-or-nothing via tx_delta. */
+/* apply one NON-fee-bump tx; appends its TransactionResult XDR to
+ * `out`.  Mirrors TransactionFrame.apply: all-or-nothing via tx_delta. */
 static int
-apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
+apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
 {
     CHeader *h = &e->header;
     int64_t fee = fee_charged_c(tx, h);
@@ -2710,6 +2824,61 @@ done:
     return rc;
 }
 
+/* fee-bump dispatch (mirror FeeBumpTransactionFrame.apply): the outer
+ * envelope authenticates the fee source at LOW; the inner v1 frame then
+ * applies with its own signatures.  InnerTransactionResult has the same
+ * byte layout as TransactionResult, so the inner core's output embeds
+ * verbatim into the txFEE_BUMP_INNER_* pair. */
+static int
+apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
+{
+    if (!tx->is_feebump)
+        return apply_tx_core(e, tx, close_time, out);
+    CHeader *h = &e->header;
+    int64_t fee = fee_charged_c(tx, h);
+    if (h->ledger_version < 13)
+        return tx_result_void(out, fee, TXC_NOT_SUPPORTED);
+    CChecker ck;
+    ck.n = tx->n_sigs;
+    memcpy(ck.sigs, tx->sigs, sizeof(CDecSig) * tx->n_sigs);
+    ck.content_hash = tx->content_hash;
+    ck.vc = &e->vcache;
+    CAccount fs;
+    int got = eng_get_account(e, tx->source, &fs);
+    if (got < 0)
+        return -1;
+    int auth_ok = got == 1 && check_account_sig(&ck, &fs, 1) &&
+                  checker_all_used(&ck);
+    if (!auth_ok) {
+        /* FEE_BUMP_INNER_FAILED wrapping feeCharged=0 txBAD_AUTH */
+        if (buf_i64(out, fee) < 0 || buf_i32(out, -13) < 0 ||
+            buf_put(out, tx->inner->content_hash, 32) < 0 ||
+            buf_i64(out, 0) < 0 || buf_i32(out, TXC_BAD_AUTH) < 0 ||
+            buf_i32(out, 0) < 0 ||
+            buf_i32(out, 0) < 0)
+            return -1;
+        return 0;
+    }
+    Buf ib = {0};
+    if (apply_tx_core(e, tx->inner, close_time, &ib) < 0) {
+        PyMem_Free(ib.p);
+        return -1;
+    }
+    /* inner result code sits after its i64 feeCharged */
+    int32_t icode = (int32_t)(((uint32_t)ib.p[8] << 24) |
+                              ((uint32_t)ib.p[9] << 16) |
+                              ((uint32_t)ib.p[10] << 8) | ib.p[11]);
+    int32_t ocode = icode == 0 ? 1 : -13;
+    int rc = 0;
+    if (buf_i64(out, fee) < 0 || buf_i32(out, ocode) < 0 ||
+        buf_put(out, tx->inner->content_hash, 32) < 0 ||
+        buf_put(out, ib.p, ib.len) < 0 ||
+        buf_i32(out, 0) < 0)
+        rc = -1;
+    PyMem_Free(ib.p);
+    return rc;
+}
+
 /* ---- apply order (mirror LedgerManager.apply_order) ------------------- */
 
 static void
@@ -2780,6 +2949,26 @@ raise_capply(const char *fmt, uint32_t seq)
     return -1;
 }
 
+/* fee-bump inner frames are heap-allocated per parse; the tx buffers are
+ * reused across records/ledgers, so allocators zero the slots once and
+ * every re-parse frees the previous generation's inners first. */
+static void
+zero_tx_inners(CTx *txs)
+{
+    for (int i = 0; i < MAX_TX_PER_LEDGER; i++)
+        txs[i].inner = NULL;
+}
+
+static void
+free_tx_inners(CTx *txs)
+{
+    for (int i = 0; i < MAX_TX_PER_LEDGER; i++)
+        if (txs[i].inner) {
+            PyMem_Free(txs[i].inner);
+            txs[i].inner = NULL;
+        }
+}
+
 /* parse one TransactionHistoryEntry; fills txs/n_txs and records the
  * TransactionSet slice for hashing.  Returns 0 ok / 1 unsupported / -1
  * malformed. */
@@ -2788,6 +2977,7 @@ parse_tx_record(const uint8_t *rec, int len, const uint8_t nid[32],
                 CTx *txs, int *n_txs, const uint8_t **set_p, int *set_len,
                 uint32_t *rec_seq)
 {
+    free_tx_inners(txs);
     Rd r;
     rd_init(&r, rec, len);
     *rec_seq = rd_u32(&r);
@@ -3287,6 +3477,7 @@ Engine_probe(Engine *self, PyObject *args)
     CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
     if (!txs)
         return PyErr_NoMemory();
+    zero_tx_inners(txs);
     Py_ssize_t n = PyList_Size(tx_recs);
     int ok = 1;
     for (Py_ssize_t i = 0; ok && i < n; i++) {
@@ -3296,6 +3487,7 @@ Engine_probe(Engine *self, PyObject *args)
         char *p;
         Py_ssize_t len;
         if (PyBytes_AsStringAndSize(item, &p, &len) < 0) {
+            free_tx_inners(txs);
             PyMem_Free(txs);
             return NULL;
         }
@@ -3306,6 +3498,7 @@ Engine_probe(Engine *self, PyObject *args)
                             txs, &n_txs, &set_p, &set_len, &rec_seq) != 0)
             ok = 0;
     }
+    free_tx_inners(txs);
     PyMem_Free(txs);
     return PyBool_FromLong(ok);
 }
@@ -3329,6 +3522,7 @@ Engine_apply_checkpoint(Engine *self, PyObject *args)
     CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
     if (!txs)
         return PyErr_NoMemory();
+    zero_tx_inners(txs);
     long applied = 0;
     for (Py_ssize_t i = 0; i < n; i++) {
         /* peek the header seq (first 32 bytes are the entry hash) */
@@ -3336,10 +3530,12 @@ Engine_apply_checkpoint(Engine *self, PyObject *args)
         Py_ssize_t hl;
         if (PyBytes_AsStringAndSize(PyList_GetItem(hdr_recs, i),
                                     &hp, &hl) < 0) {
+            free_tx_inners(txs);
             PyMem_Free(txs);
             return NULL;
         }
         if (hl < 36 + 32) {
+            free_tx_inners(txs);
             PyMem_Free(txs);
             PyErr_SetString(CapplyError, "truncated header record");
             return NULL;
@@ -3358,6 +3554,7 @@ Engine_apply_checkpoint(Engine *self, PyObject *args)
         memset(&peek, 0, sizeof(peek));
         if (parse_header(&r, &peek) < 0) {
             cheader_clear(&peek);
+            free_tx_inners(txs);
             PyMem_Free(txs);
             PyErr_SetString(CapplyError, "malformed header record");
             return NULL;
@@ -3373,16 +3570,19 @@ Engine_apply_checkpoint(Engine *self, PyObject *args)
         Py_ssize_t tl = 0;
         if (txo != Py_None &&
             PyBytes_AsStringAndSize(txo, &tp, &tl) < 0) {
+            free_tx_inners(txs);
             PyMem_Free(txs);
             return NULL;
         }
         if (close_one_ledger(self, (uint8_t *)hp, (int)hl,
                              (uint8_t *)tp, (int)tl, txs) < 0) {
+            free_tx_inners(txs);
             PyMem_Free(txs);
             return NULL;
         }
         applied++;
     }
+    free_tx_inners(txs);
     PyMem_Free(txs);
     return PyLong_FromLong(applied);
 }
@@ -3460,6 +3660,7 @@ Engine_extract_pairs(Engine *self, PyObject *args)
     CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
     if (!txs)
         return PyErr_NoMemory();
+    zero_tx_inners(txs);
     PyObject *pks = PyList_New(0), *sigs = PyList_New(0),
              *msgs = PyList_New(0);
     long total = 0;
@@ -3482,8 +3683,9 @@ Engine_extract_pairs(Engine *self, PyObject *args)
                             &n_txs, &set_p, &set_len, &rec_seq) != 0)
             continue;            /* unsupported/malformed: python pairs it */
         for (int t = 0; t < n_txs; t++) {
-            for (int oi = 0; oi < txs[t].n_ops; oi++) {
-                COp *op = &txs[t].ops[oi];
+            CTx *hb = txs[t].is_feebump ? txs[t].inner : &txs[t];
+            for (int oi = 0; oi < hb->n_ops; oi++) {
+                COp *op = &hb->ops[oi];
                 if (op->op_type != 5)
                     continue;
                 /* walk the SetOptions body to the optional signer */
@@ -3530,23 +3732,28 @@ Engine_extract_pairs(Engine *self, PyObject *args)
             continue;
         for (int t = 0; t < n_txs; t++) {
             CTx *tx = &txs[t];
+            CTx *body = tx->is_feebump ? tx->inner : tx;
             total += tx->n_sigs;
-            /* candidate pks: sources' masters + their state signers */
-            uint8_t cand[1 + MAX_OPS + 21 * (1 + MAX_OPS)][32];
+            /* candidate pks: sources' masters + their state signers
+             * (fee bumps add the inner source; ops live on the inner) */
+            uint8_t cand[2 + MAX_OPS + 21 * (2 + MAX_OPS)][32];
             int n_cand = 0;
-            uint8_t srcs[1 + MAX_OPS][32];
+            uint8_t srcs[2 + MAX_OPS][32];
             int n_srcs = 0;
             memcpy(srcs[n_srcs++], tx->source, 32);
-            for (int oi = 0; oi < tx->n_ops; oi++)
-                if (tx->ops[oi].has_source) {
+            if (tx->is_feebump &&
+                memcmp(tx->inner->source, tx->source, 32) != 0)
+                memcpy(srcs[n_srcs++], tx->inner->source, 32);
+            for (int oi = 0; oi < body->n_ops; oi++)
+                if (body->ops[oi].has_source) {
                     int dup = 0;
                     for (int k = 0; k < n_srcs; k++)
-                        if (memcmp(srcs[k], tx->ops[oi].source, 32) == 0) {
+                        if (memcmp(srcs[k], body->ops[oi].source, 32) == 0) {
                             dup = 1;
                             break;
                         }
                     if (!dup)
-                        memcpy(srcs[n_srcs++], tx->ops[oi].source, 32);
+                        memcpy(srcs[n_srcs++], body->ops[oi].source, 32);
                 }
             for (int k = 0; k < n_srcs; k++) {
                 memcpy(cand[n_cand++], srcs[k], 32);
@@ -3558,11 +3765,24 @@ Engine_extract_pairs(Engine *self, PyObject *args)
                             memcpy(cand[n_cand++], acc.signers[si].key, 32);
                 }
             }
-            for (int di = 0; di < tx->n_sigs; di++) {
-                CDecSig *ds = &tx->sigs[di];
+            /* pair the outer signatures against the outer hash, and —
+             * for fee bumps — the inner signatures against the inner
+             * hash (the Python frames pipeline only pairs the outer
+             * ones; preverifying both is strictly better and verdicts
+             * are identical either way) */
+            int n_total_sigs = tx->n_sigs +
+                (tx->is_feebump ? tx->inner->n_sigs : 0);
+            if (tx->is_feebump)
+                total += tx->inner->n_sigs;
+            for (int di = 0; di < n_total_sigs; di++) {
+                CDecSig *ds = di < tx->n_sigs
+                    ? &tx->sigs[di]
+                    : &tx->inner->sigs[di - tx->n_sigs];
+                const uint8_t *msg_hash = di < tx->n_sigs
+                    ? tx->content_hash : tx->inner->content_hash;
                 uint8_t seen[64][32];
                 int n_seen = 0;
-#define EMIT_PAIR(PK) do {                     int dup = 0;                     for (int z = 0; z < n_seen; z++)                         if (memcmp(seen[z], (PK), 32) == 0) { dup = 1; break; }                     if (!dup && n_seen < 64) {                         memcpy(seen[n_seen++], (PK), 32);                         PyObject *o1 = PyBytes_FromStringAndSize((const char *)(PK), 32);                         PyObject *o2 = PyBytes_FromStringAndSize((const char *)ds->sig, ds->sig_len);                         PyObject *o3 = PyBytes_FromStringAndSize((const char *)tx->content_hash, 32);                         if (!o1 || !o2 || !o3 ||                             PyList_Append(pks, o1) < 0 ||                             PyList_Append(sigs, o2) < 0 ||                             PyList_Append(msgs, o3) < 0) {                             Py_XDECREF(o1); Py_XDECREF(o2); Py_XDECREF(o3);                             goto fail;                         }                         Py_DECREF(o1); Py_DECREF(o2); Py_DECREF(o3);                     }                 } while (0)
+#define EMIT_PAIR(PK) do {                     int dup = 0;                     for (int z = 0; z < n_seen; z++)                         if (memcmp(seen[z], (PK), 32) == 0) { dup = 1; break; }                     if (!dup && n_seen < 64) {                         memcpy(seen[n_seen++], (PK), 32);                         PyObject *o1 = PyBytes_FromStringAndSize((const char *)(PK), 32);                         PyObject *o2 = PyBytes_FromStringAndSize((const char *)ds->sig, ds->sig_len);                         PyObject *o3 = PyBytes_FromStringAndSize((const char *)msg_hash, 32);                         if (!o1 || !o2 || !o3 ||                             PyList_Append(pks, o1) < 0 ||                             PyList_Append(sigs, o2) < 0 ||                             PyList_Append(msgs, o3) < 0) {                             Py_XDECREF(o1); Py_XDECREF(o2); Py_XDECREF(o3);                             goto fail;                         }                         Py_DECREF(o1); Py_DECREF(o2); Py_DECREF(o3);                     }                 } while (0)
                 for (int k = 0; k < n_cand; k++)
                     if (memcmp(ds->hint, cand[k] + 28, 4) == 0)
                         EMIT_PAIR(cand[k]);
@@ -3573,9 +3793,11 @@ Engine_extract_pairs(Engine *self, PyObject *args)
             }
         }
     }
+    free_tx_inners(txs);
     PyMem_Free(txs);
     return Py_BuildValue("(NNNl)", pks, sigs, msgs, total);
 fail:
+    free_tx_inners(txs);
     PyMem_Free(txs);
     Py_XDECREF(pks);
     Py_XDECREF(sigs);
@@ -3670,6 +3892,7 @@ capply_scan_tx_record(PyObject *self, PyObject *args)
     CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
     if (!txs)
         return PyErr_NoMemory();
+    zero_tx_inners(txs);
     int n_txs = 0, set_len;
     const uint8_t *set_p;
     uint32_t rec_seq;
@@ -3680,6 +3903,7 @@ capply_scan_tx_record(PyObject *self, PyObject *args)
         for (int i = 0; i < n_txs; i++)
             if (txs[i].supported)
                 n_sigs += txs[i].n_sigs;
+    free_tx_inners(txs);
     PyMem_Free(txs);
     if (rc < 0) {
         PyErr_SetString(CapplyError, "malformed tx record");

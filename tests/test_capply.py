@@ -615,3 +615,55 @@ def test_claimable_balance_differential():
         # the whole CB mix must be native (no fallbacks)
         assert cm.stats["native_ledgers_applied"] == \
             mgr0.last_closed_ledger_seq - 1, cm.stats
+
+
+def test_fee_bump_differential():
+    """Fee-bumped transactions through the native engine: outer fee-source
+    charging, unconditional inner seq consumption, inner apply with its
+    own signatures, txFEE_BUMP_INNER_SUCCESS/FAILED nesting — plus a
+    bad-outer-auth bump and a failing inner — identical hashes/stores."""
+    def fee_bump(fee_source: TestAccount, inner_frame, fee):
+        fb = X.FeeBumpTransaction(
+            feeSource=X.muxed_from_account_id(fee_source.account_id),
+            fee=fee,
+            innerTx=X.FeeBumpInnerTx.v1(inner_frame.envelope.value),
+            ext=X.FeeBumpTransaction._spec[3][1].cls(0))
+        env = X.TransactionEnvelope.feeBump(
+            X.FeeBumpTransactionEnvelope(tx=fb, signatures=[]))
+        from stellar_core_tpu.transactions.frame import TransactionFrame
+        frame = TransactionFrame.make_from_wire(NID, env)
+        env.value.signatures.append(X.DecoratedSignature(
+            hint=fee_source.secret.public_key.hint(),
+            signature=fee_source.secret.sign(frame.content_hash())))
+        return frame
+
+    def traffic(close, accounts, root):
+        payer, a, b = accounts[0], accounts[1], accounts[2]
+        # successful bump: payer pays the fee for a's payment
+        inner = build_tx(NID, a.secret, a.next_seq(),
+                         [native_payment_op(b.account_id, 12345)], fee=100)
+        close([fee_bump(payer, inner, 400)])
+        # failing inner (overdrawn) still consumes a's seq + payer's fee
+        inner2 = build_tx(NID, a.secret, a.next_seq(),
+                          [native_payment_op(b.account_id, 10 ** 18)],
+                          fee=100)
+        close([fee_bump(payer, inner2, 400)])
+        # bad outer auth: signed by the wrong key
+        inner3 = build_tx(NID, a.secret, a.next_seq(),
+                          [native_payment_op(b.account_id, 777)], fee=100)
+        fb3 = fee_bump(payer, inner3, 400)
+        wrong = SecretKey(bytes([230]) * 32)
+        fb3.envelope.value.signatures[:] = [X.DecoratedSignature(
+            hint=wrong.public_key.hint(),
+            signature=wrong.sign(fb3.content_hash()))]
+        close([fb3])
+        # the inner seq WAS consumed by the failing bump's fee phase...
+        # but not by the bad-auth one (its fee phase still ran!) — mirror
+        # whatever the oracle does by just continuing with fresh payments
+        close([b.tx([native_payment_op(a.account_id, 50)])])
+
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = _archive(d, traffic)
+        cm = _assert_replays_agree(archive, mgr)
+        assert cm.stats["native_ledgers_applied"] == \
+            mgr.last_closed_ledger_seq - 1, cm.stats
