@@ -1,10 +1,17 @@
-.PHONY: native test clean
+.PHONY: native test metrics clean
 
 native:
 	python setup.py build_ext --inplace
 
 test:
 	python -m pytest tests/ -q
+
+# metric-name lint: every name recorded by a simulated ledger close must
+# match layer.subsystem.event and appear in the documented canonical list
+metrics:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q \
+		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+		-k 'MetricNameLint or prometheus'
 
 clean:
 	rm -rf build stellar_core_tpu/_cxdr*.so stellar_core_tpu/_cquorum*.so

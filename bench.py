@@ -270,6 +270,14 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
         assert m.lcl_hash == expected_hash
         _stage(f"replay round {r + 1}/{rounds}: accel...")
         keys.clear_verify_cache()
+        if r == rounds - 1:
+            # the registry is process-global and by now holds the archive
+            # build + all CPU rounds; reset so the observability snapshot
+            # embedded in the bench record describes ONE accel replay
+            # (otherwise crypto.verify.recompute is ~all CPU-round
+            # libsodium work and the close quantiles blend every phase)
+            from stellar_core_tpu.util.metrics import reset_registry
+            reset_registry()
         cm_tpu = CatchupManager(nid, passphrase, accel=True,
                                 accel_chunk=8192, accel_hot_threshold=4)
         t0 = time.perf_counter()
@@ -292,6 +300,22 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
     phases["ratio_max"] = round(max(pair_ratios), 3)
     phases["ratio_median_of_pairs"] = round(med(pair_ratios), 3)
     return med(cpu_rates), med(tpu_rates), hit_rate, n_ledgers, phases
+
+
+def observability_snapshot(hit_rate):
+    """The metrics-registry slice that rides along in BENCH_*.json so
+    hit-rates, batch-size distributions and stage percentiles are
+    comparable round to round (ISSUE 1 exposition: bench embeds the accel
+    preverify hit rate and ed25519 batch-size metrics)."""
+    from stellar_core_tpu.util.metrics import registry
+    out = {"sig_offload_hit_rate": round(hit_rate, 3)}
+    out.update(registry().snapshot(prefix="accel."))
+    # whole catchup family: download/apply stage timers record on BOTH
+    # engines (the native C apply bypasses the Python ledger.ledger.close
+    # timer, so that slice alone would be empty on a standard run)
+    out.update(registry().snapshot(prefix="catchup."))
+    out.update(registry().snapshot(prefix="ledger.ledger.close"))
+    return out
 
 
 def tier1_quorum_map(n_orgs=9):
@@ -526,6 +550,7 @@ def main():
         _stage("replay bench...")
         cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
             nid, passphrase, archive, mgr.lcl_hash)
+    obs = observability_snapshot(hit_rate)
     _cache_put("replay", {
         "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
         "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
@@ -534,6 +559,7 @@ def main():
         "replay_hashes_identical": True,
         "sig_offload_hit_rate": round(hit_rate, 3),
         "replay_phases": phases,
+        "metrics": obs,
     })
 
     _stage("quorum bench (crossover matrix)...")
@@ -560,6 +586,7 @@ def main():
                 round(tpu_sig_rate / cpu_sig_rate, 2),
             **matrix,
             "replay_phases": phases,
+            "metrics": obs,
         },
     }))
     cancel_watchdog()

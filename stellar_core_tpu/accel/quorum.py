@@ -43,6 +43,7 @@ except ImportError:  # older jax
 from ..herder.quorum_intersection import (
     InterruptedError_, QuorumIntersectionChecker, QuorumIntersectionResult,
     flatten_qmap)
+from ..util.metrics import registry as _registry
 
 # Padding sentinel for inner-set thresholds: never satisfiable.
 _PAD_THR = 1 << 30
@@ -371,6 +372,23 @@ class TPUQuorumIntersectionChecker:
 
     # -- the frontier search ---------------------------------------------
     def check(self) -> QuorumIntersectionResult:
+        # enumeration-scale observability: map size, peak frontier width
+        # and quorum hits land in the registry (accel.quorum.*).  Both
+        # accumulators reset HERE: an aborted run must not re-count the
+        # previous check()'s hits in the finally block below
+        self._frontier_peak = 0
+        self._quorum_hits = 0
+        _registry().counter("accel.quorum.checks").inc()
+        _registry().histogram("accel.quorum.nodes").update(self.n)
+        try:
+            return self._check()
+        finally:
+            _registry().histogram("accel.quorum.frontier-peak").update(
+                self._frontier_peak)
+            _registry().counter("accel.quorum.quorum-hits").inc(
+                self._quorum_hits)
+
+    def _check(self) -> QuorumIntersectionResult:
         oracle = self.oracle
         n = self.n
         if n == 0:
@@ -462,9 +480,13 @@ class TPUQuorumIntersectionChecker:
                 break
             frontier, res = self._chunked_depth(frontier, bits_all[d],
                                                 rems_all[d], process_witness)
+            self._note_frontier(len(frontier))
             if res is not None:
                 return res
         return None
+
+    def _note_frontier(self, width: int) -> None:
+        self._frontier_peak = max(getattr(self, "_frontier_peak", 0), width)
 
     def _chunked_depth(self, frontier, bit_words, rem_words, process_witness):
         """Expand + prune ONE depth on the host-chunked path; returns
@@ -510,6 +532,7 @@ class TPUQuorumIntersectionChecker:
                     else fr_host[:n])
 
         while d < D and count > 0:
+            self._note_frontier(count)
             if self.interrupt():
                 raise InterruptedError_()
             # worst case the frontier doubles every depth of the segment;

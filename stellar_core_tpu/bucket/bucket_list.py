@@ -20,6 +20,7 @@ from typing import Iterable, List, Optional
 
 from ..crypto.sha import SHA256
 from ..util.assertions import release_assert
+from ..util.metrics import registry as _registry
 from ..xdr import LedgerEntry, LedgerKey
 from .bucket import Bucket, merge_buckets
 from .future import FutureBucket
@@ -98,19 +99,20 @@ class BucketList:
         level above, commit the previously prepared merge and prepare the
         next one (reference: BucketListBase::addBatch)."""
         release_assert(ledger_seq > 0, "ledger_seq must be positive")
-        for i in range(NUM_LEVELS - 1, 0, -1):
-            if level_should_spill(ledger_seq, i - 1):
-                spill = self.levels[i - 1].snap_curr()
-                self.levels[i].commit()
-                self.levels[i].prepare(spill, keep_tombstone_entries(i),
-                                       protocol_version, self.executor)
-        fresh = Bucket.fresh(protocol_version, init_entries, live_entries,
-                             dead_keys)
-        # level 0 merges synchronously every ledger (reference: prepare +
-        # immediate commit — the batch is small and needed for this ledger's
-        # hash)
-        self.levels[0].prepare(fresh, True, protocol_version, None)
-        self.levels[0].commit()
+        with _registry().timer("bucket.batch.addtime").time():
+            for i in range(NUM_LEVELS - 1, 0, -1):
+                if level_should_spill(ledger_seq, i - 1):
+                    spill = self.levels[i - 1].snap_curr()
+                    self.levels[i].commit()
+                    self.levels[i].prepare(spill, keep_tombstone_entries(i),
+                                           protocol_version, self.executor)
+            fresh = Bucket.fresh(protocol_version, init_entries,
+                                 live_entries, dead_keys)
+            # level 0 merges synchronously every ledger (reference:
+            # prepare + immediate commit — the batch is small and needed
+            # for this ledger's hash)
+            self.levels[0].prepare(fresh, True, protocol_version, None)
+            self.levels[0].commit()
 
     def hash(self) -> bytes:
         """bucketListHash in the ledger header: SHA-256 over level hashes

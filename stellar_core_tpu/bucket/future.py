@@ -17,7 +17,21 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..util import perf
 from .bucket import Bucket, merge_buckets
+
+# deep-level merges run minutes on big ledgers, by design, in the
+# background — only a pathological merge deserves a slow-scope warning
+perf.set_slow_threshold("bucket.merge.time", 120.0)
+
+
+def _timed_merge(curr: Bucket, snap: Bucket, keep_tombstones: bool,
+                 protocol_version: int) -> Bucket:
+    """merge_buckets with the bucket.merge.time timer (reference: the
+    "bucket.merge" medida timers in BucketManagerImpl) — runs on whichever
+    thread executes the merge, so background merges are timed too."""
+    with perf.scoped_timer("bucket.merge.time"):
+        return merge_buckets(curr, snap, keep_tombstones, protocol_version)
 
 
 class FutureBucket:
@@ -37,10 +51,10 @@ class FutureBucket:
         self.inputs = (curr, snap, keep_tombstones, protocol_version)
         if executor is not None:
             self._future = executor.submit(
-                merge_buckets, curr, snap, keep_tombstones, protocol_version)
+                _timed_merge, curr, snap, keep_tombstones, protocol_version)
         else:
-            self._output = merge_buckets(curr, snap, keep_tombstones,
-                                         protocol_version)
+            self._output = _timed_merge(curr, snap, keep_tombstones,
+                                        protocol_version)
 
     @staticmethod
     def from_output(bucket: Bucket) -> "FutureBucket":

@@ -33,6 +33,7 @@ from ..history.archive import (CATEGORY_LEDGER, CATEGORY_RESULTS,
 from ..ledger.manager import LedgerManager
 from ..transactions.frame import TransactionFrame
 from ..util import logging as slog
+from ..util.metrics import registry as _registry
 
 log = slog.get("History")
 
@@ -159,6 +160,17 @@ class PreverifyPipeline:
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
 
+    def _add_sigs_total(self, n: int) -> None:
+        """One accounting seam for the offload hit-rate denominator —
+        mirrored into the registry so /metrics and bench agree with
+        stats.  The stats dict nets retractions exactly
+        (correct_total_for_fallback can pass n < 0); the registry counter
+        is clamped non-negative because it renders as a Prometheus
+        counter, where a decrease reads as a process restart."""
+        self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + n
+        if n > 0:
+            _registry().counter("catchup.preverify.sigs-total").inc(n)
+
     def _submit(self, fn):
         """Run fn on the single daemon device-worker; returns (box, event).
         box["result"]/box["error"] is set before event fires."""
@@ -214,8 +226,7 @@ class PreverifyPipeline:
             for cp in frames_by_checkpoint:
                 for frame in frames_by_checkpoint[cp]:
                     total += len(frame.signatures)
-            self.stats["sigs_total"] = \
-                self.stats.get("sigs_total", 0) + total
+            self._add_sigs_total(total)
             cps = sorted(frames_by_checkpoint)
             group = {"job": None, "pks": [], "sigs": [], "msgs": [],
                      "checkpoints": cps, "collected": True}
@@ -295,7 +306,7 @@ class PreverifyPipeline:
                         pks.append(pk)
                         sigs.append(dsig.signature)
                         msgs.append(h)
-        self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + total
+        self._add_sigs_total(total)
         # sigs_shipped is counted at COLLECT time (successful seeding
         # only): a group that wedges and falls back to CPU never shipped
         self._enqueue_group(cps, pks, sigs, msgs, t0)
@@ -309,9 +320,8 @@ class PreverifyPipeline:
             # count signatures per checkpoint (honest hit rate denominator)
             # without materializing pairs, then register a no-op group
             for cp in cps:
-                n = self._count_and_record(cp, recs_by_checkpoint[cp])
-                self.stats["sigs_total"] = \
-                    self.stats.get("sigs_total", 0) + n
+                self._add_sigs_total(
+                    self._count_and_record(cp, recs_by_checkpoint[cp]))
             group = {"job": None, "pks": [], "sigs": [], "msgs": [],
                      "checkpoints": cps, "collected": True}
             for cp in cps:
@@ -330,8 +340,7 @@ class PreverifyPipeline:
             sigs.extend(s_)
             msgs.extend(m_)
             self._counted_sigs[cp] = total
-            self.stats["sigs_total"] = \
-                self.stats.get("sigs_total", 0) + total
+            self._add_sigs_total(total)
         self._enqueue_group(cps, pks, sigs, msgs, t0)
 
     def _count_and_record(self, cp, recs) -> int:
@@ -354,8 +363,7 @@ class PreverifyPipeline:
         counted = self._counted_sigs.pop(checkpoint, None)
         if counted is None:
             return
-        self.stats["sigs_total"] = self.stats.get("sigs_total", 0) \
-            + python_total - counted
+        self._add_sigs_total(python_total - counted)
 
     def _enqueue_group(self, cps, pks, sigs, msgs, t0) -> None:
         import time as _time
@@ -381,10 +389,11 @@ class PreverifyPipeline:
         for cp in cps:
             self._groups[cp] = group
         # phase accounting (bench per-phase breakdown): host prep + enqueue
-        self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) \
-            + (_time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + dt
         self.stats["dispatch_groups"] = \
             self.stats.get("dispatch_groups", 0) + 1
+        _registry().timer("catchup.preverify.dispatch").update(dt)
 
     def collect(self, checkpoint: int) -> None:
         """Sync the verdicts of the group containing `checkpoint` (no-op if
@@ -427,8 +436,10 @@ class PreverifyPipeline:
             done = ev.wait(budget)
         # sync stall: how long the apply cursor waited on the device —
         # ~0 when double-buffering hid the compute under earlier applies
-        self.stats["collect_wait_s"] = self.stats.get("collect_wait_s", 0.0) \
-            + (_time.perf_counter() - t0)
+        wait = _time.perf_counter() - t0
+        self.stats["collect_wait_s"] = \
+            self.stats.get("collect_wait_s", 0.0) + wait
+        _registry().timer("catchup.preverify.collect-wait").update(wait)
         race_loss = (not done and not stale
                      and budget < self.COLLECT_TIMEOUT_S)
         first = not self._first_collect_done
@@ -442,6 +453,7 @@ class PreverifyPipeline:
                 group["checkpoints"])
             self.stats["collect_fallbacks"] = \
                 self.stats.get("collect_fallbacks", 0) + 1
+            _registry().counter("catchup.preverify.fallback").inc()
             if race_loss:
                 # the device is slower than libsodium on this group; the
                 # worker keeps running (its queue drains eventually) but
@@ -483,6 +495,7 @@ class PreverifyPipeline:
             self.verdict_sink(pks, sigs, msgs, verdicts)
         self.stats["sigs_shipped"] = \
             self.stats.get("sigs_shipped", 0) + len(pks)
+        _registry().counter("catchup.preverify.sigs-shipped").inc(len(pks))
 
     def close(self) -> None:
         """Release the device worker (a pipeline is per-catchup; a node

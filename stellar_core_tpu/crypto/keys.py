@@ -15,6 +15,7 @@ from typing import Optional
 from . import sodium, strkey
 from .sha import sha256
 from ..util.cache import RandomEvictionCache
+from ..util.metrics import registry as _registry
 
 VERIFY_CACHE_SIZE = 0x10000  # reference: 64k-entry verify cache
 
@@ -135,7 +136,12 @@ def verify_sig(pk: PublicKey, sig: bytes, msg: bytes) -> bool:
     k = _VerifyCache.key(sig, pk.ed25519, msg)
     hit = _verify_cache.get(k)
     if hit is not None:
+        _registry().counter("crypto.verify.cache-hit").inc()
         return hit
+    # cache miss: the verdict is recomputed by libsodium on the host —
+    # during an accel catchup this counter is the un-offloaded remainder
+    # (unpairable hints + wedge/race fallbacks)
+    _registry().counter("crypto.verify.recompute").inc()
     verdict = sodium.verify_detached(sig, msg, pk.ed25519)
     _verify_cache.put(k, verdict)
     return verdict

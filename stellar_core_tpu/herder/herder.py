@@ -97,6 +97,9 @@ class Herder(SCPDriver):
         # slot -> externalized StellarValue waiting for its ledger turn
         self._buffered: Dict[int, X.StellarValue] = {}
         self._processing_ready = False
+        # slot -> perf_counter at nomination trigger (scp.slot.externalize
+        # timer: nomination start -> value applied)
+        self._nominate_started: Dict[int, float] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -122,6 +125,13 @@ class Herder(SCPDriver):
     # ------------------------------------------------------------------
     # intake (called by overlay / HTTP / simulation)
     # ------------------------------------------------------------------
+    # SCP statement pledge type -> per-phase meter suffix (observability:
+    # the nomination/ballot phase mix is how an operator sees where
+    # consensus rounds spend their envelopes)
+    _PHASE_METERS = {0: "scp.envelope.prepare", 1: "scp.envelope.confirm",
+                     2: "scp.envelope.externalize",
+                     3: "scp.envelope.nominate"}
+
     def recv_scp_envelope(self, env) -> str:
         st = env.statement
         slot = st.slotIndex
@@ -132,6 +142,9 @@ class Herder(SCPDriver):
         if not self.verify_envelope(env):
             return ENVELOPE_STATUS_DISCARDED
         _registry().meter("scp.envelope.receive").mark()
+        phase = self._PHASE_METERS.get(int(st.pledges.type))
+        if phase is not None:
+            _registry().meter(phase).mark()
         status = self.pending.recv_envelope(env)
         if status == ENVELOPE_STATUS_READY:
             self._process_scp_queue()
@@ -221,6 +234,10 @@ class Herder(SCPDriver):
         if seq != self.next_ledger_index():
             return
         self._last_trigger_at = self.clock.now()
+        # clock time, not perf_counter: under a virtual clock the
+        # consensus latency IS virtual (timeout-driven); wall time would
+        # report crank speed instead
+        self._nominate_started.setdefault(seq, self.clock.now())
         frames = self.tx_queue.tx_set_frames()
         tx_set, tx_set_hash, _ordered = self.lm.make_tx_set(frames)
         self.pending.add_txset(tx_set_hash, tx_set,
@@ -422,6 +439,12 @@ class Herder(SCPDriver):
                                         stellar_value=sv)
             self.state = HerderState.TRACKING
             _registry().meter("herder.ledger.externalize").mark()
+            t0 = self._nominate_started.pop(nxt, None)
+            if t0 is not None:
+                # nomination trigger -> externalized value applied (the
+                # consensus-round latency an operator tunes timers against)
+                _registry().timer("scp.slot.externalize").update(
+                    self.clock.now() - t0)
             self._persist_scp_state(nxt, sv, txset)
             self.ledger_closed_hook(arts)
             self.tx_queue.remove_applied(frames)
@@ -432,6 +455,8 @@ class Herder(SCPDriver):
                                  keep=0)
             self.pending.erase_below(seq + 1 - MAX_SLOTS_TO_REMEMBER
                                      if seq + 1 > MAX_SLOTS_TO_REMEMBER else 0)
+            for s in [s for s in self._nominate_started if s <= seq]:
+                del self._nominate_started[s]
             self._arm_trigger(seq + 1)
         if self._buffered and min(self._buffered) > \
                 self.tracking_consensus_ledger_index() + 1:

@@ -16,6 +16,7 @@ from .. import xdr as X
 from ..ledger.ledger_txn import LedgerTxn
 from ..transactions.frame import TransactionFrame
 from ..util import logging as slog
+from ..util.metrics import registry as _registry
 
 log = slog.get("Herder")
 
@@ -66,6 +67,14 @@ class TransactionQueue:
         self.by_hash: Dict[bytes, TransactionFrame] = {}
         # banned tx hash -> ledgers remaining
         self.banned: Dict[bytes, int] = {}
+        # depth gauges: registry is process-global, so the last-created
+        # queue wins (multi-node simulations share one registry; per-node
+        # depth stays in /metrics' herder section); weak_gauge so a
+        # torn-down node's graph is not pinned
+        _registry().weak_gauge("herder.tx-queue.depth", self,
+                               lambda q: q.size)
+        _registry().weak_gauge("herder.tx-queue.banned", self,
+                               lambda q: len(q.banned))
 
     # ------------------------------------------------------------------
     def _account_key(self, frame: TransactionFrame) -> bytes:

@@ -25,7 +25,9 @@ from ..crypto.sha import sha256
 from ..bucket.bucket_list import BucketList
 from ..transactions.frame import TransactionFrame
 from ..util import logging as slog
+from ..util import tracing
 from ..util.assertions import release_assert
+from ..util.metrics import registry as _registry
 from .ledger_txn import LedgerTxn, LedgerTxnRoot
 
 log = slog.get("Ledger")
@@ -216,8 +218,18 @@ class LedgerManager:
         LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
         release_assert(self.root is not None,
                        "start_new_ledger/load first")
-        from ..util.metrics import registry
-        _close_timer = registry().timer("ledger.ledger.close")
+        with tracing.span("ledger.close",
+                          seq=self.lcl_header.ledgerSeq + 1,
+                          txs=len(frames)):
+            return self._close_ledger(frames, close_time, tx_set,
+                                      expected_ledger_hash, stellar_value)
+
+    def _close_ledger(self, frames: Sequence[TransactionFrame],
+                      close_time: int,
+                      tx_set: Optional[X.TransactionSet],
+                      expected_ledger_hash: Optional[bytes],
+                      stellar_value: Optional[X.StellarValue]
+                      ) -> ClosedLedgerArtifacts:
         _t0 = time.perf_counter()
         if tx_set is None:
             tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
@@ -242,17 +254,21 @@ class LedgerManager:
         ltx.commit_header(header)
 
         # phase 1: fees + seq nums for every tx, before any applies
-        for f in ordered:
-            with LedgerTxn(ltx) as fee_ltx:
-                f.process_fee_seq_num(fee_ltx)
-                fee_ltx.commit()
+        with tracing.span("ledger.fee-process"), \
+                _registry().timer("ledger.fee.process").time():
+            for f in ordered:
+                with LedgerTxn(ltx) as fee_ltx:
+                    f.process_fee_seq_num(fee_ltx)
+                    fee_ltx.commit()
 
         # phase 2: apply
         result_pairs: List[X.TransactionResultPair] = []
-        for f in ordered:
-            res = f.apply(ltx, close_time)
-            result_pairs.append(X.TransactionResultPair(
-                transactionHash=f.content_hash(), result=res))
+        with tracing.span("ledger.tx-apply"):
+            for f in ordered:
+                with tracing.span("tx.apply"):
+                    res = f.apply(ltx, close_time)
+                result_pairs.append(X.TransactionResultPair(
+                    transactionHash=f.content_hash(), result=res))
 
         result_set = X.TransactionResultSet(results=result_pairs)
         header = ltx.load_header()
@@ -300,12 +316,13 @@ class LedgerManager:
             self.invariants.check_on_ledger_close(inv_ctx,
                                                   needs_buckets=False)
 
-        self.bucket_list.add_batch(seq, header.ledgerVersion,
-                                   init_entries, live_entries, dead_keys)
-        header = ltx.load_header()
-        header.bucketListHash = self.bucket_list.hash()
-        self._update_skip_list(header)
-        ltx.commit_header(header)
+        with tracing.span("ledger.seal"):
+            self.bucket_list.add_batch(seq, header.ledgerVersion,
+                                       init_entries, live_entries, dead_keys)
+            header = ltx.load_header()
+            header.bucketListHash = self.bucket_list.hash()
+            self._update_skip_list(header)
+            ltx.commit_header(header)
 
         if inv_ctx is not None:
             # post-bucket phase: a violation means the bucket list is
@@ -330,8 +347,12 @@ class LedgerManager:
         result_entry = X.TransactionHistoryResultEntry(
             ledgerSeq=seq, txResultSet=result_set)
 
-        _close_timer.update(time.perf_counter() - _t0)
-        registry().meter("ledger.transaction.apply").mark(len(ordered))
+        # registry lookups are NOT cached across the close: /clearmetrics
+        # resets metrics in place, but reset_registry() (tests) swaps the
+        # whole registry — a cached reference would feed a dead object
+        _registry().timer("ledger.ledger.close").update(
+            time.perf_counter() - _t0)
+        _registry().meter("ledger.transaction.apply").mark(len(ordered))
         if self.meta_stream is not None:
             self._emit_close_meta(header_entry, tx_set, result_pairs)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)

@@ -10,9 +10,14 @@ and ACCEL_CHUNK_SIZE, the `--accel` surface BASELINE.json benchmarks flip.
 
 from __future__ import annotations
 
-import tomllib
+import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+try:
+    import tomllib  # stdlib since 3.11
+except ModuleNotFoundError:  # 3.10 container: subset parser below
+    tomllib = None
 
 from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
@@ -82,8 +87,14 @@ class Config:
 
     @staticmethod
     def from_toml(path: str) -> "Config":
-        with open(path, "rb") as f:
-            raw = tomllib.load(f)
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                raw = tomllib.load(f)
+        else:
+            # TOML mandates UTF-8; the locale default on a py3.10
+            # container is often C/ASCII
+            with open(path, "r", encoding="utf-8") as f:
+                raw = _parse_toml_subset(f.read())
         return Config.from_dict(raw)
 
     @staticmethod
@@ -113,3 +124,57 @@ class Config:
                         mkdir_cmd=spec.get("mkdir", "")))
             # unknown keys are tolerated (reference warns; we ignore)
         return cfg
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Minimal TOML-subset parser for Python < 3.11 (no stdlib tomllib):
+    `[dotted.section]` tables plus `KEY = value` pairs whose values are
+    JSON-compatible TOML (basic strings, integers, floats, booleans,
+    single-line arrays) — exactly the node.cfg surface this repo's docs
+    and tests use."""
+    def strip_comment(line: str) -> str:
+        # an unquoted '#' starts a comment; '#' inside a basic string
+        # does not (the subset's strings are JSON-style double-quoted).
+        # Escape state is tracked, not peeked: a string ending in an
+        # escaped backslash ("x\\") must still close on its quote.
+        in_str = escaped = False
+        for i, c in enumerate(line):
+            if in_str:
+                if escaped:
+                    escaped = False
+                elif c == "\\":
+                    escaped = True
+                elif c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+            elif c == "#":
+                return line[:i]
+        return line
+
+    root: dict = {}
+    table = root
+    for lineno, raw_line in enumerate(text.splitlines(), 1):
+        line = strip_comment(raw_line).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            table = root
+            for part in line[1:-1].strip().split("."):
+                nxt = table.setdefault(part.strip(), {})
+                if not isinstance(nxt, dict):
+                    raise ValueError(
+                        f"config line {lineno}: section {line} collides "
+                        f"with key {part.strip()!r}")
+                table = nxt
+            continue
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"config line {lineno}: expected KEY = value")
+        try:
+            table[key.strip()] = json.loads(val.strip())
+        except ValueError as e:
+            raise ValueError(
+                f"config line {lineno}: unsupported TOML value "
+                f"{val.strip()!r} (full TOML needs Python 3.11+)") from e
+    return root

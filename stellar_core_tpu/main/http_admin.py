@@ -93,8 +93,11 @@ class CommandHandler:
 
             def _reply(self, obj, code=200):
                 body = json.dumps(obj, indent=1).encode()
+                self._reply_raw(body, "application/json", code)
+
+            def _reply_raw(self, body: bytes, content_type: str, code=200):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -106,7 +109,22 @@ class CommandHandler:
                     if url.path == "/info":
                         self._reply({"info": self._snap(app.info)})
                     elif url.path == "/metrics":
-                        self._reply({"metrics": self._snap(app.metrics)})
+                        fmt = parse_qs(url.query).get("format", ["json"])[0]
+                        if fmt == "prometheus":
+                            from ..util.metrics import (registry,
+                                                        render_prometheus)
+                            text = self._snap(lambda: render_prometheus(
+                                registry().snapshot()))
+                            self._reply_raw(
+                                text.encode(),
+                                "text/plain; version=0.0.4; charset=utf-8")
+                        else:
+                            self._reply({"metrics": self._snap(app.metrics)})
+                    elif url.path == "/trace":
+                        from ..util import tracing
+                        doc = self._snap(tracing.to_chrome_trace)
+                        self._reply_raw(json.dumps(doc).encode(),
+                                        "application/json")
                     elif url.path == "/quorum":
                         transitive = parse_qs(url.query).get(
                             "transitive", ["false"])[0] == "true"
@@ -265,7 +283,7 @@ class CommandHandler:
 
 
 _ENDPOINTS = [
-    "/info", "/metrics", "/quorum", "/peers", "/scp", "/tx", "/ll",
+    "/info", "/metrics", "/trace", "/quorum", "/peers", "/scp", "/tx", "/ll",
     "/logrotate", "/manualclose", "/bans", "/ban", "/unban", "/connect",
     "/droppeer", "/maintenance", "/clearmetrics", "/self-check",
     "/upgrades", "/surveytopologytimesliced", "/stopsurvey",

@@ -27,6 +27,11 @@ from .survey import SurveyManager
 
 log = slog.get("Overlay")
 
+# per-type intake meter names, precomputed: _message_received is the
+# hottest overlay path and must not rebuild the slug per message
+_RECV_METER = {t: "overlay.recv." + t.name.lower().replace("_", "-")
+               for t in X.MessageType}
+
 
 class OverlayManager:
     def __init__(self, clock, herder, network_id: bytes,
@@ -52,6 +57,10 @@ class OverlayManager:
         herder.lost_sync_hook = self.survey.record_lost_sync
         self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0,
               "txsets_served": 0, "qsets_served": 0}
+        # weak_gauge: must not pin a torn-down node's peer graph in the
+        # process-global registry (dead source -> null gauge)
+        _registry().weak_gauge("overlay.peer.authenticated", self,
+                               lambda o: len(o.authenticated_peers))
 
         # herder wiring (same seams the in-process simulation uses)
         herder.broadcast = self.broadcast_scp_envelope
@@ -224,6 +233,9 @@ class OverlayManager:
     def _message_received(self, peer: Peer, msg: X.StellarMessage) -> None:
         t = msg.switch
         MT = X.MessageType
+        # per-message-type intake meter (reference: the per-type
+        # "overlay.recv.*" medida timers in Peer::recvMessage)
+        _registry().meter(_RECV_METER[t]).mark()
         if t in (MT.SEND_MORE, MT.SEND_MORE_EXTENDED):
             return  # handled in Peer flow control
         if t == MT.SCP_MESSAGE:
@@ -275,6 +287,7 @@ class OverlayManager:
         if not self.floodgate.add_record(
                 h, self.herder.tracking_consensus_ledger_index(), peer):
             self.stats["deduped"] += 1
+            _registry().meter("overlay.flood.duplicate").mark()
             return
         t = msg.switch
         MT = X.MessageType
@@ -294,6 +307,7 @@ class OverlayManager:
         h = sha256(msg.to_xdr())
         if not self.floodgate.add_record(h, env.statement.slotIndex, peer):
             self.stats["deduped"] += 1
+            _registry().meter("overlay.flood.duplicate").mark()
             return
         status = self.herder.recv_scp_envelope(env)
         if status != "discarded":
@@ -309,6 +323,7 @@ class OverlayManager:
         if not self.floodgate.add_record(
                 h, self.herder.tracking_consensus_ledger_index(), peer):
             self.stats["deduped"] += 1
+            _registry().meter("overlay.flood.duplicate").mark()
             return
         res = self.herder.recv_transaction(frame)
         if getattr(res, "code", None) == "pending":

@@ -19,6 +19,7 @@ from typing import Callable, List, Optional
 
 from .. import xdr as X
 from ..util import logging as slog
+from ..util.metrics import registry as _registry
 from .peer_auth import PeerAuth, mac_message, mac_ok
 
 log = slog.get("Overlay")
@@ -152,7 +153,14 @@ class Peer:
     def _send_unauthenticated(self, msg: X.StellarMessage) -> None:
         am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
             sequence=0, message=msg, mac=X.HmacSha256Mac(mac=_ZERO_MAC)))
-        self._write_bytes(frame_encode(am.to_xdr()))
+        self._write_frame(frame_encode(am.to_xdr()))
+
+    def _write_frame(self, data: bytes) -> None:
+        # wire-level accounting: framed bytes + messages out (reference:
+        # the overlay byte/message write medida meters in Peer)
+        _registry().counter("overlay.byte.write").inc(len(data))
+        _registry().meter("overlay.message.write").mark()
+        self._write_bytes(data)
 
     def send_message(self, msg: X.StellarMessage) -> None:
         """Authenticated send; flood messages respect granted capacity and
@@ -183,7 +191,7 @@ class Peer:
             sequence=self._send_seq, message=msg,
             mac=X.HmacSha256Mac(mac=mac)))
         self._send_seq += 1
-        self._write_bytes(frame_encode(am.to_xdr()))
+        self._write_frame(frame_encode(am.to_xdr()))
 
     def _flush_flood_queue(self) -> None:
         while self._flood_queue and self._outbound_capacity > 0:
@@ -201,6 +209,7 @@ class Peer:
 
     # -- receiving ----------------------------------------------------------
     def data_received(self, data: bytes) -> None:
+        _registry().counter("overlay.byte.read").inc(len(data))
         try:
             frames = self._decoder.feed(data)
         except ValueError as e:
@@ -209,6 +218,7 @@ class Peer:
         for frame in frames:
             if self.state == Peer.CLOSING:
                 return
+            _registry().meter("overlay.message.read").mark()
             self._frame_received(frame)
 
     def _frame_received(self, frame: bytes) -> None:

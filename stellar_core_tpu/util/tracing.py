@@ -1,0 +1,203 @@
+"""Hierarchical span tracing with Chrome trace-event export.
+
+Reference shape: the reference's LogSlowExecution + medida timers only
+aggregate; this module keeps the *structure* of recent hot operations —
+a ledger close is `ledger.close` > `ledger.tx-apply` > one `tx.apply` per
+transaction; a catchup crank is `catchup.apply-checkpoint` above all of
+that — so an operator can open one slow close in `chrome://tracing` (or
+`ui.perfetto.dev`) instead of inferring shape from percentiles.
+
+Design:
+- `span("name", key=value)` is a context manager; the current span is
+  context-local (contextvars), so nesting is automatic and thread/async
+  safe — each thread traces its own tree.
+- finished ROOT spans land in a bounded ring buffer (newest wins); child
+  spans attach to their parent and cost two perf_counter calls + one
+  object.
+- `to_chrome_trace()` renders the buffer as Chrome trace-event JSON
+  (`{"traceEvents": [...]}`, "X" complete events, microsecond units);
+  `dump_trace(path)` writes it to a file; the `/trace` admin endpoint
+  serves it over HTTP.
+
+Tracing is always on: the buffer is bounded in ALL dimensions —
+TRACE_BUFFER_SPANS roots, MAX_CHILD_SPANS children per span, and
+MAX_TREE_SPANS total spans per root tree (the elided tail is counted in
+each span's `truncated_children` arg) — and span overhead is far below
+the operations instrumented (ledger close, checkpoint download, bucket
+merge).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+TRACE_BUFFER_SPANS = 64
+# Per-parent child cap: a replay crank can hold thousands of tx.apply
+# leaves per ledger; beyond this the tail is elided (the span records how
+# many were dropped).  256 leaves is more than chrome://tracing is
+# readable at anyway.
+MAX_CHILD_SPANS = 256
+# Total-span budget per root tree: the per-parent cap alone is
+# multiplicative (64 ledgers x 256 leaves each), so a whole tree is also
+# budgeted — once exhausted, further spans are elided and counted in
+# their parent's truncated tally.  Worst case the ring then pins
+# TRACE_BUFFER_SPANS * MAX_TREE_SPANS spans (~a few MB), a real bound.
+MAX_TREE_SPANS = 2048
+
+_current: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("stpu_current_span", default=None)
+# span count of the current root tree ([n] so children mutate in place)
+_tree_count: contextvars.ContextVar[Optional[list]] = \
+    contextvars.ContextVar("stpu_tree_count", default=None)
+
+# one wall-clock anchor so ts values in an export share an epoch
+_EPOCH_WALL = time.time()
+_EPOCH_PERF = time.perf_counter()
+
+
+class Span:
+    __slots__ = ("name", "start_s", "dur_s", "args", "children", "tid",
+                 "truncated")
+
+    def __init__(self, name: str, args: Optional[Dict] = None):
+        self.name = name
+        self.start_s = time.perf_counter()
+        self.dur_s: Optional[float] = None
+        self.args = args or None
+        self.children: List["Span"] = []
+        self.tid = threading.get_ident()
+        self.truncated = 0  # children elided past MAX_CHILD_SPANS
+
+    def finish(self) -> None:
+        self.dur_s = time.perf_counter() - self.start_s
+
+    def depth(self) -> int:
+        """Nesting levels including self (a leaf is 1)."""
+        return 1 + max((c.depth() for c in self.children), default=0)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "start_s": self.start_s,
+                "dur_s": self.dur_s, "args": self.args,
+                "children": [c.to_dict() for c in self.children]}
+
+
+class TraceBuffer:
+    """Bounded ring of finished root spans (newest kept)."""
+
+    def __init__(self, maxlen: int = TRACE_BUFFER_SPANS):
+        self._roots: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, root: Span) -> None:
+        with self._lock:
+            self._roots.append(root)
+
+    def roots(self) -> List[Span]:
+        with self._lock:
+            return list(self._roots)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._roots.clear()
+
+
+_buffer = TraceBuffer()
+
+
+def trace_buffer() -> TraceBuffer:
+    return _buffer
+
+
+@contextlib.contextmanager
+def span(name: str, **args):
+    """Open a span under the context-local current span; finished roots
+    are recorded in the process trace buffer."""
+    parent = _current.get()
+    counter = _tree_count.get()
+    ctoken = None
+    if parent is None or counter is None:
+        counter = [1]
+        ctoken = _tree_count.set(counter)
+    else:
+        counter[0] += 1
+    s = Span(name, args)
+    token = _current.set(s)
+    try:
+        yield s
+    finally:
+        s.finish()
+        _current.reset(token)
+        if parent is not None:
+            if len(parent.children) < MAX_CHILD_SPANS \
+                    and counter[0] <= MAX_TREE_SPANS:
+                parent.children.append(s)
+            else:
+                parent.truncated += 1
+        else:
+            _buffer.record(s)
+        if ctoken is not None:
+            _tree_count.reset(ctoken)
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def annotate(**args) -> None:
+    """Attach key=value data to the current span (no-op outside one)."""
+    s = _current.get()
+    if s is not None:
+        if s.args is None:
+            s.args = {}
+        s.args.update(args)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def _emit(events: List[dict], s: Span, pid: int) -> None:
+    ts_us = (_EPOCH_WALL + (s.start_s - _EPOCH_PERF)) * 1e6
+    ev = {
+        "name": s.name,
+        "ph": "X",
+        "ts": round(ts_us, 3),
+        "dur": round((s.dur_s or 0.0) * 1e6, 3),
+        "pid": pid,
+        "tid": s.tid,
+        "cat": s.name.split(".", 1)[0],
+    }
+    if s.args:
+        # values must be JSON-serializable; coerce the rest to str
+        ev["args"] = {k: (v if isinstance(v, (int, float, str, bool,
+                                              type(None))) else str(v))
+                      for k, v in s.args.items()}
+    if s.truncated:
+        ev.setdefault("args", {})["truncated_children"] = s.truncated
+    events.append(ev)
+    for c in s.children:
+        _emit(events, c, pid)
+
+
+def to_chrome_trace(roots: Optional[List[Span]] = None,
+                    pid: int = 1) -> dict:
+    """The trace buffer (or explicit roots) as a Chrome trace-event JSON
+    document — load it in chrome://tracing or ui.perfetto.dev."""
+    events: List[dict] = []
+    for root in (roots if roots is not None else _buffer.roots()):
+        _emit(events, root, pid)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump_trace(path: str, roots: Optional[List[Span]] = None) -> int:
+    """Write the Chrome trace JSON to `path`; returns the event count."""
+    doc = to_chrome_trace(roots)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

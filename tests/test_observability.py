@@ -1,0 +1,386 @@
+"""Admin observability surface (ISSUE 1): /metrics JSON + Prometheus
+exposition, /clearmetrics continuity, /trace Chrome trace-event export,
+/ll level round-trips, and the metric-name lint against the documented
+canonical list.
+
+Reference test model: src/main/test/CommandHandlerTests.cpp plus medida
+exposition shape checks.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util import metrics, tracing
+
+# Prometheus text exposition: every non-comment line is
+# `name{labels} value`; TYPE comments carry a known metric kind.
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+-]+|NaN|[+-]Inf)$")
+_PROM_TYPE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary|histogram)$")
+
+
+def _assert_prometheus_parses(text: str) -> int:
+    samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert _PROM_TYPE.match(line), f"bad comment line: {line!r}"
+            continue
+        assert _PROM_SAMPLE.match(line), f"unparseable sample: {line!r}"
+        samples += 1
+    assert samples > 0
+    return samples
+
+
+def _close_ledgers_with_txs(passphrase: str, n: int = 2):
+    """A standalone LedgerManager closing `n` ledgers of 1 tx each (the
+    simulated ledger close the lint and trace tests observe)."""
+    from stellar_core_tpu.ledger.manager import LedgerManager
+    from stellar_core_tpu.testutils import (TestAccount, create_account_op,
+                                            network_id)
+    m = LedgerManager(network_id(passphrase))
+    m.start_new_ledger()
+    sk = m.root_account_secret()
+    e = m.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    root = TestAccount(m, sk, e.data.value.seqNum)
+    for i in range(n):
+        dest = SecretKey(bytes([0x60 + i]) * 32)
+        m.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(dest.public_key.ed25519), 10**10)])],
+            1000 + i)
+    return m
+
+
+@pytest.fixture()
+def app_http(tmp_path):
+    """A standalone in-process node with a live admin HTTP server on an
+    ephemeral port."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.http_admin import CommandHandler
+    from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+    metrics.reset_registry()
+    cfg = Config.from_dict({
+        "NETWORK_PASSPHRASE": "observability test net",
+        "RUN_STANDALONE": True,
+        "PEER_PORT": 0,
+    })
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application(cfg, clock=clock, listen=False)
+    http = CommandHandler(app, 0)
+    http.start()
+    app.start()
+    assert clock.crank_until(
+        lambda: app.lm.last_closed_ledger_seq >= 3, timeout=60)
+    try:
+        yield app, clock, http.port
+    finally:
+        http.stop()
+        app.stop()
+
+
+def _http_get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read(), r.headers.get("Content-Type", "")
+
+
+def _http_get_cranking(clock, port, path, timeout=10.0):
+    """GET while cranking the clock on this thread — marshalled endpoints
+    (/clearmetrics) block their HTTP thread on the main crank loop."""
+    box = {}
+
+    def go():
+        try:
+            box["resp"] = _http_get(port, path, timeout)
+        except Exception as e:  # surfaced below
+            box["err"] = e
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.time() + timeout
+    while t.is_alive() and time.time() < deadline:
+        clock.crank()
+        time.sleep(0.002)
+    t.join(1.0)
+    assert "err" not in box, box.get("err")
+    assert "resp" in box, "request did not complete"
+    return box["resp"]
+
+
+class TestMetricsEndpoint:
+    def test_json_snapshot_has_percentiles(self, app_http):
+        app, clock, port = app_http
+        body, ctype = _http_get(port, "/metrics")
+        assert ctype.startswith("application/json")
+        doc = json.loads(body)["metrics"]
+        reg = doc["registry"]
+        close = reg["ledger.ledger.close"]
+        assert close["count"] >= 2
+        for k in ("p50_s", "p90_s", "p99_s", "max_s", "mean_s"):
+            assert k in close
+        assert close["p50_s"] <= close["p99_s"] <= close["max_s"] * 1.0001
+        # gauges surface live values
+        assert reg["herder.tx-queue.depth"]["type"] == "gauge"
+
+    def test_prometheus_exposition_parses(self, app_http):
+        app, clock, port = app_http
+        body, ctype = _http_get(port, "/metrics?format=prometheus")
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        _assert_prometheus_parses(text)
+        assert "stellar_core_tpu_ledger_ledger_close_seconds" in text
+        assert 'quantile="0.99"' in text
+        assert "stellar_core_tpu_herder_ledger_externalize_total" in text
+        assert "stellar_core_tpu_herder_tx_queue_depth" in text
+
+    def test_clearmetrics_then_continued_recording(self, app_http):
+        app, clock, port = app_http
+        before = json.loads(_http_get(port, "/metrics")[0])
+        assert before["metrics"]["registry"]["ledger.ledger.close"]["count"] \
+            >= 2
+        body, _ = _http_get_cranking(clock, port, "/clearmetrics")
+        assert json.loads(body).get("status") == "cleared"
+        cleared = json.loads(_http_get(port, "/metrics")[0])
+        assert cleared["metrics"]["registry"]["ledger.ledger.close"]["count"] \
+            == 0
+        # the node keeps recording into the SAME metric objects after the
+        # clear (the old clear() replaced the dict and orphaned every
+        # cached call-site reference — samples vanished silently)
+        seq = app.lm.last_closed_ledger_seq
+        deadline = time.time() + 30
+        while app.lm.last_closed_ledger_seq < seq + 2 \
+                and time.time() < deadline:
+            clock.crank()
+        after = json.loads(_http_get(port, "/metrics")[0])
+        assert after["metrics"]["registry"]["ledger.ledger.close"]["count"] \
+            >= 2
+
+    def test_ll_level_roundtrip(self, app_http):
+        app, clock, port = app_http
+        doc = json.loads(_http_get(port, "/ll")[0])
+        assert "levels" in doc and "Ledger" in doc["levels"]
+        doc = json.loads(
+            _http_get(port, "/ll?level=debug&partition=Ledger")[0])
+        assert doc["status"] == "ok" and doc["level"] == "DEBUG"
+        levels = json.loads(_http_get(port, "/ll")[0])["levels"]
+        assert levels["Ledger"] == "DEBUG"
+        doc = json.loads(_http_get(port, "/ll?level=info&partition=Ledger")[0])
+        assert doc["partition"] == "Ledger"
+        levels = json.loads(_http_get(port, "/ll")[0])["levels"]
+        assert levels["Ledger"] == "INFO"
+        # partition-less set targets the root logger
+        doc = json.loads(_http_get(port, "/ll?level=info")[0])
+        assert doc["partition"] == "all"
+        assert json.loads(_http_get(port, "/ll")[0])["levels"]["(root)"] \
+            == "INFO"
+
+
+class TestTraceEndpoint:
+    @staticmethod
+    def _nesting_depth(events):
+        """Max nesting of "X" complete events by interval containment
+        within each tid (how chrome://tracing stacks them)."""
+        depth = 0
+        by_tid = {}
+        for e in events:
+            by_tid.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+        for spans in by_tid.values():
+            for s0, s1 in spans:
+                d = sum(1 for t0, t1 in spans if t0 <= s0 and s1 <= t1)
+                depth = max(depth, d)
+        return depth
+
+    def test_trace_export_shape_and_nesting(self, app_http):
+        app, clock, port = app_http
+        # a non-empty ledger close traces ledger.close > ledger.tx-apply
+        # > tx.apply; drive one tx through the live node
+        from stellar_core_tpu.testutils import TestAccount, create_account_op
+        sk = app.lm.root_account_secret()
+        e = app.lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+        root = TestAccount(app.lm, sk, e.data.value.seqNum)
+        frame = root.tx([create_account_op(
+            X.AccountID.ed25519(SecretKey(b"\x71" * 32).public_key.ed25519),
+            10**10)])
+        res = app.submit_tx(frame.envelope.to_xdr())
+        assert res["status"] == "PENDING", res
+        seq = app.lm.last_closed_ledger_seq
+        assert clock.crank_until(
+            lambda: app.lm.last_closed_ledger_seq >= seq + 2, timeout=60)
+
+        body, ctype = _http_get(port, "/trace")
+        assert ctype.startswith("application/json")
+        doc = json.loads(body)
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        for ev in events:
+            assert ev["ph"] == "X"
+            for key in ("name", "ts", "dur", "pid", "tid"):
+                assert key in ev
+        names = {e["name"] for e in events}
+        assert {"ledger.close", "ledger.tx-apply", "tx.apply"} <= names
+        assert self._nesting_depth(events) >= 3
+
+    def test_catchup_replay_trace_and_dump(self, tmp_path):
+        """Catchup replay traces catchup.apply-checkpoint above the ledger
+        close tree (>= 3 levels), and dump_trace writes valid Chrome trace
+        JSON (the acceptance-criteria artifact)."""
+        from stellar_core_tpu.catchup.catchup import CatchupManager
+        from stellar_core_tpu.history.archive import FileHistoryArchive
+        from stellar_core_tpu.history.manager import HistoryManager
+        from stellar_core_tpu.ledger.manager import LedgerManager
+        from stellar_core_tpu.simulation.loadgen import LoadGenerator
+        from stellar_core_tpu.testutils import network_id
+
+        passphrase = "obs catchup net"
+        nid = network_id(passphrase)
+        src = LedgerManager(nid)
+        src.start_new_ledger()
+        archive = FileHistoryArchive(str(tmp_path / "arch"))
+        hist = HistoryManager(src, passphrase, [archive])
+        gen = LoadGenerator(src, hist, seed=23)
+        gen.create_accounts(8, per_ledger=8)
+        gen.payment_ledgers(4, txs_per_ledger=2)
+        gen.run_to_checkpoint_boundary()
+
+        tracing.trace_buffer().clear()
+        # native=False keeps the replay on the Python close path — the one
+        # with the span tree (the C engine traces only the checkpoint span)
+        cm = CatchupManager(nid, passphrase, native=False)
+        mgr = cm.catchup_complete(archive)
+        assert mgr.lcl_hash == src.lcl_hash
+
+        roots = tracing.trace_buffer().roots()
+        cp_roots = [r for r in roots if r.name == "catchup.apply-checkpoint"]
+        assert cp_roots
+        assert max(r.depth() for r in cp_roots) >= 3
+
+        path = str(tmp_path / "trace.json")
+        n = tracing.dump_trace(path)
+        doc = json.load(open(path))
+        assert len(doc["traceEvents"]) == n > 0
+        assert self._nesting_depth(doc["traceEvents"]) >= 3
+
+
+class TestMetricNameLint:
+    """Satellite: every metric recorded by a simulated ledger close +
+    node activity matches the naming scheme and is in the documented
+    canonical list (util.metrics.CANONICAL_METRICS / README.md)."""
+
+    def test_canonical_list_is_well_formed(self):
+        for name in metrics.CANONICAL_METRICS:
+            assert metrics.METRIC_NAME_RE.match(name), name
+        for prefix in metrics.CANONICAL_PREFIXES:
+            assert metrics.METRIC_NAME_RE.match(prefix + "x"), prefix
+
+    def test_metric_name_lint(self, app_http):
+        app, clock, port = app_http
+        # add a direct simulated close so ledger/bucket/crypto families
+        # are present even if the node closed only empty ledgers
+        _close_ledgers_with_txs("obs lint net")
+        names = metrics.registry().names()
+        assert names, "registry empty — nothing was instrumented?"
+        undocumented = []
+        for name in names:
+            assert metrics.METRIC_NAME_RE.match(name), \
+                f"metric {name!r} violates layer.subsystem.event naming"
+            if name not in metrics.CANONICAL_METRICS and not any(
+                    name.startswith(p) for p in metrics.CANONICAL_PREFIXES):
+                undocumented.append(name)
+        assert not undocumented, \
+            f"metrics not in the documented canonical list: {undocumented}"
+        # the families the sweep promises are actually present
+        for family in ("ledger.", "scp.", "herder.", "bucket.", "crypto."):
+            assert any(n.startswith(family) for n in names), family
+
+
+class TestMeterAndClearSemantics:
+    """Satellites: Meter.snapshot staleness + clear-in-place."""
+
+    def test_meter_recent_rate_live_before_window_rolls(self):
+        m = metrics.Meter()
+        m.mark(30)
+        snap = m.snapshot()
+        # old behavior: 0.0 until a full 60s window elapsed
+        assert snap["recent_rate"] > 0.0
+        assert snap["count"] == 30
+
+    def test_meter_rate_reflects_overdue_window(self):
+        m = metrics.Meter()
+        m.mark(10)
+        # simulate 120s elapsed with no further marks: the rate must decay
+        # (the old code froze at the last completed window's value)
+        m._win_start -= 120.0
+        assert m.snapshot()["recent_rate"] == pytest.approx(10 / 120.0,
+                                                            rel=0.2)
+
+    def test_clear_resets_in_place(self):
+        reg = metrics.MetricsRegistry()
+        t = reg.timer("ledger.ledger.close")
+        c = reg.counter("overlay.byte.read")
+        t.update(0.5)
+        c.inc(7)
+        reg.clear()
+        assert reg.timer("ledger.ledger.close") is t  # same object
+        assert t.snapshot()["count"] == 0
+        assert c.snapshot()["count"] == 0
+        # call sites holding direct references keep recording
+        t.update(0.25)
+        c.inc(1)
+        assert reg.snapshot()["ledger.ledger.close"]["count"] == 1
+        assert reg.snapshot()["overlay.byte.read"]["count"] == 1
+
+    def test_histogram_percentiles(self):
+        h = metrics.Histogram()
+        for v in range(1, 101):
+            h.update(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert 40 <= snap["p50"] <= 60
+        assert 85 <= snap["p90"] <= 95
+        assert snap["p99"] >= 95
+        assert snap["max"] == 100.0
+
+    def test_gauge_callable_backed(self):
+        reg = metrics.MetricsRegistry()
+        box = {"v": 1}
+        reg.gauge("herder.tx-queue.depth", lambda: box["v"])
+        assert reg.snapshot()["herder.tx-queue.depth"]["value"] == 1
+        box["v"] = 42
+        assert reg.snapshot()["herder.tx-queue.depth"]["value"] == 42
+
+
+class TestScopedTimerThresholds:
+    """Satellite: per-name slow-threshold overrides."""
+
+    def test_override_controls_warning(self, caplog):
+        import logging as pylog
+        from stellar_core_tpu.util import perf
+        perf.set_slow_threshold("obs-hot-scope", 0.0)
+        try:
+            with caplog.at_level(pylog.WARNING, logger="stellar.Perf"):
+                with perf.scoped_timer("obs-hot-scope"):
+                    pass
+            assert any("obs-hot-scope" in r.message for r in caplog.records)
+            caplog.clear()
+            perf.set_slow_threshold("obs-hot-scope", 1e9)
+            with caplog.at_level(pylog.WARNING, logger="stellar.Perf"):
+                with perf.scoped_timer("obs-hot-scope"):
+                    pass
+            assert not any("obs-hot-scope" in r.message
+                           for r in caplog.records)
+        finally:
+            perf.set_slow_threshold("obs-hot-scope", None)
