@@ -1,10 +1,17 @@
-.PHONY: native test metrics clean
+.PHONY: native test metrics bucketdb clean
 
 native:
 	python setup.py build_ext --inplace
 
 test:
 	python -m pytest tests/ -q
+
+# BucketListDB differential suite: on-disk index round-trip + corruption
+# fail-stop, snapshot consistency across closes, LRU bound, and the
+# dict-vs-disk multi-checkpoint replay hash identity
+bucketdb:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_bucketlistdb.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must
 # match layer.subsystem.event and appear in the documented canonical list

@@ -82,7 +82,7 @@ def _degraded_report(detail: str) -> dict:
         value = sig["values"].get("ed25519_tpu_sigs_per_sec", 0.0)
         base = sig["values"].get("ed25519_libsodium_1core_sigs_per_sec", 0.0)
         vs = round(value / base, 2) if base else 0.0
-    for section in ("sigs", "replay", "quorum"):
+    for section in ("sigs", "replay", "quorum", "bucketlistdb"):
         got = cache.get(section)
         if not got:
             continue
@@ -183,6 +183,62 @@ def build_archive(nid, passphrase, path, n_payment_ledgers=110,
             history.published_checkpoints[-1] != mgr.last_closed_ledger_seq:
         close([])
     return archive, mgr
+
+
+def bench_bucketlistdb():
+    """ISSUE 2 acceptance: the bench line reports the BucketListDB entry-
+    cache hit rate and load-latency percentiles.  CPU-only (no device):
+    one small archive replayed both ways — in-memory dict root vs
+    disk-backed BucketListDB root — with hash identity ASSERTED and the
+    relative replay rate recorded."""
+    from stellar_core_tpu.bucket import BucketListStore
+    from stellar_core_tpu.catchup.catchup import CatchupManager
+    from stellar_core_tpu.crypto import keys
+    from stellar_core_tpu.testutils import network_id
+    from stellar_core_tpu.util.metrics import registry, reset_registry
+
+    passphrase = "bucketlistdb bench"
+    nid = network_id(passphrase)
+    with tempfile.TemporaryDirectory() as d:
+        archive, mgr = build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=int(os.environ.get(
+                "BENCH_BLDB_LEDGERS", "120")), txs_per_ledger=20)
+        n = mgr.last_closed_ledger_seq
+        keys.clear_verify_cache()
+        t0 = time.perf_counter()
+        m_mem = CatchupManager(nid, passphrase,
+                               native=False).catchup_complete(archive)
+        mem_s = time.perf_counter() - t0
+        # isolate the bucketlistdb.* metric slice to the disk replay
+        reset_registry()
+        keys.clear_verify_cache()
+        store = BucketListStore(os.path.join(d, "bucketlistdb"))
+        cm = CatchupManager(nid, passphrase, native=False,
+                            bucket_store=store, entry_cache_size=4096)
+        t0 = time.perf_counter()
+        m_disk = cm.catchup_complete(archive)
+        disk_s = time.perf_counter() - t0
+        assert m_disk.lcl_hash == m_mem.lcl_hash == mgr.lcl_hash, \
+            "bucketlistdb replay diverged from the in-memory path"
+        stats = m_disk.root.cache_stats()
+        out = {
+            "bucketlistdb_replay_ledgers": n,
+            "bucketlistdb_cache_hit_rate": stats.get("hit_rate", 0.0),
+            "bucketlistdb_cache_entries": stats.get("size", 0),
+            "bucketlistdb_cache_max": stats.get("max_size", 0),
+            "bucketlistdb_ledgers_per_sec": round(n / disk_s, 1),
+            "bucketlistdb_vs_in_memory": round(mem_s / disk_s, 3),
+            "bucketlistdb_hashes_identical": True,
+        }
+        load = registry().snapshot(prefix="bucketlistdb.").get(
+            "bucketlistdb.load")
+        if load:
+            out["bucketlistdb_loads"] = load["count"]
+            for q in ("p50", "p90", "p99"):
+                out[f"bucketlistdb_load_{q}_us"] = round(
+                    load[f"{q}_s"] * 1e6, 1)
+    return out
 
 
 def bench_sigs():
@@ -502,6 +558,12 @@ def main():
     passphrase = "bench network"
     nid = network_id(passphrase)
 
+    # BucketListDB differential runs on CPU — measure it before touching
+    # the (occasionally wedged) device so the numbers exist either way
+    _stage("bucketlistdb bench (CPU-only)...")
+    bldb = bench_bucketlistdb()
+    _cache_put("bucketlistdb", bldb)
+
     _stage("probing device health...")
     # the tunnel has come back mid-window after outages before: retry the
     # probe a couple of times across the bench window before giving up
@@ -584,6 +646,7 @@ def main():
             "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
             "ed25519_speedup_1chip_vs_1core":
                 round(tpu_sig_rate / cpu_sig_rate, 2),
+            **bldb,
             **matrix,
             "replay_phases": phases,
             "metrics": obs,

@@ -20,11 +20,22 @@
  * to fall back to the Python engine for that checkpoint, after an
  * export_state()/import_state() round-trip.
  *
- * Supported tx surface (probe-gated): v0/v1 envelopes (no fee bumps),
- * preconditions NONE/TIME/V2, any memo, ops CREATE_ACCOUNT,
- * PAYMENT (native asset), SET_OPTIONS; ed25519/preauth/hashX signers;
- * sponsorship DATA already in state is preserved and released correctly,
- * but the sponsorship ops themselves fall back to Python.
+ * Supported tx surface (probe-gated): v0/v1 envelopes AND fee-bump
+ * envelopes (outer LOW-threshold auth, inner result embedded verbatim);
+ * preconditions NONE/TIME/V2, any memo; ed25519/preauth/hashX signers.
+ * 17 op types apply natively: CREATE_ACCOUNT, PAYMENT (native + credit),
+ * MANAGE_SELL_OFFER, MANAGE_BUY_OFFER, CREATE_PASSIVE_SELL_OFFER,
+ * SET_OPTIONS, CHANGE_TRUST (classic assets), ALLOW_TRUST, ACCOUNT_MERGE,
+ * INFLATION, MANAGE_DATA, BUMP_SEQUENCE, CREATE_CLAIMABLE_BALANCE,
+ * CLAIM_CLAIMABLE_BALANCE, CLAWBACK, CLAWBACK_CLAIMABLE_BALANCE,
+ * SET_TRUST_LINE_FLAGS.
+ *
+ * Fallback set (probe answers "unsupported"; the caller replays that
+ * checkpoint in Python): PATH_PAYMENT_STRICT_RECEIVE/SEND, the
+ * sponsorship trio (BEGIN/END_SPONSORING_FUTURE_RESERVES,
+ * REVOKE_SPONSORSHIP), LIQUIDITY_POOL_DEPOSIT/WITHDRAW, pool-share
+ * CHANGE_TRUST lines, Soroban ops, and generalized tx sets.  Sponsorship
+ * DATA already in state is preserved and released correctly either way.
  */
 
 #define PY_SSIZE_T_CLEAN

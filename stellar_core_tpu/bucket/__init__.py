@@ -3,13 +3,14 @@ from .bucket_list import (NUM_LEVELS, BucketLevel, BucketList,
                           keep_tombstone_entries, level_half,
                           level_should_spill, level_size)
 from .future import FutureBucket
-from .index import BucketIndex
-from .manager import BucketDir
+from .index import BucketIndex, DiskBucketIndex
+from .manager import BucketDir, BucketListStore
 from .snapshot import SearchableBucketListSnapshot
 
 __all__ = [
     "Bucket", "BucketDir", "BucketIndex", "BucketLevel", "BucketList",
-    "FutureBucket", "NUM_LEVELS", "SearchableBucketListSnapshot",
+    "BucketListStore", "DiskBucketIndex", "FutureBucket", "NUM_LEVELS",
+    "SearchableBucketListSnapshot",
     "entry_sort_key", "keep_tombstone_entries", "level_half",
     "level_should_spill", "level_size", "merge_buckets",
 ]

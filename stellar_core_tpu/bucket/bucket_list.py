@@ -154,11 +154,13 @@ class BucketList:
             out.append(lvl.snap)
         return out
 
-    def snapshot(self, ledger_seq: int = 0):
+    def snapshot(self, ledger_seq: int = 0, store=None):
         """Immutable point-in-time view (reference:
-        SearchableBucketListSnapshot via BucketSnapshotManager)."""
+        SearchableBucketListSnapshot via BucketSnapshotManager).  With a
+        ``BucketListStore``, the view reads through on-disk bucket indexes
+        and pins its files against GC (BucketListDB mode)."""
         from .snapshot import SearchableBucketListSnapshot
-        return SearchableBucketListSnapshot(self, ledger_seq)
+        return SearchableBucketListSnapshot(self, ledger_seq, store=store)
 
     def lookup_latest(self, key_bytes: bytes) -> Optional[LedgerEntry]:
         """Newest version of a key across the list, or None if the newest

@@ -5,14 +5,21 @@ getBucketByHash over `buckets/bucket-<hex>.xdr`, plus forgetUnreferenced
 garbage collection.  Files are immutable once written (content-addressed by
 SHA-256 of the serialized stream), written atomically via tmp+rename, and
 verified against their name hash on load.
+
+``BucketListStore`` layers the BucketListDB authority on top: every saved
+file carries a ``DiskBucketIndex`` so point lookups seek into the file,
+and live snapshots PIN the files they reference so GC never deletes a
+bucket out from under an open read view (reference: BucketManager's
+shared-ptr liveness feeding forgetUnreferencedBuckets).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Iterable, Optional, Set
+from typing import Dict, Iterable, Optional, Set
 
 from .bucket import Bucket
+from .index import DiskBucketIndex
 
 _EMPTY_HEX = "0" * 64
 
@@ -71,6 +78,7 @@ class BucketDir:
         """Delete bucket files not in `referenced` (reference:
         BucketManager::forgetUnreferencedBuckets).  Returns removed count."""
         keep: Set[str] = set(referenced)
+        keep.update(self._protected_hashes())
         removed = 0
         for name in os.listdir(self.path):
             if not (name.startswith("bucket-") and name.endswith(".xdr")):
@@ -78,5 +86,91 @@ class BucketDir:
             hh = name[len("bucket-"):-len(".xdr")]
             if hh not in keep:
                 os.unlink(os.path.join(self.path, name))
+                self._on_removed(hh)
                 removed += 1
         return removed
+
+    def _protected_hashes(self) -> Set[str]:
+        """Hashes GC must keep beyond the referenced set (BucketListStore
+        adds snapshot pins)."""
+        return set()
+
+    def _on_removed(self, hex_hash: str) -> None:
+        pass
+
+
+class BucketListStore(BucketDir):
+    """BucketDir + per-file ``DiskBucketIndex`` cache + snapshot pinning —
+    the storage half of BucketListDB (reference: BucketManager +
+    BucketIndexImpl since v21, where the indexed bucket files ARE the
+    ledger-entry database and SQL holds no entry tables).
+
+    Indexes are built once per content hash: at save time from the
+    in-memory bucket (free — reuses its cached sort keys / packed records)
+    or, for files adopted from a previous run, by a hash-verified scan.
+    Pins are refcounts held by open ``SearchableBucketListSnapshot``s; GC
+    keeps ``referenced ∪ pinned`` so an old snapshot keeps serving a
+    consistent view while the live list moves on.
+    """
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._indexes: Dict[str, DiskBucketIndex] = {}
+        self._pins: Dict[str, int] = {}
+
+    # -- save + index --------------------------------------------------------
+    def ensure(self, bucket: Bucket) -> Optional[DiskBucketIndex]:
+        """Persist `bucket` and return its index; None for the empty
+        bucket (nothing to look up).  A file this process just wrote is
+        indexed for free from the in-memory bucket; a PRE-EXISTING file
+        (restart — content addressing trusts names, but the bytes about
+        to serve authoritative reads must prove themselves) is adopted
+        through the hash-verified scan, so on-disk corruption fail-stops
+        here instead of surfacing as wrong ledger state."""
+        if bucket.is_empty():
+            return None
+        hh = bucket.hash().hex()
+        idx = self._indexes.get(hh)
+        if idx is not None:
+            return idx
+        if os.path.exists(self._file_for(hh)):
+            return self.index_for(hh)
+        self.save(bucket)
+        idx = DiskBucketIndex.from_bucket(bucket, self._file_for(hh))
+        self._indexes[hh] = idx
+        return idx
+
+    def index_for(self, hex_hash: str) -> Optional[DiskBucketIndex]:
+        """Index of an already-on-disk bucket (restart/assume-state path);
+        builds via a hash-verified file scan on first use.  None for the
+        empty hash; missing files raise (the caller named a bucket the
+        store must have)."""
+        if hex_hash == _EMPTY_HEX:
+            return None
+        idx = self._indexes.get(hex_hash)
+        if idx is None:
+            target = self._file_for(hex_hash)
+            if not os.path.exists(target):
+                raise RuntimeError(f"missing bucket file for {hex_hash}")
+            idx = DiskBucketIndex.build(target, expected_hex_hash=hex_hash)
+            self._indexes[hex_hash] = idx
+        return idx
+
+    # -- snapshot pinning ----------------------------------------------------
+    def pin(self, hex_hashes: Iterable[str]) -> None:
+        for hh in hex_hashes:
+            self._pins[hh] = self._pins.get(hh, 0) + 1
+
+    def unpin(self, hex_hashes: Iterable[str]) -> None:
+        for hh in hex_hashes:
+            n = self._pins.get(hh, 0) - 1
+            if n <= 0:
+                self._pins.pop(hh, None)
+            else:
+                self._pins[hh] = n
+
+    def _protected_hashes(self) -> Set[str]:
+        return set(self._pins)
+
+    def _on_removed(self, hex_hash: str) -> None:
+        self._indexes.pop(hex_hash, None)

@@ -574,7 +574,9 @@ class CatchupManager:
                  accel: bool = False, accel_chunk: int = 2048,
                  invariant_manager=None,
                  accel_hot_threshold: int = 1 << 62,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None,
+                 bucket_store=None,
+                 entry_cache_size: Optional[int] = None):
         """invariant_manager: None (default — the bench/hot replay path;
         the hash chain is the corruption *detector*) or an
         InvariantManager to also *localize* faults during replay and
@@ -584,17 +586,38 @@ class CatchupManager:
         engine (native/capply.c).  Default (None) = auto: on when the
         extension is built, no invariants are requested (the invariant
         hooks live on the Python close path), and STELLAR_TPU_NO_CAPPLY
-        is unset.  The Python engine remains the oracle and the fallback
-        for unsupported tx shapes."""
+        is unset.  An EXPLICIT native=True that cannot be honored logs a
+        prominent warning instead of silently degrading.  The Python
+        engine remains the oracle and the fallback for unsupported tx
+        shapes.
+
+        bucket_store: a bucket.manager.BucketListStore → every
+        LedgerManager this catchup builds runs in BucketListDB mode
+        (`in_memory_ledger = false`): assumed/replayed state lives in
+        indexed on-disk bucket files, reads go through the bounded
+        `entry_cache_size` LRU."""
         self.network_id = network_id
         self.network_passphrase = network_passphrase
         self.accel = accel
         self.accel_chunk = accel_chunk
         self.accel_hot_threshold = accel_hot_threshold
         self.invariant_manager = invariant_manager
+        self.bucket_store = bucket_store
+        self.entry_cache_size = entry_cache_size
         from ..ledger.native_apply import native_apply_available
         self.native = (native if native is not None else True) \
             and native_apply_available() and invariant_manager is None
+        if native is True and not self.native:
+            # an explicit request that cannot be honored must be LOUD —
+            # the Python path is ~an order of magnitude slower (ADVICE r5)
+            reason = ("an invariant_manager forces the Python apply path"
+                      if native_apply_available() else
+                      "the _capply extension is not built "
+                      "(or STELLAR_TPU_NO_CAPPLY is set)")
+            log.warning(
+                "native apply engine EXPLICITLY requested but unavailable "
+                "(%s) — falling back to the ~10x slower Python engine",
+                reason)
         # offload hit-rate accounting (VERDICT r1 weak #4)
         self.stats = {"sigs_total": 0, "sigs_shipped": 0}
 
@@ -636,7 +659,9 @@ class CatchupManager:
         target = to_ledger if to_ledger is not None else has.current_ledger
 
         mgr = LedgerManager(self.network_id,
-                            invariant_manager=self.invariant_manager)
+                            invariant_manager=self.invariant_manager,
+                            bucket_store=self.bucket_store,
+                            entry_cache_size=self.entry_cache_size)
         mgr.start_new_ledger()
         self._run_catchup_work(mgr, archive, target, clock, lookahead)
         return mgr
@@ -748,7 +773,9 @@ class CatchupManager:
             raise CatchupError("checkpoint tail mismatch")
 
         mgr = LedgerManager(self.network_id,
-                            invariant_manager=self.invariant_manager)
+                            invariant_manager=self.invariant_manager,
+                            bucket_store=self.bucket_store,
+                            entry_cache_size=self.entry_cache_size)
         mgr.start_new_ledger()  # scaffolding; replaced below
 
         hashes = has.bucket_hashes()
@@ -776,12 +803,17 @@ class CatchupManager:
                 raise CatchupError(str(e)) from e
 
         from ..ledger.manager import assume_bucket_state
+        scaffold_root = mgr.root
         try:
             mgr.root = assume_bucket_state(
                 mgr.bucket_list, tail.header, source, next_source,
-                invariant_manager=self.invariant_manager)
+                invariant_manager=self.invariant_manager,
+                store=self.bucket_store,
+                entry_cache_size=mgr.entry_cache_size)
         except RuntimeError as e:
             raise CatchupError(str(e)) from e
+        if scaffold_root is not None and scaffold_root.disk_backed:
+            scaffold_root.release_snapshot()  # genesis scaffolding pins
         mgr.lcl_header = tail.header
         mgr.lcl_hash = tail.hash
         log.info("assumed state at ledger %d (%d entries)",

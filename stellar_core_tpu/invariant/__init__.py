@@ -2,15 +2,15 @@
 
 from .invariants import (ALL_INVARIANTS, AccountSubEntriesCountIsValid,
                          BucketListIsConsistentWithDatabase,
-                         ConservationOfLumens, Invariant,
-                         InvariantDoesNotHold, InvariantManager,
+                         ConservationOfLumens, ConstantProductInvariant,
+                         Invariant, InvariantDoesNotHold, InvariantManager,
                          LedgerCloseContext, LedgerEntryIsValid,
                          LiabilitiesMatchOffers, SponsorshipCountIsValid)
 
 __all__ = [
     "ALL_INVARIANTS", "AccountSubEntriesCountIsValid",
     "BucketListIsConsistentWithDatabase", "ConservationOfLumens",
-    "Invariant", "InvariantDoesNotHold", "InvariantManager",
-    "LedgerCloseContext", "LedgerEntryIsValid", "LiabilitiesMatchOffers",
-    "SponsorshipCountIsValid",
+    "ConstantProductInvariant", "Invariant", "InvariantDoesNotHold",
+    "InvariantManager", "LedgerCloseContext", "LedgerEntryIsValid",
+    "LiabilitiesMatchOffers", "SponsorshipCountIsValid",
 ]

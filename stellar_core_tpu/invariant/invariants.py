@@ -431,9 +431,86 @@ class SponsorshipCountIsValid(Invariant):
         return None
 
 
+class ConstantProductInvariant(Invariant):
+    """Liquidity-pool reserve/share safety (reference:
+    src/invariant/ConstantProductInvariant.cpp), guarding pool deposits,
+    withdrawals and path payments routed through pools:
+
+    * swaps (total shares unchanged) must not shrink the constant product
+      reserveA*reserveB — the 30bp fee makes it grow;
+    * deposits (shares up) must not take from either reserve, and must not
+      dilute existing holders (minted shares are floored, so the
+      per-share value of each reserve never decreases);
+    * withdrawals (shares down) must not add to a reserve, and the
+      per-share value of each reserve must not decrease (the floor in
+      amount = reserve*shares/totalShares favors the pool);
+    * a pool leaves the ledger only once empty (no shares, no reserves).
+    """
+    NAME = "ConstantProductInvariant"
+
+    @staticmethod
+    def _cp(entry: Optional[X.LedgerEntry]):
+        if entry is None:
+            return None
+        return entry.data.value.body.value   # LiquidityPoolEntryConstantProduct
+
+    def check_on_ledger_close(self, ctx: LedgerCloseContext) -> Optional[str]:
+        tag = int(X.LedgerEntryType.LIQUIDITY_POOL).to_bytes(4, "big")
+        for kb in set(ctx.pre) | set(ctx.post):
+            if not kb.startswith(tag):
+                continue
+            pre = self._cp(ctx.pre.get(kb))
+            post = self._cp(ctx.post.get(kb))
+            pid = kb.hex()[8:24]
+            if post is not None and (
+                    post.reserveA < 0 or post.reserveB < 0
+                    or post.totalPoolShares < 0
+                    or post.poolSharesTrustLineCount < 0):
+                return f"pool {pid}: negative reserve/share/trustline count"
+            if pre is None or post is None:
+                if post is None and pre is not None and (
+                        pre.totalPoolShares != 0 or pre.reserveA != 0
+                        or pre.reserveB != 0):
+                    return (f"pool {pid} deleted while holding "
+                            f"{pre.totalPoolShares} shares / "
+                            f"({pre.reserveA},{pre.reserveB}) reserves")
+                continue
+            ds = post.totalPoolShares - pre.totalPoolShares
+            da = post.reserveA - pre.reserveA
+            db = post.reserveB - pre.reserveB
+            if ds == 0:
+                if post.reserveA * post.reserveB \
+                        < pre.reserveA * pre.reserveB:
+                    return (f"pool {pid}: constant product shrank on swap "
+                            f"({pre.reserveA}*{pre.reserveB} -> "
+                            f"{post.reserveA}*{post.reserveB})")
+            elif ds > 0:
+                if da < 0 or db < 0:
+                    return (f"pool {pid}: deposit drained a reserve "
+                            f"(ΔA={da}, ΔB={db})")
+                if post.reserveA * pre.totalPoolShares \
+                        < pre.reserveA * post.totalPoolShares \
+                        or post.reserveB * pre.totalPoolShares \
+                        < pre.reserveB * post.totalPoolShares:
+                    return (f"pool {pid}: deposit minted shares worth more "
+                            f"than the contributed reserves (dilution)")
+            else:
+                if da > 0 or db > 0:
+                    return (f"pool {pid}: withdrawal grew a reserve "
+                            f"(ΔA={da}, ΔB={db})")
+                if post.reserveA * pre.totalPoolShares \
+                        < pre.reserveA * post.totalPoolShares \
+                        or post.reserveB * pre.totalPoolShares \
+                        < pre.reserveB * post.totalPoolShares:
+                    return (f"pool {pid}: withdrawal paid out more than "
+                            f"the burned shares' value")
+        return None
+
+
 ALL_INVARIANTS = (LedgerEntryIsValid, AccountSubEntriesCountIsValid,
                   ConservationOfLumens, LiabilitiesMatchOffers,
-                  SponsorshipCountIsValid, BucketListIsConsistentWithDatabase)
+                  SponsorshipCountIsValid, ConstantProductInvariant,
+                  BucketListIsConsistentWithDatabase)
 
 
 class InvariantManager:

@@ -6,17 +6,27 @@ parent state through a copy-on-write delta; commit folds the delta into the
 parent, rollback discards it; at most one active child; header mutations are
 transactional alongside entries.
 
-Deliberate divergence (TPU-first simplification, round 1): the root's
-authoritative store is an in-memory dict keyed by LedgerKey XDR bytes, with
-the BucketList maintained separately by the LedgerManager for hashing; the
-reference backs the root with BucketListDB disk indexes + SQL.  Disk-backed
-root is tracked as a capability gap in SURVEY §2 terms, not a semantics gap.
+Root storage (SURVEY §2.1 row 9): since v21 the reference's bucket list IS
+the ledger-entry database (BucketListDB — LedgerTxnRoot reads through
+SearchableBucketListSnapshot over indexed bucket files, with a bounded
+entry cache).  This root mirrors the read architecture: in BucketListDB
+mode (constructed with a snapshot) every read goes through the snapshot's
+on-disk indexes and a bounded LRU entry cache, so the ROOT holds at most
+`entry_cache_size` decoded entries instead of one per live key.  (The
+BucketList levels themselves still keep decoded entries resident for the
+merge/hash pipeline; spilling those to the indexed files and rehydrating
+on merge is the next step — see ROADMAP.)  The legacy in-memory dict
+remains behind the `in_memory_ledger` config flag (the default for
+tests/sims — reference analog: the deprecated in-memory SQL ledger
+state).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
+from ..util.cache import LRUCache
+from ..util.metrics import registry as _registry
 from ..xdr import (LedgerEntry, LedgerHeader, LedgerKey, deep_copy_value,
                    ledger_entry_key, ledger_entry_key_xdr)
 
@@ -43,16 +53,60 @@ class AbstractLedgerTxnParent:
 
 
 class LedgerTxnRoot(AbstractLedgerTxnParent):
-    """Authoritative live-entry store + last closed header."""
+    """Authoritative live-entry store + last closed header.
 
-    def __init__(self, header: LedgerHeader):
-        self._entries: Dict[bytes, LedgerEntry] = {}
+    Default (in-memory) mode keeps every live entry in a dict.  In
+    BucketListDB mode (``snapshot`` given) the dict is RETIRED: reads go
+    through the snapshot's indexed on-disk bucket files, with a bounded
+    LRU entry cache in front (negative results — "definitively absent" —
+    are cached too, sparing repeated 22-bucket probe chains).  The
+    LedgerManager swaps in a fresh snapshot after every bucket-list
+    mutation (ledger close seal, catchup assume-state, native-engine
+    export); committed deltas land in the cache, so between the snapshot
+    refresh and the next one the cache carries exactly the keys the
+    snapshot does not yet serve.
+    """
+
+    _MISS = object()   # cache sentinel: distinguishes a cached None
+
+    def __init__(self, header: LedgerHeader, snapshot=None,
+                 entry_cache_size: int = 4096):
         self._header = header
         self._child: Optional[LedgerTxn] = None
+        self._snapshot = snapshot
+        if snapshot is None:
+            self._entries: Optional[Dict[bytes, LedgerEntry]] = {}
+            self._cache: Optional[LRUCache] = None
+        else:
+            self._entries = None
+            self._cache = LRUCache(entry_cache_size)
+            self._bind_metrics()
+
+    def _bind_metrics(self) -> None:
+        # re-resolved on every snapshot swap: the registry can be replaced
+        # wholesale (tests' reset_registry) and cached handles would feed
+        # a dead object for the rest of the manager's life
+        reg = _registry()
+        self._cache_hit = reg.meter("bucketlistdb.cache.hit")
+        self._cache_miss = reg.meter("bucketlistdb.cache.miss")
+        self._prefetch_timer = reg.timer("bucketlistdb.prefetch")
+
+    @property
+    def disk_backed(self) -> bool:
+        return self._snapshot is not None
 
     # -- parent protocol ----------------------------------------------------
     def get_entry(self, key_bytes: bytes) -> Optional[LedgerEntry]:
-        return self._entries.get(key_bytes)
+        if self._snapshot is None:
+            return self._entries.get(key_bytes)
+        v = self._cache.get(key_bytes, self._MISS)
+        if v is not self._MISS:
+            self._cache_hit.mark()
+            return v
+        self._cache_miss.mark()
+        v = self._snapshot.load(key_bytes)
+        self._cache.put(key_bytes, v)
+        return v
 
     def get_header(self) -> LedgerHeader:
         return self._header
@@ -66,24 +120,89 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         self._child = None
 
     def all_keys(self) -> Iterator[bytes]:
-        return iter(list(self._entries.keys()))
+        if self._snapshot is None:
+            return iter(list(self._entries.keys()))
+        return self._snapshot.iter_live_keys()
 
     # -- root-only ----------------------------------------------------------
     def _apply_delta(self, entries: Dict[bytes, Optional[LedgerEntry]],
                      header: Optional[LedgerHeader]) -> None:
-        for k, e in entries.items():
-            if e is None:
-                self._entries.pop(k, None)
-            else:
-                self._entries[k] = e
+        if self._snapshot is None:
+            for k, e in entries.items():
+                if e is None:
+                    self._entries.pop(k, None)
+                else:
+                    self._entries[k] = e
+        else:
+            # deletions cache as None (negative entries); the bucket list
+            # is the authority and the manager has already fed it this
+            # delta by the time the commit lands here
+            for k, e in entries.items():
+                self._cache.put(k, e)
         if header is not None:
             self._header = header
 
     def set_header(self, header: LedgerHeader) -> None:
         self._header = header
 
+    def set_snapshot(self, snapshot):
+        """Swap in a fresh read view after a bucket-list mutation; returns
+        the previous snapshot (caller releases its pins).  The entry cache
+        survives: committed deltas were applied to it, everything else is
+        unchanged between consecutive views."""
+        old = self._snapshot
+        self._snapshot = snapshot
+        self._bind_metrics()
+        return old
+
+    def release_snapshot(self) -> None:
+        """Drop this root's read view + its file pins (the root is being
+        replaced wholesale — genesis scaffolding, native-engine export)."""
+        if self._snapshot is not None:
+            self._snapshot.release()
+            self._snapshot = None
+
+    def prefetch(self, keys: Iterable[bytes]) -> int:
+        """Bulk-load `keys` into the entry cache via one batched snapshot
+        pass (reference: LedgerTxnRoot::prefetchClassic before tx-set
+        apply).  Absent keys cache as definitive misses.  Returns the
+        number of keys actually probed."""
+        if self._snapshot is None:
+            return 0
+        cache = self._cache
+        missing = [kb for kb in keys if kb not in cache]
+        if not missing:
+            return 0
+        import time as _time
+        t0 = _time.perf_counter()
+        found = self._snapshot.load_keys(missing)
+        for kb in missing:
+            cache.put(kb, found.get(kb))
+        self._prefetch_timer.update(_time.perf_counter() - t0)
+        return len(missing)
+
+    def cache_stats(self) -> Dict[str, float]:
+        """Entry-cache occupancy + hit rate (bench exposure)."""
+        if self._cache is None:
+            return {}
+        return {"size": len(self._cache), "max_size": self._cache.max_size,
+                "hits": self._cache.hits, "misses": self._cache.misses,
+                "hit_rate": round(self._cache.hit_rate(), 4)}
+
     def entry_count(self) -> int:
-        return len(self._entries)
+        if self._snapshot is None:
+            return len(self._entries)
+        return self._snapshot.live_entry_count()
+
+    def export_raw_entries(self) -> List[Tuple[bytes, bytes]]:
+        """(LedgerKey XDR, LedgerEntry XDR) for every live entry — the
+        native-engine import seam.  Only valid on a SETTLED root (no close
+        in flight): in disk mode the snapshot must already reflect every
+        committed delta.  Disk mode streams raw records (no entry
+        decode)."""
+        if self._snapshot is None:
+            return [(kb, e.to_xdr()) for kb, e in self._entries.items()]
+        return list(self._snapshot.iter_live_raw())
 
 
 class LedgerTxn(AbstractLedgerTxnParent):

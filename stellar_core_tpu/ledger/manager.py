@@ -51,15 +51,22 @@ class ClosedLedgerArtifacts:
     result_entry: X.TransactionHistoryResultEntry
 
 
+DEFAULT_ENTRY_CACHE_SIZE = 4096   # mirrored by config.BUCKETLISTDB_ENTRY_CACHE_SIZE
+
+
 def assume_bucket_state(bucket_list, header: X.LedgerHeader,
                         bucket_source, next_source=None,
-                        invariant_manager=None) -> LedgerTxnRoot:
+                        invariant_manager=None, store=None,
+                        entry_cache_size: int = DEFAULT_ENTRY_CACHE_SIZE
+                        ) -> LedgerTxnRoot:
     """Fill `bucket_list`'s levels from `bucket_source(hex_hash) -> Bucket`
-    and derive the authoritative entry store newest-first (first record per
-    key wins; DEADENTRY shadows older versions).  Verifies the reassembled
-    list against header.bucketListHash.  Shared by restart
-    (loadLastKnownLedger) and catchup state assumption (ApplyBucketsWork +
-    BucketApplicator).
+    and build the authoritative root.  In-memory mode derives the entry
+    dict newest-first (first record per key wins; DEADENTRY shadows older
+    versions); BucketListDB mode (`store` given) persists + indexes the
+    buckets instead — the files ARE the store, no dict is materialized.
+    Verifies the reassembled list against header.bucketListHash.  Shared
+    by restart (loadLastKnownLedger) and catchup state assumption
+    (ApplyBucketsWork + BucketApplicator).
 
     next_source(level) -> Optional[FutureBucket]: the level's pending merge
     (HAS "next", reference: FutureBucket::makeLive, usually built via
@@ -70,7 +77,7 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
     from ..bucket.bucket_list import NUM_LEVELS
 
     seen: set = set()
-    root = LedgerTxnRoot(header)
+    root = None if store is not None else LedgerTxnRoot(header)
     for i in range(NUM_LEVELS):
         for j, attr in ((0, "curr"), (1, "snap")):
             bucket = bucket_source(i * 2 + j)
@@ -83,6 +90,8 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
                 invariant_manager.check_on_bucket_apply(
                     bucket, i, header.ledgerSeq)
             setattr(bucket_list.levels[i], attr, bucket)
+            if root is None:
+                continue
             for be in bucket.entries:
                 if be.switch == X.BucketEntryType.DEADENTRY:
                     seen.add(be.value.to_xdr())
@@ -95,6 +104,10 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
             bucket_list.levels[i].next = next_source(i)
     if bucket_list.hash() != header.bucketListHash:
         raise RuntimeError("assumed bucket list hash != header hash")
+    if root is None:
+        snap = bucket_list.snapshot(header.ledgerSeq, store=store)
+        root = LedgerTxnRoot(header, snapshot=snap,
+                             entry_cache_size=entry_cache_size)
     return root
 
 
@@ -102,18 +115,31 @@ _DEFAULT_INVARIANTS = object()
 
 
 class LedgerManager:
+    # disk-mode GC cadence: every N closes, unreferenced+unpinned bucket
+    # files are deleted (content-addressed level-0 files otherwise pile up
+    # one per ledger)
+    BUCKET_GC_INTERVAL = 8
+
     def __init__(self, network_id: bytes,
                  invariant_manager=_DEFAULT_INVARIANTS,
-                 merge_executor=None):
+                 merge_executor=None, bucket_store=None,
+                 entry_cache_size: Optional[int] = None):
         """invariant_manager: an InvariantManager, None to disable, or
         default = all invariants enabled (reference ships them off by
         default; this framework inverts that — fail-stop by default, opt
         out on the hot replay path).
 
         merge_executor: thread pool for background bucket merges
-        (reference: WORKER_THREADS-driven FutureBucket merges)."""
+        (reference: WORKER_THREADS-driven FutureBucket merges).
+
+        bucket_store: a bucket.manager.BucketListStore → BucketListDB mode
+        (`in_memory_ledger = false`): the root reads through indexed
+        on-disk bucket files with an LRU entry cache of
+        `entry_cache_size` entries; None → legacy in-memory dict root."""
         self.network_id = network_id
         self.bucket_list = BucketList(executor=merge_executor)
+        self.bucket_store = bucket_store
+        self.entry_cache_size = entry_cache_size or DEFAULT_ENTRY_CACHE_SIZE
         self.root: Optional[LedgerTxnRoot] = None
         self.lcl_header: Optional[X.LedgerHeader] = None
         self.lcl_hash: Optional[bytes] = None
@@ -154,10 +180,15 @@ class LedgerManager:
             baseFee=GENESIS_BASE_FEE, baseReserve=GENESIS_BASE_RESERVE,
             maxTxSetSize=GENESIS_MAX_TX_SET_SIZE,
             skipList=[b"\x00" * 32] * 4)
-        self.root = LedgerTxnRoot(header)
-        with LedgerTxn(self.root) as ltx:
-            ltx.create(root_entry)
-            ltx.commit()
+        if self.bucket_store is not None:
+            # BucketListDB: the bucket list (just fed the root account)
+            # IS the store — no dict to seed
+            self.root = self._make_disk_root(header)
+        else:
+            self.root = LedgerTxnRoot(header)
+            with LedgerTxn(self.root) as ltx:
+                ltx.create(root_entry)
+                ltx.commit()
         self.lcl_header = header
         self.lcl_hash = sha256(header.to_xdr())
         log.info("genesis ledger 1 closed, root=%s",
@@ -165,6 +196,47 @@ class LedgerManager:
 
     def root_account_secret(self) -> SecretKey:
         return SecretKey(self.network_id)
+
+    # -- BucketListDB snapshot management -----------------------------------
+    def _make_disk_root(self, header: X.LedgerHeader) -> LedgerTxnRoot:
+        """Fresh disk-backed root over the CURRENT bucket list (genesis /
+        native-engine export / rebuilds).  Replaces any previous root's
+        snapshot pins."""
+        snap = self.bucket_list.snapshot(header.ledgerSeq,
+                                         store=self.bucket_store)
+        if self.root is not None and self.root.disk_backed:
+            self.root.release_snapshot()
+        return LedgerTxnRoot(header, snapshot=snap,
+                             entry_cache_size=self.entry_cache_size)
+
+    def _refresh_snapshot(self, ledger_seq: int) -> None:
+        """Swap the root onto a fresh read view after a bucket-list
+        mutation (every close's seal phase); the superseded view's file
+        pins are released so GC can reclaim its buckets."""
+        snap = self.bucket_list.snapshot(ledger_seq, store=self.bucket_store)
+        old = self.root.set_snapshot(snap)
+        if old is not None:
+            old.release()
+
+    def _maybe_gc_buckets(self, ledger_seq: int) -> None:
+        """Periodic bucket-file GC (reference: forgetUnreferencedBuckets
+        after each close): referenced = the live list's curr/snap/pending
+        hashes; snapshot-pinned files survive regardless."""
+        if ledger_seq % self.BUCKET_GC_INTERVAL == 0:
+            self.bucket_store.gc(self.bucket_list.referenced_hashes())
+
+    def build_root(self, header: X.LedgerHeader,
+                   raw_entries) -> LedgerTxnRoot:
+        """Root over `header` + the current bucket list, from the native
+        engine's exported state.  Disk mode ignores `raw_entries` (the
+        just-rebuilt bucket list is the authority — no decode); in-memory
+        mode materializes the dict from the (key XDR, entry XDR) pairs."""
+        if self.bucket_store is not None:
+            return self._make_disk_root(header)
+        root = LedgerTxnRoot(header)
+        root._entries = {kb: X.LedgerEntry.from_xdr(rec)
+                         for kb, rec in raw_entries}
+        return root
 
     # -- tx set canonicalization -------------------------------------------
     def make_tx_set(self, frames: Sequence[TransactionFrame]
@@ -245,6 +317,23 @@ class LedgerManager:
             close_time = stellar_value.closeTime
 
         seq = self.lcl_header.ledgerSeq + 1
+        if self.root.disk_backed and ordered:
+            # bulk prefetch the tx set's account entries into the entry
+            # cache: one batched, file-order snapshot pass instead of a
+            # per-load probe chain each (reference: prefetchClassic
+            # before apply)
+            keys = set()
+            for f in ordered:
+                keys.add(X.account_key_xdr(f.source_account_id().value))
+                inner = getattr(f, "inner", None)
+                if inner is not None:
+                    keys.add(X.account_key_xdr(
+                        inner.source_account_id().value))
+                for op in f.operations:
+                    if op.sourceAccount is not None:
+                        keys.add(X.account_key_xdr(
+                            X.muxed_to_account_id(op.sourceAccount).value))
+            self.root.prefetch(keys)
         ltx = LedgerTxn(self.root)
         header = ltx.load_header()
         header.ledgerSeq = seq
@@ -319,6 +408,13 @@ class LedgerManager:
         with tracing.span("ledger.seal"):
             self.bucket_list.add_batch(seq, header.ledgerVersion,
                                        init_entries, live_entries, dead_keys)
+            if self.root.disk_backed:
+                # the list just mutated: persist+index the changed buckets
+                # and swap the root onto the new view, then let GC reclaim
+                # files only old (released) snapshots referenced
+                with tracing.span("bucket.snapshot"):
+                    self._refresh_snapshot(seq)
+                self._maybe_gc_buckets(seq)
             header = ltx.load_header()
             header.bucketListHash = self.bucket_list.hash()
             self._update_skip_list(header)
@@ -428,7 +524,9 @@ class LedgerManager:
 
     @classmethod
     def load_last_known_ledger(cls, network_id: bytes, database, bucket_dir,
-                               invariant_manager=_DEFAULT_INVARIANTS
+                               invariant_manager=_DEFAULT_INVARIANTS,
+                               bucket_store=None,
+                               entry_cache_size: Optional[int] = None
                                ) -> "LedgerManager":
         """Rebuild a manager from durable state (reference:
         LedgerManagerImpl::loadLastKnownLedger): header from the DB, bucket
@@ -455,7 +553,9 @@ class LedgerManager:
             raise RuntimeError("database has no archive state")
         has = HistoryArchiveState.from_json(has_json)
 
-        mgr = cls(network_id, invariant_manager=invariant_manager)
+        mgr = cls(network_id, invariant_manager=invariant_manager,
+                  bucket_store=bucket_store,
+                  entry_cache_size=entry_cache_size)
         hashes = has.bucket_hashes()
         if len(hashes) != NUM_LEVELS * 2:
             raise RuntimeError("stored HAS malformed")
@@ -470,7 +570,9 @@ class LedgerManager:
             return has.rehydrate_next(level, bucket_dir.load)
 
         mgr.root = assume_bucket_state(mgr.bucket_list, header, source,
-                                       next_source)
+                                       next_source,
+                                       store=bucket_store,
+                                       entry_cache_size=mgr.entry_cache_size)
         mgr.lcl_header = header
         mgr.lcl_hash = bytes.fromhex(lcl_hex)
         mgr.db = database

@@ -22,7 +22,6 @@ from typing import Dict, List, Optional, Sequence
 from .. import xdr as X
 from ..bucket.bucket import Bucket
 from ..bucket.future import FutureBucket
-from .ledger_txn import LedgerTxnRoot
 
 try:
     if os.environ.get("STELLAR_TPU_NO_CAPPLY"):
@@ -53,8 +52,11 @@ class NativeApplyBridge:
 
     # -- state transfer ----------------------------------------------------
     def import_from(self, mgr) -> None:
-        """Python manager -> engine (authoritative state moves to C)."""
-        entries = [(kb, e.to_xdr()) for kb, e in mgr.root._entries.items()]
+        """Python manager -> engine (authoritative state moves to C).
+        Works for both root flavors: the BucketListDB root streams raw
+        records straight from its indexed bucket files (no Python entry
+        decode), the dict root serializes its entries."""
+        entries = mgr.root.export_raw_entries()
         buckets = []
         nexts = []
         for lvl in mgr.bucket_list.levels:
@@ -67,25 +69,26 @@ class NativeApplyBridge:
         self.active = True
 
     def export_to_manager(self, mgr) -> None:
-        """Engine -> Python manager (authoritative state moves back)."""
+        """Engine -> Python manager (authoritative state moves back).
+        The bucket list is rebuilt first and hash-verified; only then is
+        the root rebound — a BucketListDB root is rebuilt OVER that list
+        (ignoring the exported entry pairs, no decode), a dict root
+        materializes them."""
         hdr, lcl_hash, entries, bucket_streams, next_streams = \
             self.engine.export_state()
         header = X.LedgerHeader.from_xdr(hdr)
-        root = LedgerTxnRoot(header)
-        root._entries = {kb: X.LedgerEntry.from_xdr(rec)
-                         for kb, rec in entries}
         for i, lvl in enumerate(mgr.bucket_list.levels):
             lvl.curr = Bucket.deserialize(bucket_streams[2 * i])
             lvl.snap = Bucket.deserialize(bucket_streams[2 * i + 1])
             ns = next_streams[i]
             lvl.next = (None if ns is None
                         else FutureBucket.from_output(Bucket.deserialize(ns)))
-        mgr.root = root
-        mgr.lcl_header = header
-        mgr.lcl_hash = lcl_hash
         if mgr.bucket_list.hash() != header.bucketListHash:
             raise RuntimeError(
                 "native state export diverged from the bucket list hash")
+        mgr.root = mgr.build_root(header, entries)
+        mgr.lcl_header = header
+        mgr.lcl_hash = lcl_hash
         self.active = False
 
     # -- replay ------------------------------------------------------------

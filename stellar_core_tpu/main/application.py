@@ -44,6 +44,7 @@ class Application:
         # database + buckets ------------------------------------------------
         self.database: Optional[Database] = None
         self.bucket_dir: Optional[BucketDir] = None
+        self.bucket_store = None   # BucketListDB authority when enabled
         if config.DATABASE:
             os.makedirs(os.path.dirname(config.DATABASE) or ".",
                         exist_ok=True)
@@ -51,6 +52,17 @@ class Application:
             bdir = config.BUCKET_DIR_PATH or os.path.join(
                 os.path.dirname(config.DATABASE) or ".", "buckets")
             self.bucket_dir = BucketDir(bdir)
+        if not config.IN_MEMORY_LEDGER:
+            # BucketListDB mode: one store serves both the durable bucket
+            # files (persistence) and the indexed ledger-entry reads
+            from ..bucket.manager import BucketListStore
+            import tempfile
+            bdir = config.BUCKET_DIR_PATH or (
+                self.bucket_dir.path if self.bucket_dir is not None
+                else tempfile.mkdtemp(prefix="bucketlistdb-"))
+            self.bucket_store = BucketListStore(bdir)
+            if self.bucket_dir is not None:
+                self.bucket_dir = self.bucket_store
 
         invariants = (InvariantManager.from_patterns(config.INVARIANT_CHECKS)
                       if config.INVARIANT_CHECKS else None)
@@ -64,16 +76,21 @@ class Application:
             if config.WORKER_THREADS > 0 else None)
 
         # ledger ------------------------------------------------------------
+        cache_size = config.BUCKETLISTDB_ENTRY_CACHE_SIZE
         if self.database is not None and self.database.get_state(
                 PersistentState.LAST_CLOSED_LEDGER) is not None:
             self.lm = LedgerManager.load_last_known_ledger(
                 self.network_id, self.database, self.bucket_dir,
-                invariant_manager=invariants)
+                invariant_manager=invariants,
+                bucket_store=self.bucket_store,
+                entry_cache_size=cache_size)
             self.lm.bucket_list.executor = self.worker_pool
         else:
             self.lm = LedgerManager(self.network_id,
                                     invariant_manager=invariants,
-                                    merge_executor=self.worker_pool)
+                                    merge_executor=self.worker_pool,
+                                    bucket_store=self.bucket_store,
+                                    entry_cache_size=cache_size)
             self.lm.start_new_ledger()
             if self.database is not None:
                 self.lm.enable_persistence(self.database, self.bucket_dir)
@@ -117,7 +134,9 @@ class Application:
         self.catchup = CatchupManager(
             self.network_id, config.NETWORK_PASSPHRASE,
             accel=config.ACCEL == "tpu",
-            accel_chunk=config.ACCEL_CHUNK_SIZE)
+            accel_chunk=config.ACCEL_CHUNK_SIZE,
+            bucket_store=self.bucket_store,
+            entry_cache_size=cache_size)
 
         # maintenance -------------------------------------------------------
         from .maintainer import Maintainer

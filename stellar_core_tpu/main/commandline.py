@@ -94,10 +94,23 @@ def cmd_catchup(args) -> int:
     from ..invariant.invariants import InvariantManager
     inv = (InvariantManager.from_patterns(cfg.INVARIANT_CHECKS)
            if cfg.INVARIANT_CHECKS else None)
+    store = None
+    if not cfg.IN_MEMORY_LEDGER:
+        # BucketListDB catchup: assumed/replayed state lives in indexed
+        # bucket files instead of an in-memory dict
+        import os
+        import tempfile
+        from ..bucket.manager import BucketListStore
+        bdir = cfg.BUCKET_DIR_PATH or (
+            os.path.join(os.path.dirname(cfg.DATABASE) or ".", "buckets")
+            if cfg.DATABASE else tempfile.mkdtemp(prefix="bucketlistdb-"))
+        store = BucketListStore(bdir)
     cm = CatchupManager(cfg.network_id(), cfg.NETWORK_PASSPHRASE,
                         accel=cfg.ACCEL == "tpu",
                         accel_chunk=cfg.ACCEL_CHUNK_SIZE,
-                        invariant_manager=inv)
+                        invariant_manager=inv,
+                        bucket_store=store,
+                        entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE)
     at = None
     if args.at and args.at != "current":
         try:

@@ -51,6 +51,12 @@ class Config:
 
     DATABASE: str = ""                       # sqlite path; "" = in-memory
     BUCKET_DIR_PATH: str = ""
+    # BucketListDB (reference: since v21 the bucket list IS the ledger-entry
+    # database).  IN_MEMORY_LEDGER=false routes every ledger-entry read
+    # through indexed on-disk bucket files with a bounded LRU entry cache;
+    # true keeps the legacy in-memory dict root (tests/sims).
+    IN_MEMORY_LEDGER: bool = True
+    BUCKETLISTDB_ENTRY_CACHE_SIZE: int = 4096  # LRU entries in LedgerTxnRoot
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HISTORY: List[HistoryArchiveConfig] = field(default_factory=list)
 
@@ -105,7 +111,9 @@ class Config:
             "RUN_STANDALONE", "FORCE_SCP", "MANUAL_CLOSE",
             "PEER_PORT", "HTTP_PORT",
             "KNOWN_PEERS", "TARGET_PEER_CONNECTIONS", "DATABASE",
-            "BUCKET_DIR_PATH", "INVARIANT_CHECKS", "ACCEL",
+            "BUCKET_DIR_PATH", "IN_MEMORY_LEDGER",
+            "BUCKETLISTDB_ENTRY_CACHE_SIZE",
+            "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
             "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "WORKER_THREADS",

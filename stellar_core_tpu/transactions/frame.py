@@ -298,7 +298,15 @@ class TransactionFrame:
                     op_results.append(res_check)
                     ok = False
                     continue
-                res = op.do_apply(inner)
+                # each op applies in its OWN nested LedgerTxn, rolled back
+                # on op failure (reference: applyOperations' per-op ltxOp)
+                # — a mutate-then-fail path (RevokeSponsorship transfer,
+                # sponsored CreateAccount UNDERFUNDED) must leave no
+                # counter mutations for later ops of the same tx to see
+                with LedgerTxn(inner) as op_ltx:
+                    res = op.do_apply(op_ltx)
+                    if _op_ok(res):
+                        op_ltx.commit()
                 op_results.append(res)
                 if not _op_ok(res):
                     ok = False

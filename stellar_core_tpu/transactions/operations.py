@@ -764,6 +764,16 @@ class AccountMergeOpFrame(OperationFrame):
         src = src_e.data.value
         if src.flags & X.AccountFlags.AUTH_IMMUTABLE_FLAG:
             return self.result(C.ACCOUNT_MERGE_IMMUTABLE_SET)
+        if header.ledgerVersion >= 14:
+            # CAP-33 (reference: MergeOpFrame via loadSponsorship /
+            # loadSponsorshipCounter): a party to an OPEN Begin/End
+            # sandwich — sponsored account OR sponsor — cannot merge away
+            # mid-tx; this is also what keeps the sandwich sponsor loadable
+            # for the rest of the tx (see establish_sponsorship)
+            ctx = getattr(self.tx, "_sponsorship_ctx", None) or {}
+            src_x = src_id.to_xdr()
+            if src_x in ctx or src_x in ctx.values():
+                return self.result(C.ACCOUNT_MERGE_IS_SPONSOR)
         if src.numSubEntries != 0:
             return self.result(C.ACCOUNT_MERGE_HAS_SUB_ENTRIES)
         if utils.num_sponsoring(src) != 0:

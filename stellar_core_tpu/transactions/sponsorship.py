@@ -94,8 +94,10 @@ def establish_sponsorship(ltx, header: X.LedgerHeader,
     by the caller."""
     sp_e = load_account(ltx, sponsor_id)
     if sp_e is None:
-        return LOW_RESERVE  # sandwich sponsor vanished mid-tx (merge) — treat
-        # as unable to sponsor; unreachable for well-formed txs
+        # unreachable: AccountMerge rejects IS_SPONSOR for any party to an
+        # open sandwich (v14+), and merge is the only way an account
+        # leaves the ledger — a missing sponsor here means corrupt state
+        raise RuntimeError("sandwich sponsor missing from the ledger")
     sponsor = sp_e.data.value
     code = _sponsor_can_take(header, sponsor, mult)
     if code != SUCCESS:

@@ -71,6 +71,11 @@ CANONICAL_METRICS = frozenset({
     # bucket
     "bucket.merge.time",
     "bucket.batch.addtime",
+    # bucketlistdb (disk-backed ledger-entry reads)
+    "bucketlistdb.load",
+    "bucketlistdb.prefetch",
+    "bucketlistdb.cache.hit",
+    "bucketlistdb.cache.miss",
     # accel
     "accel.ed25519.batch-size",
     "accel.ed25519.table-sigs",
@@ -87,8 +92,8 @@ CANONICAL_METRICS = frozenset({
 })
 
 # Prefixes for families whose tail is data-dependent (one meter per overlay
-# message type).
-CANONICAL_PREFIXES = ("overlay.recv.",)
+# message type; one probe counter per bucket-list level).
+CANONICAL_PREFIXES = ("overlay.recv.", "bucketlistdb.probe.")
 
 
 class Counter:

@@ -549,3 +549,103 @@ def test_sponsored_account_merge_releases_sponsor(mgr, root):
         X.TransactionResultCode.txSUCCESS
     assert _acc(mgr, new_id) is None
     assert num_sponsoring(_acc(mgr, s.account_id)) == 0
+
+
+# --- AccountMerge inside an open sandwich (ADVICE r5 high) -----------------
+
+def _merge_op(dest: X.AccountID, source=None):
+    return X.Operation(
+        sourceAccount=(X.muxed_from_account_id(source)
+                       if source is not None else None),
+        body=X.OperationBody.destination(X.muxed_from_account_id(dest)))
+
+
+def test_merge_rejected_for_sandwich_sponsor(mgr, root):
+    """[Begin(S sponsors A), AccountMerge(source=S), End(A)] must fail
+    ACCOUNT_MERGE_IS_SPONSOR (reference: MergeOpFrame via
+    loadSponsorshipCounter) — previously it merged S away mid-sandwich."""
+    s = _mk(mgr, root, 70)
+    a = _mk(mgr, root, 71)
+    ops = [begin_op(a.account_id, source=s.account_id),
+           _merge_op(root.account_id, source=s.account_id),
+           end_op(source=a.account_id)]
+    tx = build_tx(NID, s.secret, s.next_seq(), ops,
+                  extra_signers=[a.secret])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[1].value.value
+    assert op_res.switch == \
+        X.AccountMergeResultCode.ACCOUNT_MERGE_IS_SPONSOR
+    assert _acc(mgr, s.account_id) is not None   # sponsor survived
+
+
+def test_merge_rejected_for_sandwiched_account(mgr, root):
+    """The SPONSORED party of an open sandwich cannot merge either
+    (reference: loadSponsorship arm of the same check)."""
+    s = _mk(mgr, root, 72)
+    a = _mk(mgr, root, 73)
+    ops = [begin_op(a.account_id, source=s.account_id),
+           _merge_op(root.account_id, source=a.account_id),
+           end_op(source=a.account_id)]
+    tx = build_tx(NID, s.secret, s.next_seq(), ops,
+                  extra_signers=[a.secret])
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op_res = res.result.value[1].value.value
+    assert op_res.switch == \
+        X.AccountMergeResultCode.ACCOUNT_MERGE_IS_SPONSOR
+    assert _acc(mgr, a.account_id) is not None
+
+
+def test_merge_outside_sandwich_still_succeeds(mgr, root):
+    """A closed sandwich leaves no trace: the same accounts merge fine in
+    a later tx."""
+    s = _mk(mgr, root, 74)
+    a = _mk(mgr, root, 75)
+    tx = _sandwich_tx(s, a, [manage_data_op(b"k", b"v",
+                                            source=a.account_id)])
+    _close(mgr, tx)
+    # undo the sponsored subentry so the merge precondition holds
+    _close(mgr, a.tx([manage_data_op(b"k", None)]))
+    merge = s.tx([_merge_op(root.account_id)])
+    arts = _close(mgr, merge)
+    assert _result_of(arts, merge).result.switch == \
+        X.TransactionResultCode.txSUCCESS
+    assert _acc(mgr, s.account_id) is None
+
+
+# --- mutate-then-fail isolation (ADVICE r5 medium) -------------------------
+
+def test_failed_op_leaves_no_counter_mutations(mgr, root):
+    """A sponsored CreateAccount that fails UNDERFUNDED (after having
+    established the sponsorship) must roll back its counter mutations, so
+    a LATER op of the same (failing) tx sees clean state — the per-op
+    nested LedgerTxn, reference: applyOperations' ltxOp.
+
+    S is funded to afford sponsoring exactly ONE more account (4 base
+    reserves = 4e8): with the old shared-ltx behavior the failed op's
+    leaked numSponsoring += 2 made op 4 fail LOW_RESERVE (needs 6e8);
+    rolled back properly, op 4 SUCCEEDS inside the failed tx — the op
+    result vector (and thus txSetResultHash on replay) differs."""
+    fee = 4 * 100
+    s = _mk(mgr, root, 76, balance=500_000_000 + fee)
+    a1 = X.AccountID.ed25519(SecretKey(bytes([77]) * 32).public_key.ed25519)
+    a2 = X.AccountID.ed25519(SecretKey(bytes([78]) * 32).public_key.ed25519)
+    ops = [begin_op(a1, source=s.account_id),
+           create_account_op(a1, 10 ** 18, source=s.account_id),  # UNDERFUNDED
+           begin_op(a2, source=s.account_id),
+           create_account_op(a2, 0, source=s.account_id)]
+    tx = build_tx(NID, s.secret, s.next_seq(), ops)
+    arts = _close(mgr, tx)
+    res = _result_of(arts, tx)
+    assert res.result.switch == X.TransactionResultCode.txFAILED
+    op1 = res.result.value[1].value.value
+    assert op1.switch == \
+        X.CreateAccountResultCode.CREATE_ACCOUNT_UNDERFUNDED
+    op3 = res.result.value[3].value.value
+    assert op3.switch == X.CreateAccountResultCode.CREATE_ACCOUNT_SUCCESS
+    # the tx failed as a whole: nothing persisted
+    assert _acc(mgr, a1) is None and _acc(mgr, a2) is None
+    assert num_sponsoring(_acc(mgr, s.account_id)) == 0
