@@ -1,4 +1,4 @@
-.PHONY: native test metrics bucketdb clean
+.PHONY: native test metrics bucketdb bucketdb-slow clean
 
 native:
 	python setup.py build_ext --inplace
@@ -7,10 +7,19 @@ test:
 	python -m pytest tests/ -q
 
 # BucketListDB differential suite: on-disk index round-trip + corruption
-# fail-stop, snapshot consistency across closes, LRU bound, and the
-# dict-vs-disk multi-checkpoint replay hash identity
+# fail-stop, snapshot consistency across closes, LRU bound, the
+# dict-vs-disk multi-checkpoint replay hash identity, plus phase 2 —
+# randomized merge_buckets vs merge_buckets_raw differentials and the
+# disk-resident RSS regression guard (deep randomized chains are -m slow;
+# run with `make bucketdb-slow` to include them)
 bucketdb:
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_bucketlistdb.py -q \
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_bucketlistdb.py \
+		tests/test_bucket_streaming.py -q -m 'not slow' \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+bucketdb-slow:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_bucketlistdb.py \
+		tests/test_bucket_streaming.py -q \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must

@@ -30,6 +30,34 @@ def _stage(msg):
 
 
 # ---------------------------------------------------------------------------
+# Global deadline (ISSUE 3 satellite): BENCH_r05 was killed by the driver
+# budget mid-quorum-matrix (rc=124) and the JSON line never printed.  ONE
+# wall-clock budget threads through every section: a section whose estimate
+# no longer fits emits SKIPPED(budget) rows instead of running, and the
+# final JSON line is ALWAYS written from whatever was measured (plus
+# last-good cache for skipped accel sections).  The watchdog stays as the
+# backstop for a section that wedges PAST its estimate.
+# ---------------------------------------------------------------------------
+_T0 = time.monotonic()
+BENCH_BUDGET_S = float(os.environ.get("BENCH_DEADLINE_S", "2100"))
+
+
+def time_left() -> float:
+    return BENCH_BUDGET_S - (time.monotonic() - _T0)
+
+
+def budget_fits(section: str, estimate_s: float) -> bool:
+    """True when `section` still fits the global budget (1.25x slack on
+    the estimate); logs the skip decision otherwise."""
+    left = time_left()
+    if left >= estimate_s * 1.25:
+        return True
+    _stage(f"SKIPPING '{section}' (needs ~{estimate_s:.0f}s, "
+           f"{left:.0f}s of the {BENCH_BUDGET_S:.0f}s budget left)")
+    return False
+
+
+# ---------------------------------------------------------------------------
 # Last-good result cache (VERDICT r3 weak #1): the shared tunnel has died
 # mid-session twice, erasing a whole round's perf record at driver time.
 # Every successful on-chip sub-result is persisted the moment it is
@@ -185,12 +213,62 @@ def build_archive(nid, passphrase, path, n_payment_ledgers=110,
     return archive, mgr
 
 
+def bench_merge_throughput(workdir):
+    """ISSUE 3 acceptance: streaming-merge throughput.  Two synthetic
+    buckets (disjoint + colliding keys) merged by the decoded path and by
+    merge_buckets_raw (file-to-file, decode-free), hash identity asserted,
+    entries/s + MB/s reported."""
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.bucket import (Bucket, BucketListStore,
+                                         merge_buckets, merge_buckets_raw)
+    from stellar_core_tpu.crypto.keys import SecretKey
+
+    n = int(os.environ.get("BENCH_MERGE_ENTRIES", "20000"))
+
+    def acct(i):
+        sk = SecretKey(bytes([i % 251 + 1]) * 28 + i.to_bytes(4, "big"))
+        return X.LedgerEntry(
+            lastModifiedLedgerSeq=1,
+            data=X.LedgerEntryData.account(X.AccountEntry(
+                accountID=X.AccountID.ed25519(sk.public_key.ed25519),
+                balance=10 ** 9 + i, seqNum=1)))
+
+    old = Bucket.fresh(23, [acct(i) for i in range(n)], [], [])
+    new = Bucket.fresh(23, [], [acct(i) for i in range(n // 2, n + n // 2)],
+                       [])
+    store = BucketListStore(os.path.join(workdir, "merge-bench"))
+    # make both inputs disk-resident so the raw pass measures the real
+    # deep-level regime: file-to-file, no decoded entries anywhere
+    old_d = merge_buckets_raw(old, Bucket.empty(), True, None, store)
+    new_d = merge_buckets_raw(new, Bucket.empty(), True, None, store)
+
+    t0 = time.perf_counter()
+    mem = merge_buckets(old, new, True)
+    mem_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    raw = merge_buckets_raw(old_d, new_d, True, None, store)
+    raw_s = time.perf_counter() - t0
+    assert mem.hash() == raw.hash(), "streaming merge diverged"
+    out_entries = len(raw)
+    out_bytes = raw.disk_index()._file_size
+    return {
+        "merge_entries_in": 2 * n,
+        "merge_entries_out": out_entries,
+        "merge_raw_entries_per_sec": round(out_entries / raw_s, 1),
+        "merge_raw_mb_per_sec": round(out_bytes / raw_s / 1e6, 2),
+        "merge_raw_vs_decoded": round(mem_s / raw_s, 3),
+        "merge_hashes_identical": True,
+    }
+
+
 def bench_bucketlistdb():
     """ISSUE 2 acceptance: the bench line reports the BucketListDB entry-
-    cache hit rate and load-latency percentiles.  CPU-only (no device):
-    one small archive replayed both ways — in-memory dict root vs
-    disk-backed BucketListDB root — with hash identity ASSERTED and the
-    relative replay rate recorded."""
+    cache hit rate and load-latency percentiles.  ISSUE 3 adds the phase-2
+    memory story: peak decoded-entry count under default residency plus
+    streaming-merge counters, with disk/memory hash identity ASSERTED
+    across the multi-checkpoint replay.  CPU-only (no device): one small
+    archive replayed both ways — in-memory dict root vs disk-backed
+    BucketListDB root — with the relative replay rate recorded."""
     from stellar_core_tpu.bucket import BucketListStore
     from stellar_core_tpu.catchup.catchup import CatchupManager
     from stellar_core_tpu.crypto import keys
@@ -222,6 +300,7 @@ def bench_bucketlistdb():
         assert m_disk.lcl_hash == m_mem.lcl_hash == mgr.lcl_hash, \
             "bucketlistdb replay diverged from the in-memory path"
         stats = m_disk.root.cache_stats()
+        bl = m_disk.bucket_list
         out = {
             "bucketlistdb_replay_ledgers": n,
             "bucketlistdb_cache_hit_rate": stats.get("hit_rate", 0.0),
@@ -230,6 +309,12 @@ def bench_bucketlistdb():
             "bucketlistdb_ledgers_per_sec": round(n / disk_s, 1),
             "bucketlistdb_vs_in_memory": round(mem_s / disk_s, 3),
             "bucketlistdb_hashes_identical": True,
+            # phase 2 memory story: peak decoded entries across the whole
+            # replay vs the ledger's live-entry count (the old O(ledger))
+            "bucketlistdb_resident_levels": bl.resident_levels,
+            "bucketlistdb_peak_resident_entries": bl.peak_decoded_entries,
+            "bucketlistdb_end_resident_entries": bl.decoded_entry_count(),
+            "bucketlistdb_total_live_entries": m_disk.root.entry_count(),
         }
         load = registry().snapshot(prefix="bucketlistdb.").get(
             "bucketlistdb.load")
@@ -238,6 +323,16 @@ def bench_bucketlistdb():
             for q in ("p50", "p90", "p99"):
                 out[f"bucketlistdb_load_{q}_us"] = round(
                     load[f"{q}_s"] * 1e6, 1)
+        bsnap = registry().snapshot(prefix="bucket.merge.")
+        stream = bsnap.get("bucket.merge.stream")
+        if stream:
+            out["bucketlistdb_stream_merges"] = stream["count"]
+            out["bucketlistdb_stream_merge_p90_ms"] = round(
+                stream["p90_s"] * 1e3, 2)
+        mbytes = bsnap.get("bucket.merge.bytes")
+        if mbytes:
+            out["bucketlistdb_stream_merge_bytes"] = mbytes["count"]
+        out.update(bench_merge_throughput(d))
     return out
 
 
@@ -552,27 +647,67 @@ def _arm_watchdog(deadline_s: float = 2100.0):
     return t.cancel
 
 
+def _stale_fill(extra: dict, section: str) -> dict:
+    """Pull a skipped section's last-good cached values into `extra`,
+    age-stamped and stale-flagged (never bare zeros while evidence
+    exists).  Returns the cached values dict ({} when none)."""
+    got = _cache_load().get(section)
+    if not got:
+        return {}
+    extra.update(got["values"])
+    extra[f"{section}_measured_at"] = got["measured_at"]
+    extra[f"{section}_age_hours"] = round(
+        (time.time() - got["measured_at_unix"]) / 3600.0, 1)
+    extra[f"{section}_stale"] = True
+    return got["values"]
+
+
 def main():
     from stellar_core_tpu.testutils import network_id
 
     passphrase = "bench network"
     nid = network_id(passphrase)
+    extra = {"bench_budget_s": BENCH_BUDGET_S}
+    value = vs = 0.0
 
     # BucketListDB differential runs on CPU — measure it before touching
     # the (occasionally wedged) device so the numbers exist either way
-    _stage("bucketlistdb bench (CPU-only)...")
-    bldb = bench_bucketlistdb()
-    _cache_put("bucketlistdb", bldb)
+    if budget_fits("bucketlistdb", 240):
+        _stage("bucketlistdb bench (CPU-only)...")
+        bldb = bench_bucketlistdb()
+        _cache_put("bucketlistdb", bldb)
+        extra.update(bldb)
+    else:
+        extra["bucketlistdb"] = "SKIPPED(budget)"
+        _stale_fill(extra, "bucketlistdb")
+
+    if not budget_fits("device probe + accel sections", 240):
+        # nothing device-side fits anymore: emit what the CPU sections
+        # measured plus last-good cache for the rest — never rc=124 with
+        # no JSON line
+        for section in ("sigs", "replay", "quorum"):
+            extra[section] = "SKIPPED(budget)"
+            _stale_fill(extra, section)
+        sig = _cache_load().get("sigs", {}).get("values", {})
+        extra["bench_spent_s"] = round(time.monotonic() - _T0, 1)
+        print(json.dumps({
+            "metric": "ed25519_batch_verify_throughput",
+            "value": sig.get("ed25519_tpu_sigs_per_sec", 0.0),
+            "unit": "sigs/s",
+            "vs_baseline": sig.get("ed25519_speedup_1chip_vs_1core", 0.0),
+            "extra": extra,
+        }))
+        return
 
     _stage("probing device health...")
     # the tunnel has come back mid-window after outages before: retry the
     # probe a couple of times across the bench window before giving up
     up = False
     for round_ in range(2):
-        if probe_device():
+        if probe_device(timeout_s=min(120.0, max(10.0, time_left() / 4))):
             up = True
             break
-        if round_ == 0:
+        if round_ == 0 and time_left() > 400:
             _stage("device unreachable — waiting 120s and re-probing once")
             time.sleep(120)
     if not up:
@@ -580,77 +715,92 @@ def main():
         # tunnel down — emit the last good on-chip numbers, aged and
         # stale-flagged, rather than zeros (VERDICT r3 weak #1)
         _stage("DEVICE UNREACHABLE — emitting stale last-good report")
-        print(json.dumps(_degraded_report(
+        rep = _degraded_report(
             "TPU tunnel unreachable (probes timed out across the bench "
             "window); numbers below are the last good on-chip results, "
-            "stale-flagged with their age")))
+            "stale-flagged with their age")
+        rep["extra"].update(extra)   # fresh CPU-side rows win over cache
+        print(json.dumps(rep))
         return
 
-    cancel_watchdog = _arm_watchdog()
+    # the watchdog backstops a section that WEDGES past its estimate (the
+    # deadline checks can only skip sections that haven't started)
+    cancel_watchdog = _arm_watchdog(BENCH_BUDGET_S + 240)
 
-    _stage("sig bench...")
-    tpu_sig_rate, cpu_sig_rate = bench_sigs()
-    _cache_put("sigs", {
-        "ed25519_tpu_sigs_per_sec": round(tpu_sig_rate, 1),
-        "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
-        "ed25519_speedup_1chip_vs_1core":
-            round(tpu_sig_rate / cpu_sig_rate, 2),
-    })
+    if budget_fits("sigs", 180):
+        _stage("sig bench...")
+        tpu_sig_rate, cpu_sig_rate = bench_sigs()
+        sig_vals = {
+            "ed25519_tpu_sigs_per_sec": round(tpu_sig_rate, 1),
+            "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
+            "ed25519_speedup_1chip_vs_1core":
+                round(tpu_sig_rate / cpu_sig_rate, 2),
+        }
+        _cache_put("sigs", sig_vals)
+        extra.update(sig_vals)
+        value = round(tpu_sig_rate, 1)
+        vs = round(tpu_sig_rate / cpu_sig_rate, 2)
+    else:
+        extra["sigs"] = "SKIPPED(budget)"
+        cached = _stale_fill(extra, "sigs")
+        value = cached.get("ed25519_tpu_sigs_per_sec", 0.0)
+        vs = cached.get("ed25519_speedup_1chip_vs_1core", 0.0)
 
-    with tempfile.TemporaryDirectory() as d:
-        _stage("building archive (~18 checkpoints)...")
-        # BASELINE.json configs 1/4 call for thousands of pubnet ledgers;
-        # 1100 payment ledgers ≈ 1215 total ≈ 19 checkpoints keeps the
-        # steady-state pipeline visible while fitting the driver budget
-        # (VERDICT r2 weak #5: 127 ledgers was inside the drift noise).
-        # BENCH_PAYMENT_LEDGERS overrides for offline full-scale runs
-        # (VERDICT r3 item 7: the 10k-ledger config-1/4 measurement).
-        archive, mgr = build_archive(
-            nid, passphrase, os.path.join(d, "archive"),
-            n_payment_ledgers=int(os.environ.get(
-                "BENCH_PAYMENT_LEDGERS", "1100")))
-        _stage("replay bench...")
-        cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
-            nid, passphrase, archive, mgr.lcl_hash)
-    obs = observability_snapshot(hit_rate)
-    _cache_put("replay", {
-        "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
-        "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
-        "replay_ledgers": n_ledgers,
-        "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
-        "replay_hashes_identical": True,
-        "sig_offload_hit_rate": round(hit_rate, 3),
-        "replay_phases": phases,
-        "metrics": obs,
-    })
-
-    _stage("quorum bench (crossover matrix)...")
-    matrix = bench_quorum()
-    from stellar_core_tpu.herder.quorum_intersection import _cquorum
-    matrix["quorum_native_engine"] = _cquorum is not None
-    _cache_put("quorum", matrix)
-
-    print(json.dumps({
-        "metric": "ed25519_batch_verify_throughput",
-        "value": round(tpu_sig_rate, 1),
-        "unit": "sigs/s",
-        "vs_baseline": round(tpu_sig_rate / cpu_sig_rate, 2),
-        "extra": {
+    if budget_fits("replay", 900):
+        with tempfile.TemporaryDirectory() as d:
+            _stage("building archive (~18 checkpoints)...")
+            # BASELINE.json configs 1/4 call for thousands of pubnet
+            # ledgers; 1100 payment ledgers ≈ 1215 total ≈ 19 checkpoints
+            # keeps the steady-state pipeline visible while fitting the
+            # driver budget (VERDICT r2 weak #5: 127 ledgers was inside
+            # the drift noise).  BENCH_PAYMENT_LEDGERS overrides for
+            # offline full-scale runs (VERDICT r3 item 7: the 10k-ledger
+            # config-1/4 measurement).
+            archive, mgr = build_archive(
+                nid, passphrase, os.path.join(d, "archive"),
+                n_payment_ledgers=int(os.environ.get(
+                    "BENCH_PAYMENT_LEDGERS", "1100")))
+            _stage("replay bench...")
+            cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
+                nid, passphrase, archive, mgr.lcl_hash)
+        obs = observability_snapshot(hit_rate)
+        replay_vals = {
             "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
             "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
             "replay_ledgers": n_ledgers,
             "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
             "replay_hashes_identical": True,
             "sig_offload_hit_rate": round(hit_rate, 3),
-            "ed25519_tpu_sigs_per_sec": round(tpu_sig_rate, 1),
-            "ed25519_libsodium_1core_sigs_per_sec": round(cpu_sig_rate, 1),
-            "ed25519_speedup_1chip_vs_1core":
-                round(tpu_sig_rate / cpu_sig_rate, 2),
-            **bldb,
-            **matrix,
             "replay_phases": phases,
             "metrics": obs,
-        },
+        }
+        _cache_put("replay", replay_vals)
+        extra.update(replay_vals)
+    else:
+        extra["replay"] = "SKIPPED(budget)"
+        _stale_fill(extra, "replay")
+
+    # the quorum matrix already degrades row-by-row under its own budget;
+    # hand it whatever wall-clock remains (minus the reporting tail)
+    quorum_budget = min(700.0, time_left() - 45.0)
+    if quorum_budget > 60.0:
+        _stage("quorum bench (crossover matrix)...")
+        matrix = bench_quorum(budget_s=quorum_budget)
+        from stellar_core_tpu.herder.quorum_intersection import _cquorum
+        matrix["quorum_native_engine"] = _cquorum is not None
+        _cache_put("quorum", matrix)
+        extra.update(matrix)
+    else:
+        extra["quorum"] = "SKIPPED(budget)"
+        _stale_fill(extra, "quorum")
+
+    extra["bench_spent_s"] = round(time.monotonic() - _T0, 1)
+    print(json.dumps({
+        "metric": "ed25519_batch_verify_throughput",
+        "value": value,
+        "unit": "sigs/s",
+        "vs_baseline": vs,
+        "extra": extra,
     }))
     cancel_watchdog()
 

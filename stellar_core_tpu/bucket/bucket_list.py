@@ -27,6 +27,12 @@ from .future import FutureBucket
 
 NUM_LEVELS = 11
 
+# Residency depth default: levels < DEFAULT_RESIDENT_LEVELS keep decoded
+# entries (they churn every few ledgers and feed the synchronous level-0
+# merge); levels >= it are disk-resident once persisted+indexed, and their
+# merges stream file-to-file (BUCKET_RESIDENT_LEVELS in config).
+DEFAULT_RESIDENT_LEVELS = 2
+
 
 def level_size(level: int) -> int:
     return 4 ** (level + 1)
@@ -65,19 +71,25 @@ class BucketLevel:
 
     def commit(self) -> None:
         """Resolve the pending merge into curr (reference:
-        BucketLevel::commit)."""
+        BucketLevel::commit).  A streaming merge's output is now
+        referenced by the level, so its adoption-time GC pin drops."""
         if self.next is not None:
             self.curr = self.next.resolve()
+            self.next.release_output_pin()
             self.next = None
 
     def prepare(self, spill: Bucket, keep_tombstones: bool,
-                protocol_version: int, executor=None) -> None:
+                protocol_version: int, executor=None,
+                raw_store=None) -> None:
         """Start merging curr with the incoming spill (reference:
-        BucketLevel::prepare → FutureBucket ctor on a worker thread)."""
+        BucketLevel::prepare → FutureBucket ctor on a worker thread).
+        With `raw_store` the merge streams records file-to-file
+        (merge_buckets_raw) and resolves to a disk-resident bucket."""
         release_assert(self.next is None,
                        "prepare() without a prior commit()")
         self.next = FutureBucket(self.curr, spill, keep_tombstones,
-                                 protocol_version, executor)
+                                 protocol_version, executor,
+                                 raw_store=raw_store)
 
     def hash(self) -> bytes:
         return SHA256().add(self.curr.hash()).add(self.snap.hash()).finish()
@@ -90,6 +102,65 @@ class BucketList:
         None for synchronous merges — the outputs are identical either way."""
         self.levels: List[BucketLevel] = [BucketLevel() for _ in range(NUM_LEVELS)]
         self.executor = executor
+        # residency (BucketListDB phase 2): unset = every bucket decoded
+        self.store = None
+        self.resident_levels = NUM_LEVELS
+        self.peak_decoded_entries = 0
+
+    # -- residency (BucketListDB phase 2) ------------------------------------
+    def configure_residency(self, store, resident_levels: int) -> None:
+        """Run levels >= `resident_levels` disk-resident against `store`
+        (a BucketListStore): their merges stream file-to-file and their
+        decoded entry lists drop after each close's enforce_residency().
+        Level 0 must stay resident (its merge runs synchronously inside
+        every close), so the floor is 1."""
+        release_assert(store is not None, "residency needs a store")
+        self.store = store
+        self.resident_levels = max(1, min(int(resident_levels), NUM_LEVELS))
+        _registry().gauge("bucket.resident.entries").set_source(
+            self.decoded_entry_count)
+
+    def decoded_entry_count(self) -> int:
+        """Decoded BucketEntry objects currently held across the list
+        (curr/snap plus already-materialized merge outputs; merge inputs
+        alias curr/snap so they are not double-counted).  This is the
+        memory story phase 2 bounds: O(working set + top levels) instead
+        of O(ledger)."""
+        total = 0
+        for lvl in self.levels:
+            total += lvl.curr.resident_entry_count()
+            total += lvl.snap.resident_entry_count()
+            if lvl.next is not None:
+                out = lvl.next.peek()
+                if out is not None:
+                    total += out.resident_entry_count()
+        return total
+
+    def _note_decoded_peak(self) -> None:
+        n = self.decoded_entry_count()
+        if n > self.peak_decoded_entries:
+            self.peak_decoded_entries = n
+
+    def enforce_residency(self) -> None:
+        """Drop decoded entries from levels >= resident_levels: persist +
+        index each such bucket in the store (content addressing makes the
+        repeat calls free) and flip it disk-resident.  Resolved pending
+        merges convert too — a streaming merge's output already is, so in
+        steady state this only catches buckets that entered decoded
+        (restart, catchup assume, native export)."""
+        if self.store is None:
+            return
+        self._note_decoded_peak()
+        for i in range(self.resident_levels, NUM_LEVELS):
+            lvl = self.levels[i]
+            for b in (lvl.curr, lvl.snap):
+                if not b.is_empty() and not b.is_disk_resident():
+                    b.make_disk_resident(self.store.ensure(b))
+            if lvl.next is not None:
+                out = lvl.next.peek()
+                if out is not None and not out.is_empty() \
+                        and not out.is_disk_resident():
+                    out.make_disk_resident(self.store.ensure(out))
 
     def add_batch(self, ledger_seq: int, protocol_version: int,
                   init_entries: Iterable[LedgerEntry],
@@ -104,8 +175,13 @@ class BucketList:
                 if level_should_spill(ledger_seq, i - 1):
                     spill = self.levels[i - 1].snap_curr()
                     self.levels[i].commit()
+                    # deep levels merge decode-free, file-to-file
+                    raw = self.store if (self.store is not None
+                                         and i >= self.resident_levels) \
+                        else None
                     self.levels[i].prepare(spill, keep_tombstone_entries(i),
-                                           protocol_version, self.executor)
+                                           protocol_version, self.executor,
+                                           raw_store=raw)
             fresh = Bucket.fresh(protocol_version, init_entries,
                                  live_entries, dead_keys)
             # level 0 merges synchronously every ledger (reference:
@@ -113,6 +189,8 @@ class BucketList:
             # for this ledger's hash)
             self.levels[0].prepare(fresh, True, protocol_version, None)
             self.levels[0].commit()
+            if self.store is not None:
+                self._note_decoded_peak()
 
     def hash(self) -> bytes:
         """bucketListHash in the ledger header: SHA-256 over level hashes

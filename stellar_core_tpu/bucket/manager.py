@@ -16,9 +16,12 @@ shared-ptr liveness feeding forgetUnreferencedBuckets).
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, Optional, Set
+import threading
+import uuid
+from typing import Dict, Iterable, List, Optional, Set
 
-from .bucket import Bucket
+from ..crypto.sha import SHA256
+from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
 
 _EMPTY_HEX = "0" * 64
@@ -99,6 +102,77 @@ class BucketDir:
         pass
 
 
+class BucketStreamWriter:
+    """Streaming bucket output (reference: BucketOutputIterator): records
+    append to a temp file while the content hash and the DiskBucketIndex
+    grow incrementally, so a whole merge never holds more than one record
+    in memory.  finalize() renames the file to its content address and
+    registers the index with the store; content addressing makes a
+    collision with an existing file a free dedup.
+
+    Records must arrive in strictly ascending key order — the same
+    contract the in-memory merge guarantees — and are NOT inspected
+    beyond their leading 4-byte discriminant (the tombstone flag)."""
+
+    __slots__ = ("_store", "_proto", "_tmp", "_f", "_sha", "_off",
+                 "_keys", "_offsets", "_dead", "bytes_written")
+
+    def __init__(self, store: "BucketListStore", protocol_version: int):
+        self._store = store
+        self._proto = protocol_version
+        self._tmp = os.path.join(
+            store.path, f".merge-{uuid.uuid4().hex}.tmp")
+        self._f = open(self._tmp, "wb", buffering=1 << 16)
+        meta = pack_meta(protocol_version)
+        self._f.write(meta)
+        self._sha = SHA256().add(meta)
+        self._off = len(meta)
+        self._keys: List[bytes] = []
+        self._offsets: List[int] = []
+        self._dead = bytearray()
+        self.bytes_written = len(meta)
+
+    def write(self, key: bytes, rec: bytes) -> None:
+        self._f.write(rec)
+        self._sha.add(rec)
+        self._keys.append(key)
+        self._offsets.append(self._off)
+        self._dead.append(1 if rec[:4] == DEAD_TAG else 0)
+        self._off += len(rec)
+        self.bytes_written += len(rec)
+
+    def finalize(self) -> Bucket:
+        """Close + content-address the stream; returns the disk-resident
+        Bucket (or a plain empty bucket carrying the output protocol when
+        nothing was written — the all-annihilated merge)."""
+        if not self._keys:
+            self.abort()
+            return Bucket([], self._proto)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+        hh = self._sha.finish()
+        bucket = self._store._adopt_stream(
+            self._tmp, hh, DiskBucketIndex(
+                "", self._proto, self._keys, self._offsets, self._off,
+                bytes(self._dead)))
+        self._tmp = None
+        return bucket
+
+    def abort(self) -> None:
+        """Discard the stream (merge raised / empty output); idempotent."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        if self._tmp is not None:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+            self._tmp = None
+
+
 class BucketListStore(BucketDir):
     """BucketDir + per-file ``DiskBucketIndex`` cache + snapshot pinning —
     the storage half of BucketListDB (reference: BucketManager +
@@ -117,6 +191,50 @@ class BucketListStore(BucketDir):
         super().__init__(path)
         self._indexes: Dict[str, DiskBucketIndex] = {}
         self._pins: Dict[str, int] = {}
+        # background streaming merges register outputs from worker threads
+        # while the close path reads/pins/GCs on the main thread; reentrant
+        # because gc() holds it across the scan and _protected_hashes()
+        # re-acquires
+        self._lock = threading.RLock()
+
+    # -- streaming merge output ----------------------------------------------
+    def stream_writer(self, protocol_version: int) -> BucketStreamWriter:
+        """Open a streaming bucket output (merge_buckets_raw's sink)."""
+        return BucketStreamWriter(self, protocol_version)
+
+    def _adopt_stream(self, tmp_path: str, hash_bytes: bytes,
+                      idx: DiskBucketIndex) -> Bucket:
+        """Content-address a finished stream file and register its index.
+        The output hash is PINNED (released by FutureBucket at commit):
+        a background merge can finish between the close path computing
+        referenced_hashes and GC unlinking — without the pin that window
+        would delete a file the about-to-commit level points at.  Pin,
+        register and rename happen under the store lock, and gc() holds
+        the same lock across its whole scan, so the file can never become
+        visible-but-unpinned mid-collection."""
+        hh = hash_bytes.hex()
+        target = self._file_for(hh)
+        idx.path = target
+        with self._lock:
+            self._pins[hh] = self._pins.get(hh, 0) + 1
+            self._indexes.setdefault(hh, idx)
+            idx = self._indexes[hh]
+            if os.path.exists(target):
+                os.unlink(tmp_path)  # dedup: identical content already stored
+            else:
+                os.replace(tmp_path, target)
+                dfd = os.open(self.path, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+        return Bucket.from_disk(idx, hash_bytes)
+
+    def gc(self, referenced: Iterable[str]) -> int:
+        # one atomic scan vs concurrent stream adoptions (see
+        # _adopt_stream) — the lock is reentrant for _protected_hashes
+        with self._lock:
+            return super().gc(referenced)
 
     # -- save + index --------------------------------------------------------
     def ensure(self, bucket: Bucket) -> Optional[DiskBucketIndex]:
@@ -129,15 +247,24 @@ class BucketListStore(BucketDir):
         here instead of surfacing as wrong ledger state."""
         if bucket.is_empty():
             return None
+        attached = bucket.disk_index()
+        if attached is not None:
+            # a disk-resident bucket (streaming-merge output / prior
+            # residency pass) carries its index; adopt it if unseen
+            with self._lock:
+                return self._indexes.setdefault(bucket.hash().hex(),
+                                                attached)
         hh = bucket.hash().hex()
-        idx = self._indexes.get(hh)
+        with self._lock:
+            idx = self._indexes.get(hh)
         if idx is not None:
             return idx
         if os.path.exists(self._file_for(hh)):
             return self.index_for(hh)
         self.save(bucket)
         idx = DiskBucketIndex.from_bucket(bucket, self._file_for(hh))
-        self._indexes[hh] = idx
+        with self._lock:
+            idx = self._indexes.setdefault(hh, idx)
         return idx
 
     def index_for(self, hex_hash: str) -> Optional[DiskBucketIndex]:
@@ -147,30 +274,36 @@ class BucketListStore(BucketDir):
         store must have)."""
         if hex_hash == _EMPTY_HEX:
             return None
-        idx = self._indexes.get(hex_hash)
+        with self._lock:
+            idx = self._indexes.get(hex_hash)
         if idx is None:
             target = self._file_for(hex_hash)
             if not os.path.exists(target):
                 raise RuntimeError(f"missing bucket file for {hex_hash}")
             idx = DiskBucketIndex.build(target, expected_hex_hash=hex_hash)
-            self._indexes[hex_hash] = idx
+            with self._lock:
+                idx = self._indexes.setdefault(hex_hash, idx)
         return idx
 
     # -- snapshot pinning ----------------------------------------------------
     def pin(self, hex_hashes: Iterable[str]) -> None:
-        for hh in hex_hashes:
-            self._pins[hh] = self._pins.get(hh, 0) + 1
+        with self._lock:
+            for hh in hex_hashes:
+                self._pins[hh] = self._pins.get(hh, 0) + 1
 
     def unpin(self, hex_hashes: Iterable[str]) -> None:
-        for hh in hex_hashes:
-            n = self._pins.get(hh, 0) - 1
-            if n <= 0:
-                self._pins.pop(hh, None)
-            else:
-                self._pins[hh] = n
+        with self._lock:
+            for hh in hex_hashes:
+                n = self._pins.get(hh, 0) - 1
+                if n <= 0:
+                    self._pins.pop(hh, None)
+                else:
+                    self._pins[hh] = n
 
     def _protected_hashes(self) -> Set[str]:
-        return set(self._pins)
+        with self._lock:
+            return set(self._pins)
 
     def _on_removed(self, hex_hash: str) -> None:
-        self._indexes.pop(hex_hash, None)
+        with self._lock:
+            self._indexes.pop(hex_hash, None)

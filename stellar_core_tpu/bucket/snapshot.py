@@ -183,9 +183,17 @@ class SearchableBucketListSnapshot:
                 continue
             level = pos // 2
             if store is not None:
-                idx = store.ensure(bucket)
+                # a disk-resident bucket already carries its index
+                # (streaming-merge output / residency pass) — unify the
+                # seams: one _DiskView either way, no re-ensure scan
+                idx = (bucket.disk_index() if bucket.is_disk_resident()
+                       else store.ensure(bucket))
                 self._views.append((level, _DiskView(idx)))
                 self._pinned.append(bucket.hash().hex())
+            elif bucket.is_disk_resident():
+                # storeless view over a disk-resident bucket (tests,
+                # tools): serve from the file rather than rehydrating
+                self._views.append((level, _DiskView(bucket.disk_index())))
             else:
                 self._views.append((level, _ResidentView(bucket)))
         if store is not None:

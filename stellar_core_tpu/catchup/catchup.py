@@ -156,6 +156,11 @@ class PreverifyPipeline:
     # the pipeline stands down.
     RACE_CPU_S_PER_SIG = 40e-6
     MAX_CONSECUTIVE_LOSSES = 3
+    # collect-fallback warnings are rate-limited: a drifted chip can lose
+    # the CPU race on EVERY group (r5 bench logs: one warning per group),
+    # and the interesting signal is the first occurrence + the trend —
+    # which catchup.preverify.fallback and stats carry in full
+    FALLBACK_WARN_EVERY_N = 10
 
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
@@ -445,15 +450,23 @@ class PreverifyPipeline:
         first = not self._first_collect_done
         self._first_collect_done = True
         if not done or "error" in box:
-            log.warning(
+            n_fallbacks = self.stats.get("collect_fallbacks", 0) + 1
+            self.stats["collect_fallbacks"] = n_fallbacks
+            _registry().counter("catchup.preverify.fallback").inc()
+            # first occurrence + every Nth at WARNING (with the running
+            # count); the rest at DEBUG — the per-group counter metric
+            # above keeps the exact tally either way
+            emit = (log.warning if n_fallbacks == 1
+                    or n_fallbacks % self.FALLBACK_WARN_EVERY_N == 0
+                    else log.debug)
+            emit(
                 "preverify collect %s for checkpoints %s — falling back to "
-                "on-demand CPU verification",
+                "on-demand CPU verification (occurrence %d%s)",
                 ("lost the CPU race" if race_loss else "timed out")
                 if not done else f"failed: {box.get('error')}",
-                group["checkpoints"])
-            self.stats["collect_fallbacks"] = \
-                self.stats.get("collect_fallbacks", 0) + 1
-            _registry().counter("catchup.preverify.fallback").inc()
+                group["checkpoints"], n_fallbacks,
+                "" if n_fallbacks == 1 else
+                f"; warning logged every {self.FALLBACK_WARN_EVERY_N}th")
             if race_loss:
                 # the device is slower than libsodium on this group; the
                 # worker keeps running (its queue drains eventually) but
@@ -576,7 +589,8 @@ class CatchupManager:
                  accel_hot_threshold: int = 1 << 62,
                  native: Optional[bool] = None,
                  bucket_store=None,
-                 entry_cache_size: Optional[int] = None):
+                 entry_cache_size: Optional[int] = None,
+                 resident_levels: Optional[int] = None):
         """invariant_manager: None (default — the bench/hot replay path;
         the hash chain is the corruption *detector*) or an
         InvariantManager to also *localize* faults during replay and
@@ -595,7 +609,10 @@ class CatchupManager:
         LedgerManager this catchup builds runs in BucketListDB mode
         (`in_memory_ledger = false`): assumed/replayed state lives in
         indexed on-disk bucket files, reads go through the bounded
-        `entry_cache_size` LRU."""
+        `entry_cache_size` LRU, and bucket-list levels >=
+        `resident_levels` (config BUCKET_RESIDENT_LEVELS) stay
+        disk-resident — streaming decode-free merges, no decoded entry
+        lists."""
         self.network_id = network_id
         self.network_passphrase = network_passphrase
         self.accel = accel
@@ -604,6 +621,7 @@ class CatchupManager:
         self.invariant_manager = invariant_manager
         self.bucket_store = bucket_store
         self.entry_cache_size = entry_cache_size
+        self.resident_levels = resident_levels
         from ..ledger.native_apply import native_apply_available
         self.native = (native if native is not None else True) \
             and native_apply_available() and invariant_manager is None
@@ -661,7 +679,8 @@ class CatchupManager:
         mgr = LedgerManager(self.network_id,
                             invariant_manager=self.invariant_manager,
                             bucket_store=self.bucket_store,
-                            entry_cache_size=self.entry_cache_size)
+                            entry_cache_size=self.entry_cache_size,
+                            resident_levels=self.resident_levels)
         mgr.start_new_ledger()
         self._run_catchup_work(mgr, archive, target, clock, lookahead)
         return mgr
@@ -775,7 +794,8 @@ class CatchupManager:
         mgr = LedgerManager(self.network_id,
                             invariant_manager=self.invariant_manager,
                             bucket_store=self.bucket_store,
-                            entry_cache_size=self.entry_cache_size)
+                            entry_cache_size=self.entry_cache_size,
+                            resident_levels=self.resident_levels)
         mgr.start_new_ledger()  # scaffolding; replaced below
 
         hashes = has.bucket_hashes()

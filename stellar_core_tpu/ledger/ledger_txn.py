@@ -12,10 +12,10 @@ SearchableBucketListSnapshot over indexed bucket files, with a bounded
 entry cache).  This root mirrors the read architecture: in BucketListDB
 mode (constructed with a snapshot) every read goes through the snapshot's
 on-disk indexes and a bounded LRU entry cache, so the ROOT holds at most
-`entry_cache_size` decoded entries instead of one per live key.  (The
-BucketList levels themselves still keep decoded entries resident for the
-merge/hash pipeline; spilling those to the indexed files and rehydrating
-on merge is the next step — see ROADMAP.)  The legacy in-memory dict
+`entry_cache_size` decoded entries instead of one per live key.  (Phase 2:
+BucketList levels >= BUCKET_RESIDENT_LEVELS are disk-resident too — their
+buckets hold no decoded entries and merge via the streaming decode-free
+path, see bucket/bucket.py merge_buckets_raw.)  The legacy in-memory dict
 remains behind the `in_memory_ledger` config flag (the default for
 tests/sims — reference analog: the deprecated in-memory SQL ledger
 state).

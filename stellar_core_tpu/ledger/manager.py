@@ -57,7 +57,8 @@ DEFAULT_ENTRY_CACHE_SIZE = 4096   # mirrored by config.BUCKETLISTDB_ENTRY_CACHE_
 def assume_bucket_state(bucket_list, header: X.LedgerHeader,
                         bucket_source, next_source=None,
                         invariant_manager=None, store=None,
-                        entry_cache_size: int = DEFAULT_ENTRY_CACHE_SIZE
+                        entry_cache_size: int = DEFAULT_ENTRY_CACHE_SIZE,
+                        resident_levels: Optional[int] = None
                         ) -> LedgerTxnRoot:
     """Fill `bucket_list`'s levels from `bucket_source(hex_hash) -> Bucket`
     and build the authoritative root.  In-memory mode derives the entry
@@ -105,6 +106,15 @@ def assume_bucket_state(bucket_list, header: X.LedgerHeader,
     if bucket_list.hash() != header.bucketListHash:
         raise RuntimeError("assumed bucket list hash != header hash")
     if root is None:
+        # BucketListDB: persist + index the assumed buckets, then drop the
+        # decoded lists of levels >= the residency depth — the deep levels
+        # never stay O(ledger) in memory, even right after catchup
+        if bucket_list.store is None:
+            from ..bucket.bucket_list import DEFAULT_RESIDENT_LEVELS
+            bucket_list.configure_residency(
+                store, resident_levels if resident_levels is not None
+                else DEFAULT_RESIDENT_LEVELS)
+        bucket_list.enforce_residency()
         snap = bucket_list.snapshot(header.ledgerSeq, store=store)
         root = LedgerTxnRoot(header, snapshot=snap,
                              entry_cache_size=entry_cache_size)
@@ -123,7 +133,8 @@ class LedgerManager:
     def __init__(self, network_id: bytes,
                  invariant_manager=_DEFAULT_INVARIANTS,
                  merge_executor=None, bucket_store=None,
-                 entry_cache_size: Optional[int] = None):
+                 entry_cache_size: Optional[int] = None,
+                 resident_levels: Optional[int] = None):
         """invariant_manager: an InvariantManager, None to disable, or
         default = all invariants enabled (reference ships them off by
         default; this framework inverts that — fail-stop by default, opt
@@ -135,11 +146,21 @@ class LedgerManager:
         bucket_store: a bucket.manager.BucketListStore → BucketListDB mode
         (`in_memory_ledger = false`): the root reads through indexed
         on-disk bucket files with an LRU entry cache of
-        `entry_cache_size` entries; None → legacy in-memory dict root."""
+        `entry_cache_size` entries; None → legacy in-memory dict root.
+
+        resident_levels: BucketListDB residency depth (config
+        BUCKET_RESIDENT_LEVELS): levels >= it hold no decoded entries —
+        their buckets are served from indexed files and merged by the
+        streaming decode-free path."""
         self.network_id = network_id
         self.bucket_list = BucketList(executor=merge_executor)
         self.bucket_store = bucket_store
         self.entry_cache_size = entry_cache_size or DEFAULT_ENTRY_CACHE_SIZE
+        if bucket_store is not None:
+            from ..bucket.bucket_list import DEFAULT_RESIDENT_LEVELS
+            self.bucket_list.configure_residency(
+                bucket_store, resident_levels if resident_levels is not None
+                else DEFAULT_RESIDENT_LEVELS)
         self.root: Optional[LedgerTxnRoot] = None
         self.lcl_header: Optional[X.LedgerHeader] = None
         self.lcl_hash: Optional[bytes] = None
@@ -201,7 +222,10 @@ class LedgerManager:
     def _make_disk_root(self, header: X.LedgerHeader) -> LedgerTxnRoot:
         """Fresh disk-backed root over the CURRENT bucket list (genesis /
         native-engine export / rebuilds).  Replaces any previous root's
-        snapshot pins."""
+        snapshot pins.  Deep levels that (re)entered decoded — the native
+        export path deserializes every bucket — drop their entry lists
+        first."""
+        self.bucket_list.enforce_residency()
         snap = self.bucket_list.snapshot(header.ledgerSeq,
                                          store=self.bucket_store)
         if self.root is not None and self.root.disk_backed:
@@ -410,10 +434,13 @@ class LedgerManager:
                                        init_entries, live_entries, dead_keys)
             if self.root.disk_backed:
                 # the list just mutated: persist+index the changed buckets
-                # and swap the root onto the new view, then let GC reclaim
-                # files only old (released) snapshots referenced
+                # and swap the root onto the new view; deep levels drop any
+                # decoded entry lists (streaming-merge outputs already are
+                # disk-resident), then GC reclaims files only old
+                # (released) snapshots referenced
                 with tracing.span("bucket.snapshot"):
                     self._refresh_snapshot(seq)
+                self.bucket_list.enforce_residency()
                 self._maybe_gc_buckets(seq)
             header = ltx.load_header()
             header.bucketListHash = self.bucket_list.hash()
@@ -526,7 +553,8 @@ class LedgerManager:
     def load_last_known_ledger(cls, network_id: bytes, database, bucket_dir,
                                invariant_manager=_DEFAULT_INVARIANTS,
                                bucket_store=None,
-                               entry_cache_size: Optional[int] = None
+                               entry_cache_size: Optional[int] = None,
+                               resident_levels: Optional[int] = None
                                ) -> "LedgerManager":
         """Rebuild a manager from durable state (reference:
         LedgerManagerImpl::loadLastKnownLedger): header from the DB, bucket
@@ -555,7 +583,8 @@ class LedgerManager:
 
         mgr = cls(network_id, invariant_manager=invariant_manager,
                   bucket_store=bucket_store,
-                  entry_cache_size=entry_cache_size)
+                  entry_cache_size=entry_cache_size,
+                  resident_levels=resident_levels)
         hashes = has.bucket_hashes()
         if len(hashes) != NUM_LEVELS * 2:
             raise RuntimeError("stored HAS malformed")

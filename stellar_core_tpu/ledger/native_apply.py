@@ -36,7 +36,9 @@ def native_apply_available() -> bool:
 
 
 def _bucket_tuple(bucket: Bucket):
-    return (bucket.sort_keys(), bucket.packed_entries(),
+    # raw_records: a disk-resident bucket slices its file (transient list,
+    # nothing cached) — no BucketEntry decode on the import path
+    return (bucket.sort_keys(), bucket.raw_records(),
             bucket.protocol_version)
 
 

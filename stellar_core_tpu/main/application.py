@@ -77,20 +77,23 @@ class Application:
 
         # ledger ------------------------------------------------------------
         cache_size = config.BUCKETLISTDB_ENTRY_CACHE_SIZE
+        resident = config.BUCKET_RESIDENT_LEVELS
         if self.database is not None and self.database.get_state(
                 PersistentState.LAST_CLOSED_LEDGER) is not None:
             self.lm = LedgerManager.load_last_known_ledger(
                 self.network_id, self.database, self.bucket_dir,
                 invariant_manager=invariants,
                 bucket_store=self.bucket_store,
-                entry_cache_size=cache_size)
+                entry_cache_size=cache_size,
+                resident_levels=resident)
             self.lm.bucket_list.executor = self.worker_pool
         else:
             self.lm = LedgerManager(self.network_id,
                                     invariant_manager=invariants,
                                     merge_executor=self.worker_pool,
                                     bucket_store=self.bucket_store,
-                                    entry_cache_size=cache_size)
+                                    entry_cache_size=cache_size,
+                                    resident_levels=resident)
             self.lm.start_new_ledger()
             if self.database is not None:
                 self.lm.enable_persistence(self.database, self.bucket_dir)
@@ -136,7 +139,8 @@ class Application:
             accel=config.ACCEL == "tpu",
             accel_chunk=config.ACCEL_CHUNK_SIZE,
             bucket_store=self.bucket_store,
-            entry_cache_size=cache_size)
+            entry_cache_size=cache_size,
+            resident_levels=resident)
 
         # maintenance -------------------------------------------------------
         from .maintainer import Maintainer

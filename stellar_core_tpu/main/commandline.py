@@ -110,7 +110,8 @@ def cmd_catchup(args) -> int:
                         accel_chunk=cfg.ACCEL_CHUNK_SIZE,
                         invariant_manager=inv,
                         bucket_store=store,
-                        entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE)
+                        entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE,
+                        resident_levels=cfg.BUCKET_RESIDENT_LEVELS)
     at = None
     if args.at and args.at != "current":
         try:

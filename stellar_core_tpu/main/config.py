@@ -57,6 +57,12 @@ class Config:
     # true keeps the legacy in-memory dict root (tests/sims).
     IN_MEMORY_LEDGER: bool = True
     BUCKETLISTDB_ENTRY_CACHE_SIZE: int = 4096  # LRU entries in LedgerTxnRoot
+    # BucketListDB residency depth (phase 2): bucket-list levels >= this
+    # hold NO decoded entries — they are served from indexed bucket files
+    # and merged by the streaming decode-free path.  Levels below it stay
+    # decoded (level 0 merges synchronously inside every close).  Raising
+    # it trades memory for fewer file reads; NUM_LEVELS disables eviction.
+    BUCKET_RESIDENT_LEVELS: int = 2
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HISTORY: List[HistoryArchiveConfig] = field(default_factory=list)
 
@@ -112,7 +118,7 @@ class Config:
             "PEER_PORT", "HTTP_PORT",
             "KNOWN_PEERS", "TARGET_PEER_CONNECTIONS", "DATABASE",
             "BUCKET_DIR_PATH", "IN_MEMORY_LEDGER",
-            "BUCKETLISTDB_ENTRY_CACHE_SIZE",
+            "BUCKETLISTDB_ENTRY_CACHE_SIZE", "BUCKET_RESIDENT_LEVELS",
             "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",

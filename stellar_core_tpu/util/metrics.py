@@ -70,7 +70,12 @@ CANONICAL_METRICS = frozenset({
     "catchup.preverify.fallback",
     # bucket
     "bucket.merge.time",
+    "bucket.merge.stream",
+    "bucket.merge.bytes",
     "bucket.batch.addtime",
+    "bucket.rehydrate",
+    "bucket.rehydrate.entries",
+    "bucket.resident.entries",
     # bucketlistdb (disk-backed ledger-entry reads)
     "bucketlistdb.load",
     "bucketlistdb.prefetch",
