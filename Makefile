@@ -1,9 +1,19 @@
-.PHONY: native test metrics bucketdb bucketdb-slow clean
+.PHONY: native test lint metrics bucketdb bucketdb-slow clean
 
 native:
 	python setup.py build_ext --inplace
 
-test:
+# corelint: project-native static analysis (clock discipline, LedgerTxn
+# paths, decode-free seam, exception hygiene, metric registry, lock
+# order).  LINT_BASELINE.json ratchets the explicit suppressions: new
+# violations OR new suppressions fail; regenerate the baseline with
+# `python -m stellar_core_tpu.lint --write-baseline LINT_BASELINE.json`
+# only after justifying the new suppression in review.
+lint:
+	env JAX_PLATFORMS=cpu python -m stellar_core_tpu.lint \
+		--baseline LINT_BASELINE.json
+
+test: lint
 	python -m pytest tests/ -q
 
 # BucketListDB differential suite: on-disk index round-trip + corruption

@@ -213,6 +213,38 @@ def build_archive(nid, passphrase, path, n_payment_ledgers=110,
     return archive, mgr
 
 
+def bench_lint():
+    """corelint wall time + per-rule counts over the full tree: the
+    static-analysis gate runs on every `make test`, so its cost must stay
+    a rounding error as the tree grows (ISSUE 4 satellite)."""
+    from stellar_core_tpu.lint import (DEFAULT_TARGETS, all_rules,
+                                       check_baseline, load_baseline,
+                                       run_paths)
+    root = os.path.dirname(os.path.abspath(__file__))
+    targets = [os.path.join(root, t) for t in DEFAULT_TARGETS]
+    t0 = time.perf_counter()
+    rep = run_paths(targets, all_rules(), root=root)
+    wall = time.perf_counter() - t0
+    # parse errors and baseline-ratchet drift fail `make lint` too —
+    # count them so this row can never read clean while the gate is red
+    ratchet = []
+    bl_path = os.path.join(root, "LINT_BASELINE.json")
+    if os.path.exists(bl_path):
+        ratchet = check_baseline(rep, load_baseline(bl_path))
+    return {
+        "lint_wall_s": round(wall, 3),
+        "lint_files": rep.files_scanned,
+        "lint_files_per_sec": round(rep.files_scanned / wall, 1)
+        if wall > 0 else 0.0,
+        "lint_violations": len(rep.violations) + len(rep.parse_errors)
+        + len(ratchet),
+        "lint_parse_errors": len(rep.parse_errors),
+        "lint_ratchet_problems": len(ratchet),
+        "lint_suppressed": len(rep.suppressed),
+        "lint_rule_counts": rep.counts_by_rule(),
+    }
+
+
 def bench_merge_throughput(workdir):
     """ISSUE 3 acceptance: streaming-merge throughput.  Two synthetic
     buckets (disjoint + colliding keys) merged by the decoded path and by
@@ -670,6 +702,13 @@ def main():
     extra = {"bench_budget_s": BENCH_BUDGET_S}
     value = vs = 0.0
 
+    # corelint is pure CPU and cheap (~1s for the current tree): measure
+    # it first so the gate's cost trend is in every report
+    _stage("corelint bench...")
+    lint_vals = bench_lint()
+    _cache_put("lint", lint_vals)
+    extra.update(lint_vals)
+
     # BucketListDB differential runs on CPU — measure it before touching
     # the (occasionally wedged) device so the numbers exist either way
     if budget_fits("bucketlistdb", 240):
@@ -810,6 +849,6 @@ if __name__ == "__main__":
         main()
     except AssertionError:
         raise  # correctness claims (identical hashes/verdicts) never retry
-    except Exception as e:  # transient tunnel/compile flakes: one retry
+    except Exception as e:  # corelint: disable=exception-hygiene -- transient tunnel/compile flake: one retry
         print(f"[bench] retrying after: {e}", file=sys.stderr, flush=True)
         main()

@@ -51,7 +51,7 @@ def ensure_native(quiet=True):
             cwd=_REPO, capture_output=True, text=True, timeout=300)
         if res.returncode != 0 and not quiet:
             sys.stderr.write(res.stdout + res.stderr)
-    except Exception as e:  # missing compiler etc. — fall back to Python
+    except Exception as e:  # corelint: disable=exception-hygiene -- missing compiler: fall back to Python
         if not quiet:
             sys.stderr.write(f"native build failed: {e}\n")
     return not _stale()

@@ -16,11 +16,11 @@ shared-ptr liveness feeding forgetUnreferencedBuckets).
 from __future__ import annotations
 
 import os
-import threading
 import uuid
 from typing import Dict, Iterable, List, Optional, Set
 
 from ..crypto.sha import SHA256
+from ..util.lockorder import make_rlock
 from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
 
@@ -195,7 +195,7 @@ class BucketListStore(BucketDir):
         # while the close path reads/pins/GCs on the main thread; reentrant
         # because gc() holds it across the scan and _protected_hashes()
         # re-acquires
-        self._lock = threading.RLock()
+        self._lock = make_rlock("bucket.store")
 
     # -- streaming merge output ----------------------------------------------
     def stream_writer(self, protocol_version: int) -> BucketStreamWriter:

@@ -24,10 +24,10 @@ sees either way.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
+from ..util.lockorder import make_lock
 from ..util.metrics import registry as _registry
 from ..xdr import LedgerEntry
 from .bucket import _BE, Bucket, _is_dead
@@ -88,7 +88,7 @@ class _DiskView:
     def __init__(self, index):
         self.index = index
         self._f = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("bucket.snapshot-file")
 
     def _read(self, off: int, end: int) -> bytes:
         with self._lock:
@@ -221,7 +221,7 @@ class SearchableBucketListSnapshot:
     def __del__(self):  # best-effort: a leaked snapshot must not leak pins
         try:
             self.release()
-        except Exception:
+        except Exception:  # corelint: disable=exception-hygiene -- destructor cleanup must never raise
             pass
 
     # -- point reads ---------------------------------------------------------

@@ -198,7 +198,7 @@ class PreverifyPipeline:
                     jfn, jbox, jev = item
                     try:
                         jbox["result"] = jfn()
-                    except BaseException as e:  # surfaced at collect()
+                    except BaseException as e:  # corelint: disable=exception-hygiene -- verdict box re-raised at collect()
                         jbox["error"] = e
                     jev.set()
 

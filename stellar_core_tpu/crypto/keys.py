@@ -8,13 +8,13 @@ hash(sig‖key‖msg)), KeyUtils; src/crypto/SignerKey.h.
 from __future__ import annotations
 
 import os
-import threading
 from dataclasses import dataclass
 from typing import Optional
 
 from . import sodium, strkey
 from .sha import sha256
 from ..util.cache import RandomEvictionCache
+from ..util.lockorder import make_lock
 from ..util.metrics import registry as _registry
 
 VERIFY_CACHE_SIZE = 0x10000  # reference: 64k-entry verify cache
@@ -84,7 +84,7 @@ class SecretKey:
 class _VerifyCache:
     def __init__(self) -> None:
         self._cache: RandomEvictionCache[tuple, bool] = RandomEvictionCache(VERIFY_CACHE_SIZE)
-        self._lock = threading.Lock()
+        self._lock = make_lock("crypto.verify-cache")
 
     @staticmethod
     def key(sig: bytes, pk: bytes, msg: bytes) -> tuple:

@@ -157,8 +157,8 @@ class Herder(SCPDriver):
         try:
             if sha256(txset.to_xdr()) != txset_hash:
                 return False
-        except Exception:
-            return False
+        except X.XdrError:
+            return False  # unencodable peer tx set == hash mismatch
         try:
             frames = [self.lm.make_frame(e) for e in txset.txs]
         except Exception:
@@ -257,7 +257,7 @@ class Herder(SCPDriver):
     def _decode_value(self, value: bytes) -> Optional[X.StellarValue]:
         try:
             return X.StellarValue.from_xdr(value)
-        except Exception:
+        except X.XdrError:
             return None
 
     def validate_value(self, slot_index: int, value: bytes,
@@ -341,7 +341,7 @@ class Herder(SCPDriver):
             for u in sv.upgrades:
                 try:
                     up = X.LedgerUpgrade.from_xdr(u)
-                except Exception:
+                except X.XdrError:
                     continue
                 t = int(up.switch)
                 cur = upgrades_by_type.get(t)
@@ -382,7 +382,9 @@ class Herder(SCPDriver):
                 keys.PublicKey(envelope.statement.nodeID.value),
                 envelope.signature,
                 self._envelope_payload(envelope.statement))
-        except Exception:
+        except ValueError:
+            # malformed nodeID / unencodable statement (XdrError IS-A
+            # ValueError): verification fails
             return False
 
     def setup_timer(self, slot_index: int, timer_id: int, timeout: float,

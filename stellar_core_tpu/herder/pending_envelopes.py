@@ -70,7 +70,7 @@ def statement_txset_hashes(st) -> List[bytes]:
         try:
             sv = X.StellarValue.from_xdr(v)
             out.append(sv.txSetHash)
-        except Exception:
+        except X.XdrError:
             pass
     return out
 

@@ -90,7 +90,7 @@ class Upgrades:
         Reference: Upgrades::isValid / isValidForApply."""
         try:
             up = X.LedgerUpgrade.from_xdr(upgrade_bytes)
-        except Exception:
+        except X.XdrError:
             return False
         if not self._valid_for_apply(up, header):
             return False
@@ -170,7 +170,7 @@ class Upgrades:
     def describe(upgrade_bytes: bytes) -> str:
         try:
             up = X.LedgerUpgrade.from_xdr(upgrade_bytes)
-        except Exception:
+        except X.XdrError:
             return "<malformed>"
         t = up.switch
         if t == UT.LEDGER_UPGRADE_VERSION:

@@ -1,0 +1,22 @@
+"""corelint — project-native static analysis for stellar-core-tpu.
+
+Encodes the repo's cross-PR invariants as AST checks (see rules/):
+
+  clock-discipline   VirtualClock-only time outside util/clock, util/perf
+  ledger-txn-paths   every LedgerTxn reaches commit()/rollback()
+  decode-free-seam   the raw-record path never rehydrates BucketEntry
+  exception-hygiene  no silently swallowed `except Exception`
+  metric-registry    static layer.subsystem.event + canonical-list check
+  lock-order         cycle-free static lock-acquisition graph
+
+Run `python -m stellar_core_tpu.lint` (or `make lint`); suppress a
+finding with `# corelint: disable=<rule> -- reason` — suppressions are
+ratcheted by LINT_BASELINE.json.
+"""
+
+from .core import (FileContext, LintReport, Rule, Violation,  # noqa: F401
+                   check_baseline, load_baseline, render_human,
+                   render_json, run_paths, write_baseline)
+from .rules import ALL_RULE_CLASSES, all_rules, rules_by_id  # noqa: F401
+
+DEFAULT_TARGETS = ("stellar_core_tpu", "bench.py")

@@ -327,6 +327,7 @@ class Application:
             env = X.TransactionEnvelope.from_xdr(envelope_xdr)
             frame = self.lm.make_frame(env)
         except Exception as e:
+            log.debug("rejecting submitted tx: %s", e)
             return {"status": "ERROR", "detail": f"malformed: {e}"}
         res = self.herder.recv_transaction(frame)
         out = {"status": res.code.upper()}

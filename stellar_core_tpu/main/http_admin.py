@@ -60,6 +60,7 @@ class CommandHandler:
                 if isinstance(out, dict):
                     result.update(out)
             except Exception as e:
+                log.debug("admin action %s failed: %s", name, e)
                 result.update({"status": "ERROR", "detail": str(e)})
             done.set()
 
@@ -231,6 +232,7 @@ class CommandHandler:
                         self._reply({"error": "unknown endpoint",
                                      "endpoints": sorted(_ENDPOINTS)}, 404)
                 except Exception as e:  # admin surface must never crash
+                    log.warning("admin request failed: %s", e)
                     self._reply({"error": str(e)}, 500)
 
             def _log_level(self, qs) -> None:

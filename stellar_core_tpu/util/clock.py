@@ -25,6 +25,22 @@ class ClockMode(Enum):
     VIRTUAL_TIME = 1
 
 
+def monotonic_now() -> float:
+    """Real monotonic seconds — the blessed escape hatch for *infra*
+    timing (metric rate windows, reservoir decay) that must track the
+    host clock even under VIRTUAL_TIME.  Subsystem logic must go through
+    a VirtualClock; corelint's clock-discipline rule enforces that this
+    module (plus util/perf.py and bench.py) is the only wall-clock seam."""
+    return _time.monotonic()
+
+
+def wall_now() -> float:
+    """Real wall-clock epoch seconds — the infra-level counterpart of
+    system_now() for export timestamps (Chrome trace epochs, bench
+    cache ages).  Same discipline as monotonic_now()."""
+    return _time.time()
+
+
 class VirtualClock:
     def __init__(self, mode: ClockMode = ClockMode.VIRTUAL_TIME) -> None:
         self.mode = mode

@@ -24,10 +24,12 @@ import heapq
 import math
 import random
 import re
-import threading
 import time
 import weakref
 from typing import Callable, Dict, List, Optional
+
+from .clock import monotonic_now
+from .lockorder import make_lock
 
 METRIC_NAME_RE = re.compile(r"^[a-z0-9]+(\.[a-z0-9-]+)+$")
 
@@ -138,7 +140,7 @@ class Gauge:
             return None
         try:
             return float(self._fn())
-        except Exception:
+        except Exception:  # corelint: disable=exception-hygiene -- dead gauge reads as null, never breaks /metrics
             return None
 
     def reset(self) -> None:
@@ -161,7 +163,7 @@ class Meter:
 
     def reset(self) -> None:
         self.count = 0
-        self._t0 = time.monotonic()
+        self._t0 = monotonic_now()
         self._win_start = self._t0
         self._win_count = 0
         self._last_rate = 0.0
@@ -170,7 +172,7 @@ class Meter:
     def mark(self, n: int = 1) -> None:
         self.count += n
         self._win_count += n
-        now = time.monotonic()
+        now = monotonic_now()
         if now - self._win_start >= self.WINDOW:
             self._last_rate = self._win_count / (now - self._win_start)
             self._win_start = now
@@ -181,7 +183,7 @@ class Meter:
         """Rate over the trailing window, INCLUDING the in-progress one:
         the old behavior reported 0.0 until a full 60s window elapsed and
         then froze between marks."""
-        now = time.monotonic()
+        now = monotonic_now()
         elapsed = now - self._win_start
         if elapsed >= self.WINDOW:
             # window overdue (no mark rolled it): everything we know about
@@ -197,7 +199,7 @@ class Meter:
                 + self._last_rate * (self.WINDOW - elapsed)) / self.WINDOW
 
     def snapshot(self) -> dict:
-        lifetime = time.monotonic() - self._t0
+        lifetime = monotonic_now() - self._t0
         return {"type": "meter", "count": self.count,
                 "mean_rate": round(self.count / lifetime, 3)
                 if lifetime > 0 else 0.0,
@@ -216,12 +218,12 @@ class _ExpDecayReservoir:
         self.size = size
         self.alpha = alpha
         self._heap: List = []  # (priority, tiebreak, value)
-        self._t0 = time.monotonic()
+        self._t0 = monotonic_now()
         self._next_rescale = self._t0 + self.RESCALE_INTERVAL
         self._rng = random.Random(0x5747)
 
     def update(self, value: float) -> None:
-        now = time.monotonic()
+        now = monotonic_now()
         if now >= self._next_rescale:
             self._rescale(now)
         priority = math.exp(self.alpha * (now - self._t0)) \
@@ -262,7 +264,7 @@ class Histogram:
     __slots__ = ("count", "total", "max", "min", "_reservoir", "_lock")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.histogram")
         self._init_state()
 
     def _init_state(self) -> None:
@@ -346,7 +348,7 @@ class MetricsRegistry:
         # threads (worker-pool bucket merges, the preverify device
         # worker): without the lock, concurrent first-touch of a name
         # makes two objects and silently drops one's samples
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
 
     def _get(self, name: str, cls, exact: bool = False):
         m = self._metrics.get(name)

@@ -37,6 +37,9 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from .clock import wall_now
+from .lockorder import make_lock
+
 TRACE_BUFFER_SPANS = 64
 # Per-parent child cap: a replay crank can hold thousands of tx.apply
 # leaves per ledger; beyond this the tail is elided (the span records how
@@ -57,7 +60,7 @@ _tree_count: contextvars.ContextVar[Optional[list]] = \
     contextvars.ContextVar("stpu_tree_count", default=None)
 
 # one wall-clock anchor so ts values in an export share an epoch
-_EPOCH_WALL = time.time()
+_EPOCH_WALL = wall_now()
 _EPOCH_PERF = time.perf_counter()
 
 
@@ -92,7 +95,7 @@ class TraceBuffer:
 
     def __init__(self, maxlen: int = TRACE_BUFFER_SPANS):
         self._roots: deque = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracing.buffer")
 
     def record(self, root: Span) -> None:
         with self._lock:

@@ -1,0 +1,944 @@
+"""corelint suite tests: every checker proven to fire AND to stay quiet,
+suppression round-trip, the whole-tree clean gate, the baseline ratchet,
+and the runtime lock-order tracer.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from stellar_core_tpu.lint import (all_rules, check_baseline, load_baseline,
+                                   run_paths, rules_by_id, write_baseline)
+from stellar_core_tpu.util import lockorder
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, relpath, src, rule_ids=None):
+    """Write `src` at tmp_path/relpath and lint it in isolation."""
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    rules = rules_by_id(rule_ids) if rule_ids else all_rules()
+    return run_paths([str(tmp_path)], rules, root=str(tmp_path))
+
+
+def rule_hits(report, rule_id):
+    return [v for v in report.violations if v.rule == rule_id]
+
+
+# ---------------------------------------------------------------------------
+# clock-discipline
+# ---------------------------------------------------------------------------
+
+class TestClockDiscipline:
+    def test_fires_on_time_time(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/mod.py", """
+            import time
+            def f():
+                return time.time()
+            """, ["clock-discipline"])
+        assert len(rule_hits(rep, "clock-discipline")) == 1
+
+    def test_fires_on_aliased_monotonic(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/mod.py", """
+            import time as _t
+            x = _t.monotonic()
+            """, ["clock-discipline"])
+        assert len(rule_hits(rep, "clock-discipline")) == 1
+
+    def test_fires_on_from_import_and_datetime_now(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/mod.py", """
+            from time import monotonic
+            from datetime import datetime
+            a = monotonic()
+            b = datetime.now()
+            """, ["clock-discipline"])
+        assert len(rule_hits(rep, "clock-discipline")) == 2
+
+    def test_fires_on_ns_variants(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/mod.py", """
+            import time
+            a = time.time_ns()
+            b = time.monotonic_ns()
+            """, ["clock-discipline"])
+        assert len(rule_hits(rep, "clock-discipline")) == 2
+
+    def test_quiet_in_allowed_files_and_on_perf_counter(self, tmp_path):
+        allowed = """
+            import time
+            t = time.time()
+            """
+        rep = lint_src(tmp_path, "stellar_core_tpu/util/clock.py", allowed,
+                       ["clock-discipline"])
+        assert not rule_hits(rep, "clock-discipline")
+        rep = lint_src(tmp_path, "bench.py", allowed, ["clock-discipline"])
+        assert not rule_hits(rep, "clock-discipline")
+        rep = lint_src(tmp_path, "pkg/mod.py", """
+            import time
+            t = time.perf_counter()  # durations are fine
+            s = self_time()          # unrelated name
+            """, ["clock-discipline"])
+        assert not rule_hits(rep, "clock-discipline")
+
+    def test_allowlist_robust_to_root_above_repo(self, tmp_path):
+        # relpaths carry extra leading segments when --root sits above the
+        # repo; the allowlist must still exempt the blessed files
+        p = tmp_path / "repo" / "stellar_core_tpu" / "util" / "clock.py"
+        p.parent.mkdir(parents=True)
+        p.write_text("import time\nt = time.time()\n")
+        rep = run_paths([str(tmp_path)], rules_by_id(["clock-discipline"]),
+                        root=str(tmp_path))
+        assert not rule_hits(rep, "clock-discipline")
+        # ...but a mere filename collision is NOT exempt
+        q = tmp_path / "repo" / "workbench.py"
+        q.write_text("import time\nt = time.time()\n")
+        rep = run_paths([str(q)], rules_by_id(["clock-discipline"]),
+                        root=str(tmp_path))
+        assert len(rule_hits(rep, "clock-discipline")) == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger-txn-paths
+# ---------------------------------------------------------------------------
+
+class TestLedgerTxnPaths:
+    def test_fires_on_early_return_without_close(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, bad):
+                ltx = LedgerTxn(root)
+                if bad:
+                    return None     # leaks the open txn
+                ltx.commit()
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_fires_on_fall_off_end(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                ltx.load_header()
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_fires_on_branch_missing_close(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, ok):
+                ltx = LedgerTxn(root)
+                if ok:
+                    ltx.commit()
+                else:
+                    pass            # this arm leaks
+                return 1
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_quiet_on_all_paths_closed(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, ok):
+                ltx = LedgerTxn(root)
+                if ok:
+                    ltx.commit()
+                    return 1
+                ltx.rollback()
+                return 0
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_context_manager(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                with LedgerTxn(root) as ltx:
+                    ltx.create(1)
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_try_finally(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, bad):
+                ltx = LedgerTxn(root)
+                try:
+                    if bad:
+                        return None   # finally still closes
+                    work(ltx)
+                finally:
+                    ltx.rollback()
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_except_reraise_with_open_guard(self, tmp_path):
+        # the transactions/frame.py shape
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                inner = LedgerTxn(root)
+                try:
+                    if early():
+                        inner.rollback()
+                        return 2
+                    inner.commit()
+                    return 1
+                except Exception:
+                    if inner._open:
+                        inner.rollback()
+                    raise
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_fires_when_open_guard_body_does_not_close(self, tmp_path):
+        # `if x._open:` alone must not silence the rule — only a body
+        # that actually closes does
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                if ltx._open:
+                    log.warning("still open")
+                return 1
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_fires_on_local_alias_without_close(self, tmp_path):
+        # a plain local rebinding is not an ownership escape
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                tmp = ltx
+                return 1
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_quiet_on_raise_caught_and_closed_by_handler(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                try:
+                    if bad():
+                        raise ValueError("boom")
+                    ltx.commit()
+                    return 1
+                except Exception:
+                    ltx.rollback()
+                    return None
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_fires_on_raise_past_narrow_handler(self, tmp_path):
+        # a typed handler may not match: the raise can still escape open
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                try:
+                    raise KeyError("boom")
+                except ValueError:
+                    ltx.rollback()
+                    return None
+                ltx.commit()
+                return 1
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_many_sequential_branches_complete_fast(self, tmp_path):
+        # one-bit state must not explode 2^n across sequential ifs
+        branches = "\n".join(
+            f"    if cond({i}):\n        note({i})" for i in range(60))
+        src = ("def f(root):\n"
+               "    ltx = LedgerTxn(root)\n"
+               f"{branches}\n"
+               "    ltx.commit()\n")
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        import time as _t
+        t0 = _t.perf_counter()
+        rep = run_paths([str(p)], rules_by_id(["ledger-txn-paths"]),
+                        root=str(tmp_path))
+        assert _t.perf_counter() - t0 < 5.0
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_fires_on_conditionally_evaluated_close(self, tmp_path):
+        # a close in a short-circuit / ternary position is not certain
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, ok):
+                ltx = LedgerTxn(root)
+                return ok and ltx.commit()
+
+            def g(root, ok):
+                ltx = LedgerTxn(root)
+                return ltx.commit() if ok else None
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 2
+
+    def test_quiet_on_close_in_unconditional_position(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                return note(ltx.commit())
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_binding_inside_try_with_enclosing_handler(
+            self, tmp_path):
+        # a raise caught by the ENCLOSING handler is not a function exit
+        # for a binding that lives inside the try body
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                try:
+                    ltx = LedgerTxn(root)
+                    do_work(ltx)
+                    raise RetryError()
+                except RetryError:
+                    ltx.rollback()
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_nested_closure_closer(self, tmp_path):
+        # the offer_ops.py use_pool() shape
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, alt):
+                book = LedgerTxn(root)
+                def use_pool():
+                    book.rollback()
+                    return 2
+                if alt:
+                    return use_pool()
+                book.commit()
+                return 1
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_quiet_on_while_true_commit_break(self, tmp_path):
+        # `while True` has no zero-iteration path; break carries its state
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                while True:
+                    if step(ltx):
+                        ltx.commit()
+                        break
+                return 1
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+    def test_fires_on_plain_loop_that_may_not_run(self, tmp_path):
+        # a zero-iteration for loop never reaches the commit
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, items):
+                ltx = LedgerTxn(root)
+                for it in items:
+                    ltx.commit()
+                    break
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_fires_on_annotated_binding(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root, bad):
+                ltx: LedgerTxn = LedgerTxn(root)
+                if bad:
+                    return None     # leaks the open txn
+                ltx.commit()
+            """, ["ledger-txn-paths"])
+        assert len(rule_hits(rep, "ledger-txn-paths")) == 1
+
+    def test_quiet_on_ownership_escape(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(root):
+                ltx = LedgerTxn(root)
+                return ltx            # caller owns it now
+            """, ["ledger-txn-paths"])
+        assert not rule_hits(rep, "ledger-txn-paths")
+
+
+# ---------------------------------------------------------------------------
+# decode-free-seam
+# ---------------------------------------------------------------------------
+
+class TestDecodeFreeSeam:
+    def test_fires_on_entries_in_merge_raw(self, tmp_path):
+        rep = lint_src(tmp_path, "stellar_core_tpu/bucket/bucket.py", """
+            def merge_buckets_raw(old, new, keep, proto, store):
+                for e in old.entries:     # rehydrates!
+                    pass
+            """, ["decode-free-seam"])
+        assert len(rule_hits(rep, "decode-free-seam")) == 1
+
+    def test_fires_on_bucketentry_in_stream_writer(self, tmp_path):
+        rep = lint_src(tmp_path, "stellar_core_tpu/bucket/manager.py", """
+            class BucketStreamWriter:
+                def write(self, key, rec):
+                    be = BucketEntry.liveEntry(rec)   # decoded construction
+                    self.out.append(be)
+            """, ["decode-free-seam"])
+        assert len(rule_hits(rep, "decode-free-seam")) == 1
+
+    def test_fires_anywhere_in_native_bridge(self, tmp_path):
+        rep = lint_src(tmp_path, "stellar_core_tpu/ledger/native_apply.py",
+                       """
+            def export(bucket):
+                return bucket.entries
+            """, ["decode-free-seam"])
+        assert len(rule_hits(rep, "decode-free-seam")) == 1
+
+    def test_quiet_outside_scopes_and_on_raw_use(self, tmp_path):
+        # .entries outside the raw scopes is fine
+        rep = lint_src(tmp_path, "stellar_core_tpu/bucket/bucket.py", """
+            def merge_buckets(old, new):
+                return old.entries + new.entries
+
+            def merge_buckets_raw(old, new, keep, proto, store):
+                w = store.stream_writer(proto)
+                for k, rec in old.iter_raw():
+                    w.write(k, rec)
+                return w.finalize()
+            """, ["decode-free-seam"])
+        assert not rule_hits(rep, "decode-free-seam")
+
+
+# ---------------------------------------------------------------------------
+# exception-hygiene
+# ---------------------------------------------------------------------------
+
+class TestExceptionHygiene:
+    def test_fires_on_silent_swallow(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+            """, ["exception-hygiene"])
+        assert len(rule_hits(rep, "exception-hygiene")) == 1
+
+    def test_fires_on_bare_except(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f():
+                try:
+                    g()
+                except:
+                    return None
+            """, ["exception-hygiene"])
+        assert len(rule_hits(rep, "exception-hygiene")) == 1
+
+    def test_quiet_on_narrow_log_raise_and_failure_sink(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f():
+                try:
+                    g()
+                except ValueError:
+                    pass              # narrow: fine
+                try:
+                    g()
+                except Exception as e:
+                    log.warning("boom: %s", e)
+                try:
+                    g()
+                except Exception:
+                    raise
+                try:
+                    g()
+                except Exception as e:
+                    return self._fail(str(e))
+            """, ["exception-hygiene"])
+        assert not rule_hits(rep, "exception-hygiene")
+
+
+# ---------------------------------------------------------------------------
+# metric-registry
+# ---------------------------------------------------------------------------
+
+class TestMetricRegistry:
+    def test_fires_on_malformed_name(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            registry().counter("NotDotted")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
+    def test_fires_on_non_canonical_name(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            registry().timer("ledger.made.up-name")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
+    def test_fires_on_unpinned_fstring(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(reg, level):
+                reg.counter(f"made-up.{level}")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
+    def test_quiet_on_canonical_prefix_and_dynamic(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            def f(reg, level, name):
+                reg.timer("ledger.ledger.close")
+                reg.counter(f"bucketlistdb.probe.level-{level}")
+                reg.meter(name)            # dynamic: skipped
+                with scoped_timer("bucket.merge.time"):
+                    pass
+            """, ["metric-registry"])
+        assert not rule_hits(rep, "metric-registry")
+
+    def test_fires_on_keyword_name_argument(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            registry().timer(name="totally.bogus.name")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
+    def test_fires_on_bad_scoped_timer_literal(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            with scoped_timer("not.a.canonical-name"):
+                pass
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-order (static)
+# ---------------------------------------------------------------------------
+
+class TestLockOrderStatic:
+    def test_fires_on_lexical_abba(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """, ["lock-order"])
+        assert rule_hits(rep, "lock-order")
+
+    def test_fires_on_call_graph_cycle(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def outer(self):
+                    with self._a_lock:
+                        self.helper()
+                def helper(self):
+                    with self._b_lock:
+                        pass
+                def inverted(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """, ["lock-order"])
+        assert rule_hits(rep, "lock-order")
+
+    def test_fires_on_multi_item_with_abba(self, tmp_path):
+        # `with a, b:` orders a before b — inverting it is still a cycle
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def one(self):
+                    with self._a_lock, self._b_lock:
+                        pass
+                def two(self):
+                    with self._b_lock, self._a_lock:
+                        pass
+            """, ["lock-order"])
+        assert rule_hits(rep, "lock-order")
+
+    def test_fires_on_cross_object_abba_with_type_evidence(self, tmp_path):
+        # self._lock vs other._lock resolved via annotation / constructor
+        rep = lint_src(tmp_path, "m.py", """
+            class Snap:
+                def grab(self, store: "Store"):
+                    pass
+
+            class Store:
+                def one(self, snap: Snap):
+                    with self._lock:
+                        with snap._lock:
+                            pass
+
+            class Snap2(Snap):
+                pass
+
+            def two(store_arg):
+                store = Store()
+                snap = Snap()
+                with snap._lock:
+                    with store._lock:
+                        pass
+            """, ["lock-order"])
+        assert rule_hits(rep, "lock-order")
+
+    def test_unresolvable_receiver_is_not_collapsed(self, tmp_path):
+        # an unknown receiver must be its own node: no self-edge dropping
+        # (missed cycles) and no merging with the enclosing class (false
+        # cycles)
+        rep = lint_src(tmp_path, "m.py", """
+            class Store:
+                def one(self, snap):
+                    with self._lock:
+                        with snap._lock:
+                            pass
+                def two(self, snap):
+                    with self._lock:
+                        with snap._lock:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+    def test_same_named_unknowns_do_not_merge_across_functions(
+            self, tmp_path):
+        # unrelated objects sharing a parameter name must not fabricate a
+        # cross-function cycle...
+        rep = lint_src(tmp_path, "m.py", """
+            class Store:
+                def one(self, snap):
+                    with self._lock:
+                        with snap._lock:
+                            pass
+                def two(self, snap):
+                    with snap._lock:
+                        with self._lock:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+        # ...but within ONE function the same name is one object and an
+        # inversion is still caught
+        rep = lint_src(tmp_path, "m.py", """
+            class Store:
+                def one(self, snap):
+                    with self._lock:
+                        with snap._lock:
+                            pass
+                    with snap._lock:
+                        with self._lock:
+                            pass
+            """, ["lock-order"])
+        assert rule_hits(rep, "lock-order")
+
+    def test_no_lock_self_method_does_not_alias_module_function(
+            self, tmp_path):
+        # a lock-free self.close() must not inherit a same-named module
+        # function's acquisitions (would fabricate edges / false cycles)
+        rep = lint_src(tmp_path, "m.py", """
+            def close():
+                with _b_lock:
+                    pass
+
+            class A:
+                def close(self):
+                    pass
+                def one(self):
+                    with self._a_lock:
+                        self.close()
+
+            class B:
+                def two(self):
+                    with _b_lock:
+                        with other_a._a_lock:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+    def test_unknown_self_attr_receivers_do_not_merge_across_classes(
+            self, tmp_path):
+        # untyped `self.cb._lock` in two unrelated classes must not be
+        # one node (would chain their edges into phantom cycles)
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def one(self):
+                    with self._lock:
+                        with self.cb._lock:
+                            pass
+            class B:
+                def two(self):
+                    with self.cb._lock:
+                        with self._lock:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+    def test_lambda_body_is_not_held_context(self, tmp_path):
+        # a deferred lambda runs lock-free: no held-call edge
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def helper(self):
+                    with self._b_lock:
+                        pass
+                def one(self):
+                    with self._a_lock:
+                        self.defer(lambda: self.helper())
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+    def test_clock_and_block_are_not_locks(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def one(self):
+                    with self.clock:
+                        with self._a_lock:
+                            pass
+                def two(self):
+                    with self._a_lock:
+                        with self.block:
+                            pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+    def test_quiet_on_consistent_order(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            class A:
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def three(self):
+                    with self._b_lock:
+                        pass
+            """, ["lock-order"])
+        assert not rule_hits(rep, "lock-order")
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline ratchet
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    SRC_BAD = """
+        import time
+        t = time.time()
+        """
+    SRC_SUPPRESSED = """
+        import time
+        t = time.time()  # corelint: disable=clock-discipline -- test fixture
+        """
+
+    def test_round_trip(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/m.py", self.SRC_SUPPRESSED,
+                       ["clock-discipline"])
+        assert not rep.violations
+        assert len(rep.suppressed) == 1
+        # deleting the suppression comment re-surfaces the violation
+        rep = lint_src(tmp_path, "pkg/m.py", self.SRC_BAD,
+                       ["clock-discipline"])
+        assert len(rep.violations) == 1
+        assert not rep.suppressed
+
+    def test_file_level_suppression(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/m.py", """
+            # corelint: disable-file=clock-discipline -- fixture module
+            import time
+            a = time.time()
+            b = time.monotonic()
+            """, ["clock-discipline"])
+        assert not rep.violations
+        assert len(rep.suppressed) == 2
+
+    def test_baseline_ratchet_blocks_new_suppressions(self, tmp_path):
+        rep = lint_src(tmp_path, "pkg/m.py", self.SRC_SUPPRESSED,
+                       ["clock-discipline"])
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), rep)
+        assert check_baseline(rep, load_baseline(str(bl))) == []
+        # a second suppression in the same file exceeds the ratchet
+        rep2 = lint_src(tmp_path, "pkg/m.py", """
+            import time
+            t = time.time()       # corelint: disable=clock-discipline -- one
+            u = time.monotonic()  # corelint: disable=clock-discipline -- two
+            """, ["clock-discipline"])
+        assert len(rep2.suppressed) == 2
+        problems = check_baseline(rep2, load_baseline(str(bl)))
+        assert problems and "ratchet" in problems[0]
+
+    def test_baseline_ratchet_flags_stale_entries(self, tmp_path):
+        # a removed suppression must not leave headroom for a later
+        # unreviewed one: shrinkage demands a baseline regen too
+        rep = lint_src(tmp_path, "pkg/m.py", self.SRC_SUPPRESSED,
+                       ["clock-discipline"])
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), rep)
+        rep2 = lint_src(tmp_path, "pkg/m.py", "x = 1\n",
+                        ["clock-discipline"])
+        problems = check_baseline(rep2, load_baseline(str(bl)))
+        assert problems and "ratchet down" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# whole-tree gate + CLI
+# ---------------------------------------------------------------------------
+
+class TestWholeTree:
+    def test_tree_is_clean_and_matches_baseline(self):
+        targets = [os.path.join(REPO_ROOT, "stellar_core_tpu"),
+                   os.path.join(REPO_ROOT, "bench.py")]
+        rep = run_paths(targets, all_rules(), root=REPO_ROOT)
+        assert rep.files_scanned > 100
+        assert rep.violations == [], \
+            "\n".join(v.format() for v in rep.violations)
+        assert not rep.parse_errors
+        baseline = load_baseline(os.path.join(REPO_ROOT,
+                                              "LINT_BASELINE.json"))
+        assert check_baseline(rep, baseline) == []
+        # the documented grandfathered suppressions exist and are listed
+        assert rep.suppression_counts() == baseline["suppressions"]
+
+    def test_overlapping_paths_lint_each_file_once(self):
+        targets = [os.path.join(REPO_ROOT, "stellar_core_tpu"),
+                   os.path.join(REPO_ROOT, "stellar_core_tpu", "util",
+                                "metrics.py")]
+        rep = run_paths(targets, all_rules(), root=REPO_ROOT)
+        key = "stellar_core_tpu/util/metrics.py:exception-hygiene"
+        assert rep.suppression_counts()[key] == 1
+
+    def test_cli_rejects_baseline_with_partial_scope(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint",
+             "--rules", "clock-discipline",
+             "--baseline", "LINT_BASELINE.json"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 2
+        assert "full scope" in r.stderr
+        # a non-cwd --root would mis-key every suppression: rejected too
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint",
+             "--root", str(tmp_path),
+             "--baseline", "LINT_BASELINE.json"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 2
+        assert "full scope" in r.stderr
+
+    def test_nul_byte_file_is_a_parse_error_not_a_crash(self, tmp_path):
+        p = tmp_path / "nul.py"
+        p.write_bytes(b"x = 1\x00")
+        rep = run_paths([str(p)], all_rules(), root=str(tmp_path))
+        assert rep.files_scanned == 0
+        assert rep.parse_errors and "nul.py" in rep.parse_errors[0]
+
+    def test_cli_rejects_nonexistent_path(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint",
+             str(tmp_path / "no_such_dir")],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 2
+        assert "no such path" in r.stderr
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\nx = time.time()\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint", str(bad),
+             "--root", str(tmp_path), "--json"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["counts"] == {"clock-discipline": 1}
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint", str(good),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_cli_list_rules_names_all_six(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 0
+        for rule in ("clock-discipline", "ledger-txn-paths",
+                     "decode-free-seam", "exception-hygiene",
+                     "metric-registry", "lock-order"):
+            assert rule in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order tracer
+# ---------------------------------------------------------------------------
+
+class TestRuntimeLockTracer:
+    @pytest.fixture(autouse=True)
+    def _traced(self):
+        lockorder.enable()
+        lockorder.reset_observed()
+        yield
+        lockorder.disable()
+        lockorder.reset_observed()
+
+    def test_disabled_factory_returns_plain_lock(self):
+        lockorder.disable()
+        lk = lockorder.make_lock("x")
+        assert type(lk).__name__ in ("lock", "LockType")
+
+    def test_records_acquisition_dag(self):
+        a = lockorder.make_lock("a")
+        b = lockorder.make_lock("b")
+        with a:
+            with b:
+                pass
+        assert lockorder.observed_edges() == {"a": {"b"}}
+
+    def test_fail_stops_on_inversion(self):
+        a = lockorder.make_lock("a")
+        b = lockorder.make_lock("b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockorder.LockOrderError):
+            with b:
+                with a:
+                    pass
+
+    def test_transitive_inversion_detected(self):
+        a = lockorder.make_lock("a")
+        b = lockorder.make_lock("b")
+        c = lockorder.make_lock("c")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(lockorder.LockOrderError):
+            with c:
+                with a:
+                    pass
+
+    def test_rlock_reentry_is_not_an_inversion(self):
+        r = lockorder.make_rlock("r")
+        with r:
+            with r:
+                pass
+        assert lockorder.observed_edges() == {}
+
+    def test_traced_lock_api_parity(self):
+        import threading
+        lk = lockorder.make_lock("parity")
+        with lk:
+            assert lk.locked()
+        # a traced RLock exposes exactly the wrapped RLock's surface:
+        # .locked() exists only where threading.RLock has it (3.14+)
+        r = lockorder.make_rlock("parity-r")
+        if hasattr(threading.RLock(), "locked"):
+            r.locked()
+        else:
+            with pytest.raises(AttributeError):
+                r.locked()
+
+    def test_same_class_two_instances_consistent(self):
+        # two instances of the same lock class are one DAG node
+        h1 = lockorder.make_lock("metrics.histogram")
+        reg = lockorder.make_lock("metrics.registry")
+        with reg:
+            with h1:
+                pass
+        assert lockorder.observed_edges() == \
+            {"metrics.registry": {"metrics.histogram"}}
