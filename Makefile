@@ -1,4 +1,4 @@
-.PHONY: native test lint metrics bucketdb bucketdb-slow clean
+.PHONY: native test lint metrics obs bucketdb bucketdb-slow clean
 
 native:
 	python setup.py build_ext --inplace
@@ -30,6 +30,14 @@ bucketdb:
 bucketdb-slow:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_bucketlistdb.py \
 		tests/test_bucket_streaming.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# incident-observability suite: flight recorder + crash bundles, /health
+# + StatusManager, trace-correlated JSON logging, admin error paths, and
+# the metrics/trace exposition surface
+obs:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
+		tests/test_eventlog.py -q -m 'not slow' \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must

@@ -64,8 +64,8 @@ def budget_fits(section: str, estimate_s: float) -> bool:
 # measured; a degraded run emits the cached numbers with their age and a
 # stale flag instead of bare zeros.
 # ---------------------------------------------------------------------------
-CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "BENCH_CACHE.json")
+CACHE_PATH = os.environ.get("BENCH_CACHE_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_CACHE.json")
 
 
 def _cache_load() -> dict:
@@ -417,17 +417,34 @@ def bench_sigs():
     return med(tpu_rates), med(base_rates)
 
 
-def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
+def bench_replay(nid, passphrase, archive, expected_hash, rounds=3,
+                 time_left_fn=None):
     """Configs #1 + #4: ledgers/sec CPU vs accel.  The rig's shared TPU
     drifts 20-40% run to run, so passes are INTERLEAVED (cpu, accel) x
     `rounds` and the medians reported; identical hashes asserted on every
     pass.  The accel pass reports a per-phase breakdown
-    (dispatch host prep / collect sync-stall)."""
+    (dispatch host prep / collect sync-stall).
+
+    `time_left_fn` is the global bench deadline (ISSUE 5 satellite: the
+    PR 3 budget only gated sections that hadn't STARTED — BENCH_r05 hit
+    rc=124 cut mid-replay).  The deadline now pre-empts the replay
+    section itself: each completed (cpu, accel) round updates the
+    per-round cost estimate, and a next round that no longer fits is
+    skipped — partial results (medians over completed rounds) are
+    reported instead of the whole run dying.  Returns None when not even
+    one round fit."""
+    import time as _time
+
     from stellar_core_tpu.catchup.catchup import CatchupManager
     from stellar_core_tpu.crypto import keys
 
     has = archive.get_state()
     n_ledgers = has.current_ledger
+
+    if time_left_fn is not None and time_left_fn() < 240:
+        _stage("replay: archive build consumed the section budget — "
+               "skipping all rounds")
+        return None
 
     _stage("replay: accel warm pass (compiles)...")
     keys.clear_verify_cache()
@@ -443,7 +460,17 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
     cpu_rates, tpu_rates = [], []
     phases = {}
     hit_rate = 0.0
+    rounds_skipped = 0
+    round_cost_s = None   # measured cost of one full (cpu, accel) round
     for r in range(rounds):
+        if time_left_fn is not None and round_cost_s is not None \
+                and time_left_fn() < round_cost_s * 1.25:
+            rounds_skipped = rounds - r
+            _stage(f"replay: PRE-EMPTED after {r}/{rounds} rounds "
+                   f"(next round needs ~{round_cost_s:.0f}s, "
+                   f"{time_left_fn():.0f}s left)")
+            break
+        t_round = _time.perf_counter()
         _stage(f"replay round {r + 1}/{rounds}: cpu...")
         keys.clear_verify_cache()
         cm_cpu = CatchupManager(nid, passphrase, accel=False)
@@ -453,14 +480,15 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
         assert m.lcl_hash == expected_hash
         _stage(f"replay round {r + 1}/{rounds}: accel...")
         keys.clear_verify_cache()
-        if r == rounds - 1:
-            # the registry is process-global and by now holds the archive
-            # build + all CPU rounds; reset so the observability snapshot
-            # embedded in the bench record describes ONE accel replay
-            # (otherwise crypto.verify.recompute is ~all CPU-round
-            # libsodium work and the close quantiles blend every phase)
-            from stellar_core_tpu.util.metrics import reset_registry
-            reset_registry()
+        # the registry is process-global and by now holds the archive
+        # build + all CPU rounds; reset before EVERY accel pass — not
+        # just the planned last one — so the observability snapshot
+        # embedded in the bench record describes exactly ONE accel
+        # replay even when the deadline pre-empts later rounds
+        # (otherwise crypto.verify.recompute is ~all CPU-round libsodium
+        # work and the close quantiles blend every phase)
+        from stellar_core_tpu.util.metrics import reset_registry
+        reset_registry()
         cm_tpu = CatchupManager(nid, passphrase, accel=True,
                                 accel_chunk=8192, accel_hot_threshold=4)
         t0 = time.perf_counter()
@@ -470,6 +498,10 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
         hit_rate = cm_tpu.offload_hit_rate()
         phases = {k: round(v, 3) if isinstance(v, float) else v
                   for k, v in cm_tpu.stats.items()}
+        round_cost_s = _time.perf_counter() - t_round
+
+    if not cpu_rates:
+        return None   # budget pre-empted before one full round completed
 
     med = lambda xs: sorted(xs)[len(xs) // 2]
     # drift-resistant headline (VERDICT r4 item 6): per-round arrays + the
@@ -482,6 +514,8 @@ def bench_replay(nid, passphrase, archive, expected_hash, rounds=3):
     phases["ratio_min"] = round(min(pair_ratios), 3)
     phases["ratio_max"] = round(max(pair_ratios), 3)
     phases["ratio_median_of_pairs"] = round(med(pair_ratios), 3)
+    if rounds_skipped:
+        phases["rounds_skipped_budget"] = rounds_skipped
     return med(cpu_rates), med(tpu_rates), hit_rate, n_ledgers, phases
 
 
@@ -800,21 +834,27 @@ def main():
                 n_payment_ledgers=int(os.environ.get(
                     "BENCH_PAYMENT_LEDGERS", "1100")))
             _stage("replay bench...")
-            cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = bench_replay(
-                nid, passphrase, archive, mgr.lcl_hash)
-        obs = observability_snapshot(hit_rate)
-        replay_vals = {
-            "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
-            "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
-            "replay_ledgers": n_ledgers,
-            "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
-            "replay_hashes_identical": True,
-            "sig_offload_hit_rate": round(hit_rate, 3),
-            "replay_phases": phases,
-            "metrics": obs,
-        }
-        _cache_put("replay", replay_vals)
-        extra.update(replay_vals)
+            replay = bench_replay(nid, passphrase, archive, mgr.lcl_hash,
+                                  time_left_fn=time_left)
+        if replay is None:
+            # deadline pre-empted the section before one full round
+            extra["replay"] = "SKIPPED(budget, pre-empted mid-section)"
+            _stale_fill(extra, "replay")
+        else:
+            cpu_rate, tpu_rate, hit_rate, n_ledgers, phases = replay
+            obs = observability_snapshot(hit_rate)
+            replay_vals = {
+                "replay_accel_ledgers_per_sec": round(tpu_rate, 1),
+                "replay_accel_vs_cpu": round(tpu_rate / cpu_rate, 3),
+                "replay_ledgers": n_ledgers,
+                "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
+                "replay_hashes_identical": True,
+                "sig_offload_hit_rate": round(hit_rate, 3),
+                "replay_phases": phases,
+                "metrics": obs,
+            }
+            _cache_put("replay", replay_vals)
+            extra.update(replay_vals)
     else:
         extra["replay"] = "SKIPPED(budget)"
         _stale_fill(extra, "replay")

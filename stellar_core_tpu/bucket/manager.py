@@ -20,6 +20,7 @@ import uuid
 from typing import Dict, Iterable, List, Optional, Set
 
 from ..crypto.sha import SHA256
+from ..util import eventlog
 from ..util.lockorder import make_rlock
 from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
@@ -220,21 +221,31 @@ class BucketListStore(BucketDir):
             self._indexes.setdefault(hh, idx)
             idx = self._indexes[hh]
             if os.path.exists(target):
+                deduped = True
                 os.unlink(tmp_path)  # dedup: identical content already stored
             else:
+                deduped = False
                 os.replace(tmp_path, target)
                 dfd = os.open(self.path, os.O_RDONLY)
                 try:
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)
+        # recorded OUTSIDE the store lock: the event-log lock is a leaf
+        eventlog.record("Bucket", "INFO", "stream merge output adopted",
+                        hash=hh[:16], entries=len(idx._keys),
+                        bytes=idx._file_size, deduped=deduped)
         return Bucket.from_disk(idx, hash_bytes)
 
     def gc(self, referenced: Iterable[str]) -> int:
         # one atomic scan vs concurrent stream adoptions (see
         # _adopt_stream) — the lock is reentrant for _protected_hashes
         with self._lock:
-            return super().gc(referenced)
+            removed = super().gc(referenced)
+        if removed:
+            eventlog.record("Bucket", "INFO", "bucket GC pass",
+                            removed=removed)
+        return removed
 
     # -- save + index --------------------------------------------------------
     def ensure(self, bucket: Bucket) -> Optional[DiskBucketIndex]:

@@ -32,7 +32,11 @@ from ..history.archive import (CATEGORY_LEDGER, CATEGORY_RESULTS,
                                first_ledger_in_checkpoint)
 from ..ledger.manager import LedgerManager
 from ..transactions.frame import TransactionFrame
+import itertools
+
+from ..util import eventlog
 from ..util import logging as slog
+from ..util.logging import discard_rate_limit, rate_limited
 from ..util.metrics import registry as _registry
 
 log = slog.get("History")
@@ -43,6 +47,10 @@ _THE = X.TransactionHistoryEntry._xdr_adapter()
 
 class CatchupError(RuntimeError):
     pass
+
+
+# monotone ids for per-pipeline rate-limit keys (GIL-atomic counter)
+_PIPELINE_IDS = itertools.count(1)
 
 
 def verify_ledger_chain(headers: Sequence[X.LedgerHeaderHistoryEntry],
@@ -136,6 +144,11 @@ class PreverifyPipeline:
         self._harvested_hint: Dict[bytes, List[bytes]] = {}
         self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
         self._counted_sigs: Dict[int, int] = {}  # raw-path per-cp totals
+        # per-pipeline rate-limit key, unique for process lifetime (an
+        # id(self) key would recycle addresses after GC and inherit a
+        # dead pipeline's count); discarded in close()
+        self._fallback_warn_key = \
+            f"preverify-collect-fallback-{next(_PIPELINE_IDS)}"
 
     # a wedged tunnel RPC must degrade to CPU-speed verification, not hang
     # the catchup; generous enough for a cold compile (~60s observed)
@@ -161,6 +174,12 @@ class PreverifyPipeline:
     # and the interesting signal is the first occurrence + the trend —
     # which catchup.preverify.fallback and stats carry in full
     FALLBACK_WARN_EVERY_N = 10
+    # Test seam: when set (class attribute), called as DEVICE_GATE(i)
+    # inside the device worker before group i's verdicts materialize.  A
+    # test that must lose the CPU race DETERMINISTICALLY blocks the gate
+    # for i >= 1 instead of hoping 0.25s of wall clock beats the device
+    # (the old sleep-race test flaked whenever CPU-jax finished first).
+    DEVICE_GATE = None
 
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
@@ -382,8 +401,12 @@ class PreverifyPipeline:
             # single-kernel-per-chunk generic path.
             chunk = self.chunk_size
             hot = self.hot_threshold
+            gate = self.DEVICE_GATE
+            group_idx = self.stats.get("dispatch_groups", 0)
 
             def device_job(pks=pks, sigs=sigs, msgs=msgs):
+                if gate is not None:
+                    gate(group_idx)
                 return verify_batch_async(
                     pks, sigs, msgs, chunk_size=chunk,
                     tail_floor=chunk, hot_threshold=hot)()
@@ -456,17 +479,30 @@ class PreverifyPipeline:
             # first occurrence + every Nth at WARNING (with the running
             # count); the rest at DEBUG — the per-group counter metric
             # above keeps the exact tally either way
-            emit = (log.warning if n_fallbacks == 1
-                    or n_fallbacks % self.FALLBACK_WARN_EVERY_N == 0
-                    else log.debug)
+            why = (("lost the CPU race" if race_loss else "timed out")
+                   if not done else f"failed: {box.get('error')}")
+            # keyed per pipeline: each catchup gets its own loud first
+            # occurrence, and the emit cadence tracks the same count the
+            # message prints (a process-wide key would let an earlier
+            # catchup swallow this one's first WARNING)
+            emit, _n = rate_limited(log, self._fallback_warn_key,
+                                    self.FALLBACK_WARN_EVERY_N)
             emit(
                 "preverify collect %s for checkpoints %s — falling back to "
                 "on-demand CPU verification (occurrence %d%s)",
-                ("lost the CPU race" if race_loss else "timed out")
-                if not done else f"failed: {box.get('error')}",
-                group["checkpoints"], n_fallbacks,
+                why, group["checkpoints"], n_fallbacks,
                 "" if n_fallbacks == 1 else
                 f"; warning logged every {self.FALLBACK_WARN_EVERY_N}th")
+            if emit is not log.warning:
+                # quiet occurrences still land in the flight recorder
+                # with structured fields; loud ones arrive via the
+                # WARNING bridge — never both (duplicates would burn
+                # bounded ring slots on a degraded catchup)
+                eventlog.record("History", "WARNING",
+                                "preverify collect fallback",
+                                why=why,
+                                checkpoints=str(group["checkpoints"]),
+                                occurrence=n_fallbacks)
             if race_loss:
                 # the device is slower than libsodium on this group; the
                 # worker keeps running (its queue drains eventually) but
@@ -519,6 +555,7 @@ class PreverifyPipeline:
             self._jobs.put(None)
         self._worker = None
         self._jobs = None
+        discard_rate_limit(self._fallback_warn_key)
 
 
 def preverify_checkpoint_signatures(network_id: bytes,

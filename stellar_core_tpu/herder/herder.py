@@ -22,6 +22,7 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP, EnvelopeState
+from ..util import eventlog
 from ..util import logging as slog
 from ..util.clock import VirtualClock, VirtualTimer
 from ..util.metrics import registry as _registry
@@ -107,14 +108,24 @@ class Herder(SCPDriver):
     def bootstrap(self) -> None:
         """Go live assuming the LCL is current (standalone/test networks).
         Reference: HerderImpl::bootstrap (FORCE_SCP path)."""
-        self.state = HerderState.TRACKING
+        self._set_state(HerderState.TRACKING, "bootstrap")
         self._last_trigger_at = self.clock.now()
         self.trigger_next_ledger(self.tracking_consensus_ledger_index() + 1)
 
     def start(self) -> None:
         """Go live and wait for consensus traffic before participating.
         Reference: HerderImpl::start/restoreState."""
-        self.state = HerderState.SYNCING
+        self._set_state(HerderState.SYNCING, "start")
+
+    def _set_state(self, state: str, why: str) -> None:
+        """State transitions are SCP phase edges — flight-recorded so a
+        post-mortem shows when (and why) the node entered/left tracking."""
+        if state == self.state:
+            return
+        old, self.state = self.state, state
+        eventlog.record("SCP", "INFO", "herder state transition",
+                        old=old, new=state, why=why,
+                        lcl=self.tracking_consensus_ledger_index())
 
     def tracking_consensus_ledger_index(self) -> int:
         return self.lm.last_closed_ledger_seq
@@ -414,8 +425,10 @@ class Herder(SCPDriver):
         if slot_index <= lcl:
             return
         self._buffered[slot_index] = sv
-        self.state = HerderState.TRACKING if slot_index == lcl + 1 \
-            else self.state
+        eventlog.record("SCP", "INFO", "slot externalized",
+                        slot=slot_index, lcl=lcl)
+        if slot_index == lcl + 1:
+            self._set_state(HerderState.TRACKING, "externalized next slot")
         self._drain_buffered()
 
     def _drain_buffered(self) -> None:
@@ -439,7 +452,7 @@ class Herder(SCPDriver):
             txset, frames = got
             arts = self.lm.close_ledger(frames, sv.closeTime, tx_set=txset,
                                         stellar_value=sv)
-            self.state = HerderState.TRACKING
+            self._set_state(HerderState.TRACKING, "externalized value applied")
             _registry().meter("herder.ledger.externalize").mark()
             t0 = self._nominate_started.pop(nxt, None)
             if t0 is not None:
@@ -469,7 +482,7 @@ class Herder(SCPDriver):
             log.warning("herder out of sync at lcl=%d buffered=%s",
                         self.tracking_consensus_ledger_index(),
                         sorted(self._buffered))
-            self.state = HerderState.SYNCING
+            self._set_state(HerderState.SYNCING, "lost sync")
             self.lost_sync_hook()
             self.out_of_sync_handler()
 

@@ -36,6 +36,7 @@ from ..history.archive import (CATEGORY_LEDGER, CATEGORY_TRANSACTIONS,
 from ..transactions.frame import TransactionFrame
 import time
 
+from ..util import eventlog
 from ..util import logging as slog
 from ..util import perf
 from ..util import tracing
@@ -291,8 +292,18 @@ class ApplyCheckpointWork(BasicWork):
             # wall-clock from first crank to completion — includes the
             # preverify collect and any cooperative-yield gaps, which is
             # the honest per-checkpoint apply latency
-            _registry().timer("catchup.apply.checkpoint").update(
-                time.perf_counter() - self._t_first_crank)
+            dur_s = time.perf_counter() - self._t_first_crank
+            _registry().timer("catchup.apply.checkpoint").update(dur_s)
+            # checkpoint verdict: one flight event per checkpoint keeps
+            # post-mortems cheap even on thousand-checkpoint replays
+            eventlog.record("History", "INFO", "checkpoint applied",
+                            checkpoint=self.download.checkpoint,
+                            lcl=self.mgr.last_closed_ledger_seq,
+                            dur_ms=round(dur_s * 1e3, 1))
+        elif state == State.FAILURE:
+            eventlog.record("History", "ERROR", "checkpoint apply FAILED",
+                            checkpoint=self.download.checkpoint,
+                            detail=self.error_detail or "?")
         return state
 
     def _run_crank(self) -> State:

@@ -26,6 +26,18 @@ class InvariantDoesNotHold(Exception):
     """Fail-stop: raised out of close_ledger, never caught internally."""
 
 
+def _fail_invariant(msg: str) -> None:
+    """Record the violation as a flight event and write a post-mortem
+    bundle (util/eventlog → $STPU_CRASH_DIR) before the fail-stop —
+    the crash artifact is what the operator reads instead of a bare
+    traceback."""
+    from ..util import eventlog
+    eventlog.record("Invariant", "ERROR", "invariant does not hold",
+                    detail=msg)
+    eventlog.write_crash_bundle(f"InvariantDoesNotHold: {msg}")
+    raise InvariantDoesNotHold(msg)
+
+
 class LedgerCloseContext:
     """Everything an invariant may inspect for one close.
 
@@ -543,7 +555,7 @@ class InvariantManager:
                 continue
             msg = inv.check_on_ledger_close(ctx)
             if msg is not None:
-                raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")
+                _fail_invariant(f"{inv.NAME}: {msg}")
 
     def check_on_bucket_apply(self, bucket, level: int,
                               header_seq: int) -> None:
@@ -560,4 +572,4 @@ class InvariantManager:
             for be in bucket.entries:
                 msg = inv.check_on_bucket_apply(be, level, header_seq)
                 if msg is not None:
-                    raise InvariantDoesNotHold(f"{inv.NAME}: {msg}")
+                    _fail_invariant(f"{inv.NAME}: {msg}")

@@ -24,6 +24,7 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..bucket.bucket_list import BucketList
 from ..transactions.frame import TransactionFrame
+from ..util import eventlog
 from ..util import logging as slog
 from ..util import tracing
 from ..util.assertions import release_assert
@@ -473,8 +474,14 @@ class LedgerManager:
         # registry lookups are NOT cached across the close: /clearmetrics
         # resets metrics in place, but reset_registry() (tests) swaps the
         # whole registry — a cached reference would feed a dead object
-        _registry().timer("ledger.ledger.close").update(
-            time.perf_counter() - _t0)
+        dur_s = time.perf_counter() - _t0
+        _registry().timer("ledger.ledger.close").update(dur_s)
+        # flight event at the seal edge: the last thing a post-mortem sees
+        # from a healthy node is the close it finished
+        eventlog.record("Ledger", "INFO", "ledger close sealed",
+                        seq=seq, txs=len(ordered),
+                        dur_ms=round(dur_s * 1e3, 3),
+                        hash=self.lcl_hash.hex()[:16])
         _registry().meter("ledger.transaction.apply").mark(len(ordered))
         if self.meta_stream is not None:
             self._emit_close_meta(header_entry, tx_set, result_pairs)

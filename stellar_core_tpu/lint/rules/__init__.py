@@ -7,6 +7,7 @@ from typing import List
 from ..core import Rule
 from .clock import ClockDisciplineRule
 from .decode_free import DecodeFreeSeamRule
+from .eventlog import EventlogPartitionRule
 from .exceptions import ExceptionHygieneRule
 from .ledger_txn import LedgerTxnPathsRule
 from .lock_order import LockOrderRule
@@ -18,6 +19,7 @@ ALL_RULE_CLASSES = (
     DecodeFreeSeamRule,
     ExceptionHygieneRule,
     MetricRegistryRule,
+    EventlogPartitionRule,
     LockOrderRule,
 )
 

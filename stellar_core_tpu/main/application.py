@@ -31,6 +31,43 @@ log = slog.get("Main")
 VERSION = "stellar-core-tpu 2.0.0"
 
 
+def _herder_bundle(app) -> dict:
+    """Herder/SCP state for crash bundles (registered via weakref: a
+    torn-down node reports itself gone instead of pinning its graph)."""
+    if app is None:
+        return {"gone": True}
+    return {
+        "state": app.herder.get_state_human(),
+        "tracking_ledger": app.herder.tracking_consensus_ledger_index(),
+        "tx_queue_depth": app.herder.tx_queue.size,
+        "buffered_slots": sorted(app.herder._buffered),
+        "ledger_timespan_s": app.herder.ledger_timespan,
+        "lcl": {"seq": app.lm.last_closed_ledger_seq,
+                "hash": app.lm.lcl_hash.hex(),
+                "close_time": app.lm.lcl_header.scpValue.closeTime},
+    }
+
+
+def _config_fingerprint(app) -> dict:
+    """Enough config identity to tell WHICH deployment produced a crash
+    bundle without leaking secrets (no seeds, no peer credentials)."""
+    if app is None:
+        return {"gone": True}
+    cfg = app.config
+    return {
+        "network_passphrase": cfg.NETWORK_PASSPHRASE,
+        "network_id": app.network_id.hex(),
+        "node": app.node_secret.public_key.to_strkey(),
+        "is_validator": cfg.NODE_IS_VALIDATOR,
+        "run_standalone": cfg.RUN_STANDALONE,
+        "in_memory_ledger": cfg.IN_MEMORY_LEDGER,
+        "bucket_resident_levels": cfg.BUCKET_RESIDENT_LEVELS,
+        "accel": cfg.ACCEL,
+        "log_format": cfg.LOG_FORMAT,
+        "worker_threads": cfg.WORKER_THREADS,
+    }
+
+
 class Application:
     def __init__(self, config: Config,
                  clock: Optional[VirtualClock] = None,
@@ -40,6 +77,24 @@ class Application:
         self.network_id = config.network_id()
         self.node_secret = config.node_secret()
         slog.set_level(config.LOG_LEVEL)
+        slog.set_format(config.LOG_FORMAT)
+
+        # incident observability: per-category status lines (reference:
+        # StatusManager feeding /info), the node.health gauge behind
+        # /health, and post-mortem bundle sources (herder/SCP state +
+        # config fingerprint ride along in every crash bundle)
+        from ..util import eventlog
+        from ..util.metrics import registry as _registry
+        from .status import StatusManager, health_gauge_value
+        self.status = StatusManager()
+        _registry().weak_gauge("node.health", self, health_gauge_value)
+        eventlog.install_thread_excepthook()
+        import weakref
+        ref = weakref.ref(self)
+        eventlog.register_bundle_source(
+            "herder", lambda: _herder_bundle(ref()))
+        eventlog.register_bundle_source(
+            "config", lambda: _config_fingerprint(ref()))
 
         # database + buckets ------------------------------------------------
         self.database: Optional[Database] = None
@@ -159,8 +214,16 @@ class Application:
         self.history.ledger_closed(arts)
         self.overlay.clear_below(
             max(0, self.lm.last_closed_ledger_seq - 100))
+        # recovery clears the out-of-sync status line (reference:
+        # StatusManager newest-status-per-category, removed on recovery)
+        from ..herder.herder import HerderState
+        if self.herder.state == HerderState.TRACKING:
+            self.status.clear_status("scp")
 
     def _on_out_of_sync(self) -> None:
+        self.status.set_status(
+            "scp", f"out of sync at ledger "
+            f"{self.lm.last_closed_ledger_seq}; requesting SCP state")
         self.overlay.request_scp_state()
         self.maybe_start_archive_catchup()
 
@@ -184,6 +247,10 @@ class Application:
         from ..historywork.works import CatchupWork
         log.info("starting in-place archive catchup: lcl=%d archive=%d",
                  self.lm.last_closed_ledger_seq, has.current_ledger)
+        self.status.set_status(
+            "history-catchup",
+            f"catching up from archive: lcl={self.lm.last_closed_ledger_seq}"
+            f" target={has.current_ledger}")
         work = CatchupWork(self.clock, self.lm,
                            self.history.archives[0], has.current_ledger,
                            self.network_id,
@@ -207,6 +274,13 @@ class Application:
         log.info("archive catchup %s at lcl=%d",
                  "complete" if ok else "FAILED",
                  self.lm.last_closed_ledger_seq)
+        if ok:
+            self.status.clear_status("history-catchup")
+        else:
+            self.status.set_status(
+                "history-catchup",
+                f"archive catchup FAILED at "
+                f"lcl={self.lm.last_closed_ledger_seq}")
         self._catchup_work = None
         self.herder._drain_buffered()
 
@@ -304,7 +378,13 @@ class Application:
             },
             "protocol_version": self.lm.lcl_header.ledgerVersion,
             "accel": self.config.ACCEL,
+            "status": self.status.status_lines(),
         }
+
+    def health(self) -> dict:
+        """/health backend — see main/status.evaluate_health."""
+        from .status import evaluate_health
+        return evaluate_health(self)
 
     def metrics(self) -> dict:
         from ..util.metrics import registry

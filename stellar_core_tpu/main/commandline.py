@@ -4,7 +4,8 @@ Subcommands: run / new-db / new-hist / catchup / publish /
 check-quorum-intersection / self-check / verify-checkpoints /
 report-last-history-checkpoint / offline-info / print-xdr / dump-xdr /
 dump-ledger / encode-asset / sign-transaction / convert-id / http-command /
-fuzz / gen-fuzz / apply-load / test / sec-to-pub / gen-seed / version.
+health / fuzz / gen-fuzz / apply-load / test / sec-to-pub / gen-seed /
+version.
 """
 
 from __future__ import annotations
@@ -474,6 +475,28 @@ def cmd_http_command(args) -> int:
     return 0
 
 
+def cmd_health(args) -> int:
+    """Probe a running node's /health; exit 0 when ok, 1 when degraded
+    or unreachable — the CLI form of the load-balancer probe (wire it
+    into systemd watchdogs / container healthchecks)."""
+    import urllib.error
+    import urllib.request
+    cfg = _load_config(args)
+    url = f"http://127.0.0.1:{cfg.HTTP_PORT}/health"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = resp.read().decode()
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        code = e.code
+    except (urllib.error.URLError, OSError) as e:
+        print(json.dumps({"status": "unreachable", "detail": str(e)}))
+        return 1
+    print(body)
+    return 0 if code == 200 else 1
+
+
 def cmd_fuzz(args) -> int:
     """Run a deterministic fuzz campaign (reference: `stellar-core fuzz`
     over FuzzerImpl)."""
@@ -639,6 +662,12 @@ def main(argv=None) -> int:
     s.add_argument("cmd")
     s.add_argument("--conf", required=True)
     s.set_defaults(fn=cmd_http_command)
+
+    s = sub.add_parser("health",
+                       help="probe a running node's /health (exit 0=ok)")
+    s.add_argument("--conf", required=True)
+    s.add_argument("--timeout", type=float, default=5.0)
+    s.set_defaults(fn=cmd_health)
 
     s = sub.add_parser("fuzz", help="run a deterministic fuzz campaign")
     s.add_argument("--mode", choices=["tx", "overlay", "xdr"], default="tx")

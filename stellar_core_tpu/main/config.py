@@ -72,6 +72,10 @@ class Config:
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
     LOG_LEVEL: str = "INFO"
+    # "json" = one-JSON-object-per-line structured records carrying the
+    # current span id (trace correlation); runtime-switchable via
+    # /ll?format=.  "text" = the classic human stream.
+    LOG_FORMAT: str = "text"
     WORKER_THREADS: int = 4                  # background bucket merges
 
     # -- derived -------------------------------------------------------------
@@ -122,7 +126,7 @@ class Config:
             "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
-            "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "WORKER_THREADS",
+            "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
         }
         for key, val in raw.items():
             if key in simple:

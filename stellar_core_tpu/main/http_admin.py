@@ -1,9 +1,14 @@
 """HTTP admin endpoints (reference: src/main/CommandHandler.{h,cpp}).
 
-Full surface: /info /metrics /quorum /peers /tx /scp /ll /logrotate
-/manualclose /bans /unban /connect /droppeer /maintenance /clearmetrics
-/self-check /upgrades /surveytopologytimesliced /getsurveyresult
-/getledgerentry.
+Full surface: /info /health /dumpflight /metrics /trace /quorum /peers
+/tx /scp /ll /logrotate /manualclose /bans /unban /connect /droppeer
+/maintenance /clearmetrics /self-check /upgrades
+/surveytopologytimesliced /getsurveyresult /getledgerentry.
+
+/health answers 200 ("ok") or 503 ("degraded", with reasons) — the
+load-balancer probe surface; /dumpflight serves the live post-mortem
+bundle (flight events, span stack, metrics — util/eventlog).  Malformed
+query parameters answer 400 (_BadRequest), never 500.
 
 The admin server runs on its own threads and marshals work onto the main
 thread: a ThreadingHTTPServer serves reads directly (GIL-atomic snapshots
@@ -22,6 +27,34 @@ from urllib.parse import parse_qs, urlparse
 from ..util import logging as slog
 
 log = slog.get("CommandHandler")
+
+
+class _BadRequest(Exception):
+    """Malformed query parameter — surfaces as HTTP 400, never 500."""
+
+
+def _hex_param(qs: dict, name: str, required: bool = True) -> bytes:
+    raw = qs.get(name, [""])[0]
+    if not raw:
+        if required:
+            raise _BadRequest(f"missing required hex param {name!r}")
+        return b""
+    try:
+        return bytes.fromhex(raw)
+    except ValueError:
+        raise _BadRequest(f"param {name!r} must be hex") from None
+
+
+def _int_param(qs: dict, name: str, default=None) -> int:
+    raw = qs.get(name, [None])[0]
+    if raw is None:
+        if default is None:
+            raise _BadRequest(f"missing required integer param {name!r}")
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise _BadRequest(f"param {name!r} must be an integer") from None
 
 
 class CommandHandler:
@@ -109,6 +142,19 @@ class CommandHandler:
                 try:
                     if url.path == "/info":
                         self._reply({"info": self._snap(app.info)})
+                    elif url.path == "/health":
+                        # NOT marshalled: a load-balancer probe must keep
+                        # answering while the main loop is stalled — that
+                        # stall is exactly what it exists to detect (the
+                        # reads are GIL-atomic snapshots)
+                        doc = self._snap(app.health)
+                        self._reply(doc,
+                                    200 if doc["status"] == "ok" else 503)
+                    elif url.path == "/dumpflight":
+                        # the live post-mortem: same bundle a crash writes
+                        from ..util import eventlog
+                        self._reply(self._snap(lambda: eventlog.flight_bundle(
+                            "live dump via /dumpflight")))
                     elif url.path == "/metrics":
                         fmt = parse_qs(url.query).get("format", ["json"])[0]
                         if fmt == "prometheus":
@@ -166,15 +212,13 @@ class CommandHandler:
                     elif url.path == "/unban":
                         # marshalled: the ban table lives in the main
                         # thread's sqlite connection
-                        nid = bytes.fromhex(
-                            parse_qs(url.query).get("node", [""])[0])
+                        nid = _hex_param(parse_qs(url.query), "node")
                         out = handler_self._on_main(
                             lambda: app.overlay.ban_manager.unban_node(nid),
                             name="unban")
                         self._reply(out or {"status": "unbanned"})
                     elif url.path == "/ban":
-                        nid = bytes.fromhex(
-                            parse_qs(url.query).get("node", [""])[0])
+                        nid = _hex_param(parse_qs(url.query), "node")
                         out = handler_self._on_main(
                             lambda: app.overlay.ban_manager.ban_node(nid),
                             name="ban")
@@ -182,14 +226,14 @@ class CommandHandler:
                     elif url.path == "/connect":
                         qs = parse_qs(url.query)
                         host = qs.get("peer", [""])[0]
-                        port = int(qs.get("port", ["11625"])[0])
+                        port = _int_param(qs, "port", default=11625)
                         self._reply(handler_self._on_main(
                             lambda: app.connect_to(host, port),
                             name="connect"))
                     elif url.path == "/droppeer":
-                        nid = parse_qs(url.query).get("node", [""])[0]
+                        nid = _hex_param(parse_qs(url.query), "node")
                         self._reply(handler_self._on_main(
-                            lambda: app.drop_peer(bytes.fromhex(nid)),
+                            lambda: app.drop_peer(nid),
                             name="droppeer"))
                     elif url.path == "/maintenance":
                         self._reply(handler_self._on_main(
@@ -209,11 +253,10 @@ class CommandHandler:
                     elif url.path == "/upgrades":
                         self._upgrades(parse_qs(url.query))
                     elif url.path == "/surveytopologytimesliced":
-                        qs = parse_qs(url.query)
-                        node = qs.get("node", [""])[0]
+                        node = _hex_param(parse_qs(url.query), "node",
+                                          required=False)
                         self._reply(handler_self._on_main(
-                            lambda: app.survey_node(
-                                bytes.fromhex(node) if node else None),
+                            lambda: app.survey_node(node or None),
                             name="survey"))
                     elif url.path == "/stopsurvey":
                         self._reply(handler_self._on_main(
@@ -223,14 +266,15 @@ class CommandHandler:
                     elif url.path == "/getledgerentry":
                         # marshalled: snapshot construction must not race
                         # add_batch's spill window on the main thread
-                        key = bytes.fromhex(
-                            parse_qs(url.query).get("key", [""])[0])
+                        key = _hex_param(parse_qs(url.query), "key")
                         self._reply(handler_self._on_main(
                             lambda: app.get_ledger_entry(key),
                             name="getledgerentry"))
                     else:
                         self._reply({"error": "unknown endpoint",
                                      "endpoints": sorted(_ENDPOINTS)}, 404)
+                except _BadRequest as e:
+                    self._reply({"error": str(e)}, 400)
                 except Exception as e:  # admin surface must never crash
                     log.warning("admin request failed: %s", e)
                     self._reply({"error": str(e)}, 500)
@@ -239,8 +283,29 @@ class CommandHandler:
                 from ..util import logging as slog2
                 level = qs.get("level", [None])[0]
                 partition = qs.get("partition", [None])[0]
+                fmt = qs.get("format", [None])[0]
+                # validate EVERY param before applying ANY of them: a
+                # request that answers 400 must be side-effect free
+                if fmt is not None and fmt not in slog2.LOG_FORMATS:
+                    raise _BadRequest(
+                        f"format must be one of {slog2.LOG_FORMATS}")
+                if level is not None and level.upper() not in (
+                        "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL",
+                        "FATAL"):
+                    raise _BadRequest(f"unknown log level {level!r}")
+                if partition is not None \
+                        and partition not in slog2.PARTITIONS:
+                    raise _BadRequest(f"unknown partition {partition!r}")
+                if fmt is not None:
+                    # runtime structured-logging switch (reference: the
+                    # spdlog pattern swap behind /ll)
+                    slog2.set_format(fmt)
+                    if level is None:
+                        self._reply({"status": "ok", "format": fmt})
+                        return
                 if level is None:
-                    self._reply({"levels": slog2.current_levels()})
+                    self._reply({"levels": slog2.current_levels(),
+                                 "format": slog2.current_format()})
                     return
                 # direct call, deliberately NOT marshalled: setLevel is a
                 # thread-safe single attribute store, and /ll must keep
@@ -248,7 +313,8 @@ class CommandHandler:
                 # when an operator reaches for it
                 slog2.set_level(level.upper(), partition)
                 self._reply({"status": "ok", "partition": partition or "all",
-                             "level": level.upper()})
+                             "level": level.upper(),
+                             "format": slog2.current_format()})
 
             def _upgrades(self, qs) -> None:
                 app = handler_self.app
@@ -263,17 +329,17 @@ class CommandHandler:
                     self._reply(out or {"status": "cleared"})
                 elif mode == "set":
                     from ..herder.upgrades import UpgradeParameters
+
+                    def opt(name):
+                        return _int_param(qs, name, default=0) \
+                            if name in qs else None
+
                     params = UpgradeParameters(
-                        upgrade_time=int(qs.get("upgradetime", ["0"])[0]),
-                        protocol_version=(
-                            int(qs["protocolversion"][0])
-                            if "protocolversion" in qs else None),
-                        base_fee=(int(qs["basefee"][0])
-                                  if "basefee" in qs else None),
-                        max_tx_set_size=(int(qs["maxtxsetsize"][0])
-                                         if "maxtxsetsize" in qs else None),
-                        base_reserve=(int(qs["basereserve"][0])
-                                      if "basereserve" in qs else None))
+                        upgrade_time=_int_param(qs, "upgradetime", default=0),
+                        protocol_version=opt("protocolversion"),
+                        base_fee=opt("basefee"),
+                        max_tx_set_size=opt("maxtxsetsize"),
+                        base_reserve=opt("basereserve"))
                     out = handler_self._on_main(
                         lambda: app.herder.upgrades.set_parameters(params),
                         name="upgrades-set")
@@ -285,7 +351,8 @@ class CommandHandler:
 
 
 _ENDPOINTS = [
-    "/info", "/metrics", "/trace", "/quorum", "/peers", "/scp", "/tx", "/ll",
+    "/info", "/health", "/dumpflight", "/metrics", "/trace", "/quorum",
+    "/peers", "/scp", "/tx", "/ll",
     "/logrotate", "/manualclose", "/bans", "/ban", "/unban", "/connect",
     "/droppeer", "/maintenance", "/clearmetrics", "/self-check",
     "/upgrades", "/surveytopologytimesliced", "/stopsurvey",

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Set
 
+from ..util import eventlog
+
 
 class BanManager:
     def __init__(self, database=None):
@@ -21,11 +23,16 @@ class BanManager:
         if node_id in self._banned:
             return
         self._banned.add(node_id)
+        eventlog.record("Overlay", "WARNING", "node banned",
+                        node=node_id.hex()[:16])
         if self.db is not None:
             self.db.store_ban(node_id)
             self.db.commit()
 
     def unban_node(self, node_id: bytes) -> None:
+        if node_id in self._banned:
+            eventlog.record("Overlay", "INFO", "node unbanned",
+                            node=node_id.hex()[:16])
         self._banned.discard(node_id)
         if self.db is not None:
             self.db.delete_ban(node_id)

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 from .. import xdr as X
 from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
+from ..util import eventlog
 from ..util import logging as slog
 from ..util.metrics import registry as _registry
 from .ban import BanManager
@@ -112,6 +113,11 @@ class OverlayManager:
         self.authenticated_peers[peer.peer_id] = peer
         log.info("peer %s authenticated (%s)", peer.peer_id.hex()[:8],
                  "outbound" if peer.we_called_remote else "inbound")
+        eventlog.record("Overlay", "INFO", "peer authenticated",
+                        peer=peer.peer_id.hex()[:8],
+                        direction="outbound" if peer.we_called_remote
+                        else "inbound",
+                        authenticated=len(self.authenticated_peers))
         # learn the network (reference: Peer::recvAuth -> sendGetPeers)
         peer.send_message(X.StellarMessage.getPeers())
         if peer.remote_listening_port > 0 and hasattr(peer, "sock") \
@@ -133,6 +139,10 @@ class OverlayManager:
     def _peer_dropped(self, peer: Peer) -> None:
         _registry().counter("overlay.peer.drop").inc()
         self.stats["dropped_peers"] += 1
+        eventlog.record("Overlay", "INFO", "peer dropped",
+                        peer=peer.peer_id.hex()[:8]
+                        if peer.peer_id else "(unauthenticated)",
+                        reason=getattr(peer, "drop_reason", None) or "?")
         if peer.is_authenticated():
             self.survey.record_dropped_peer()
         # outbound dials that never authenticated feed the backoff policy

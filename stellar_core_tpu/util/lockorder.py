@@ -47,6 +47,23 @@ class LockOrderError(AssertionError):
     """A lock acquisition inverted the observed acquisition DAG."""
 
 
+def _fail_lock_order(msg: str) -> None:
+    """Fail-stop with a post-mortem: record the inversion as a flight
+    event and write a crash bundle (util/eventlog → $STPU_CRASH_DIR)
+    before raising.  Called with NO locks held (the caller releases
+    _graph_mu first) so bundle assembly — which snapshots metrics and the
+    event ring under their own locks — cannot add edges to the graph
+    being reported on, let alone deadlock against it."""
+    try:
+        from . import eventlog
+        eventlog.record("Process", "ERROR", "lock-order inversion",
+                        detail=msg)
+        eventlog.write_crash_bundle(f"LockOrderError: {msg}")
+    except Exception:  # corelint: disable=exception-hygiene -- the fail-stop below must never be masked by dump plumbing
+        pass
+    raise LockOrderError(msg)
+
+
 def enable() -> None:
     """Trace locks created from now on (locks made before stay plain)."""
     global _enabled
@@ -129,23 +146,30 @@ class _TracedLock:
         if self.name in held:
             if self._reentrant:
                 return  # same-class re-entry: no edge, no inversion
-            raise LockOrderError(
+            _fail_lock_order(
                 f"non-reentrant lock class '{self.name}' re-acquired "
                 f"while already held (held: {held})")
         new_edges: List[Tuple[str, str]] = []
+        inversion = None
         with _graph_mu:
             for h in held:
                 if self.name not in _edges.get(h, ()):
                     cyc = _would_cycle(h, self.name)
                     if cyc:
-                        raise LockOrderError(
+                        inversion = (
                             f"lock-order inversion: acquiring "
                             f"'{self.name}' while holding '{h}', but the "
                             f"observed DAG already orders "
                             f"{' -> '.join(cyc)}")
+                        break
                     new_edges.append((h, self.name))
-            for h, n in new_edges:
-                _edges.setdefault(h, set()).add(n)
+            if inversion is None:
+                for h, n in new_edges:
+                    _edges.setdefault(h, set()).add(n)
+        if inversion is not None:
+            # raised OUTSIDE _graph_mu: the crash-bundle dump acquires
+            # other (traced) locks and must not nest under the graph lock
+            _fail_lock_order(inversion)
 
     def acquire(self, *a, **kw) -> bool:
         self._before_acquire()

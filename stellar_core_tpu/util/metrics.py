@@ -96,6 +96,10 @@ CANONICAL_METRICS = frozenset({
     # crypto
     "crypto.verify.cache-hit",
     "crypto.verify.recompute",
+    # incident observability (flight recorder / health)
+    "node.health",
+    "eventlog.record.count",
+    "log.bridge.records",
 })
 
 # Prefixes for families whose tail is data-dependent (one meter per overlay

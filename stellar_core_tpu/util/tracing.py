@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import itertools
 import json
 import threading
 import time
@@ -63,12 +64,18 @@ _tree_count: contextvars.ContextVar[Optional[list]] = \
 _EPOCH_WALL = wall_now()
 _EPOCH_PERF = time.perf_counter()
 
+# process-unique span ids (GIL-atomic counter).  The id is what a
+# structured log line carries (util/logging LOG_FORMAT=json) so a slow
+# span can be joined against every record it emitted.
+_SPAN_IDS = itertools.count(1)
+
 
 class Span:
     __slots__ = ("name", "start_s", "dur_s", "args", "children", "tid",
-                 "truncated")
+                 "truncated", "span_id", "parent")
 
-    def __init__(self, name: str, args: Optional[Dict] = None):
+    def __init__(self, name: str, args: Optional[Dict] = None,
+                 parent: Optional["Span"] = None):
         self.name = name
         self.start_s = time.perf_counter()
         self.dur_s: Optional[float] = None
@@ -76,6 +83,8 @@ class Span:
         self.children: List["Span"] = []
         self.tid = threading.get_ident()
         self.truncated = 0  # children elided past MAX_CHILD_SPANS
+        self.span_id = f"{next(_SPAN_IDS):x}"
+        self.parent = parent
 
     def finish(self) -> None:
         self.dur_s = time.perf_counter() - self.start_s
@@ -129,7 +138,7 @@ def span(name: str, **args):
         ctoken = _tree_count.set(counter)
     else:
         counter[0] += 1
-    s = Span(name, args)
+    s = Span(name, args, parent=parent)
     token = _current.set(s)
     try:
         yield s
@@ -150,6 +159,40 @@ def span(name: str, **args):
 
 def current_span() -> Optional[Span]:
     return _current.get()
+
+
+def jsonable_args(args: Optional[Dict]) -> Optional[Dict]:
+    """Span/event key=value fields coerced to JSON-clean scalars (the one
+    serialization rule shared by Chrome trace export, span stacks and
+    flight-event bundles): scalars pass through, everything else
+    stringifies."""
+    if not args:
+        return None
+    return {k: (v if isinstance(v, (int, float, str, bool, type(None)))
+                else str(v))
+            for k, v in args.items()}
+
+
+def current_span_id() -> Optional[str]:
+    """Id of the innermost open span in this thread/context, or None —
+    the correlation key structured log records carry."""
+    s = _current.get()
+    return s.span_id if s is not None else None
+
+
+def active_span_stack() -> List[dict]:
+    """The open span chain of the current context, innermost first —
+    what a post-mortem bundle captures as "what was this thread doing".
+    Each entry: name, span_id, elapsed_s so far, and the span args."""
+    out: List[dict] = []
+    s = _current.get()
+    now = time.perf_counter()
+    while s is not None:
+        out.append({"name": s.name, "span_id": s.span_id,
+                    "elapsed_s": round(now - s.start_s, 6),
+                    "args": jsonable_args(s.args)})
+        s = s.parent
+    return out
 
 
 def annotate(**args) -> None:
@@ -178,9 +221,7 @@ def _emit(events: List[dict], s: Span, pid: int) -> None:
     }
     if s.args:
         # values must be JSON-serializable; coerce the rest to str
-        ev["args"] = {k: (v if isinstance(v, (int, float, str, bool,
-                                              type(None))) else str(v))
-                      for k, v in s.args.items()}
+        ev["args"] = jsonable_args(s.args)
     if s.truncated:
         ev.setdefault("args", {})["truncated_children"] = s.truncated
     events.append(ev)

@@ -1,0 +1,463 @@
+"""Incident-observability suite (ISSUE 5): flight recorder + logging
+bridge, crash bundles on forced fail-stops, StatusManager semantics,
+/health degradation, and trace-correlated structured (JSON) logging.
+
+Acceptance criteria exercised here:
+- a forced LockOrderError produces a crash bundle whose JSON contains
+  >=1 flight event from each of three different partitions, the active
+  span stack, and a metric snapshot;
+- /health flips from "ok" to degraded when the ledger age exceeds the
+  close target in a simulated stall;
+- with LOG_FORMAT=json, a log line emitted inside a ledger.close span
+  carries that span's id.
+"""
+
+import io
+import json
+import logging as pylog
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util import eventlog, lockorder, metrics, tracing
+from stellar_core_tpu.util import logging as slog
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    eventlog.event_log().clear()
+    yield
+    eventlog.event_log().clear()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder core
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_record_captures_structure_and_span(self):
+        with tracing.span("ledger.close", seq=7) as s:
+            eventlog.record("Ledger", "info", "close sealed", seq=7, txs=3)
+        evs = eventlog.event_log().events()
+        ev = next(e for e in evs if e.msg == "close sealed")
+        assert ev.partition == "Ledger"
+        assert ev.severity == "INFO"
+        assert ev.fields == {"seq": 7, "txs": 3}
+        assert ev.span_id == s.span_id
+        assert ev.mono_s > 0 and ev.wall_s > 0
+
+    def test_unknown_partition_rejected(self):
+        with pytest.raises(ValueError):
+            eventlog.record("NotAPartition", "INFO", "x")
+
+    def test_ring_is_bounded_newest_kept(self):
+        log = eventlog.EventLog(capacity=8)
+        for i in range(20):
+            log.record("Ledger", "INFO", f"e{i}")
+        evs = log.events()
+        assert len(evs) == 8
+        assert evs[0].msg == "e12" and evs[-1].msg == "e19"
+
+    def test_bridge_records_warning_not_info(self):
+        slog.get("Overlay").warning("connection storm from %s", "peer-x")
+        slog.get("Overlay").info("all quiet")
+        msgs = [e.msg for e in eventlog.event_log().events()]
+        assert any("connection storm from peer-x" in m for m in msgs)
+        assert not any("all quiet" in m for m in msgs)
+
+    def test_bridge_level_gate_means_zero_work_below(self):
+        # the zero-overhead claim: the bridge handler's level filters
+        # records before emit() — stdlib logging never calls it
+        bridge = next(h for h in pylog.getLogger("stellar").handlers
+                      if isinstance(h, eventlog.FlightRecorderBridge))
+        assert bridge.level == pylog.WARNING
+
+    def test_snapshot_coerces_fields(self):
+        eventlog.record("Bucket", "INFO", "adopt", raw=b"\x01\x02")
+        snap = eventlog.event_log().snapshot()
+        ev = next(e for e in snap if e["msg"] == "adopt")
+        assert isinstance(ev["fields"]["raw"], str)
+        json.dumps(snap)  # whole snapshot is JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# crash bundles
+# ---------------------------------------------------------------------------
+
+def _force_lock_inversion_in_span():
+    """Build an A->B order, then invert it inside a ledger.close span."""
+    lockorder.enable()
+    lockorder.reset_observed()
+    a = lockorder.make_lock("crashtest.a")
+    b = lockorder.make_lock("crashtest.b")
+    try:
+        with a:
+            with b:
+                pass
+        with tracing.span("ledger.close", seq=99):
+            with tracing.span("ledger.seal"):
+                with b:
+                    with a:
+                        pass
+    finally:
+        lockorder.disable()
+        lockorder.reset_observed()
+
+
+class TestCrashBundle:
+    def test_lock_order_error_writes_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+        # populate three partitions through the real paths: an explicit
+        # lifecycle record, the logging bridge, and a catchup-style event
+        eventlog.record("Ledger", "INFO", "ledger close sealed", seq=12)
+        slog.get("Overlay").warning("peer %s dropped: timeout", "ab12")
+        eventlog.record("History", "INFO", "checkpoint applied",
+                        checkpoint=63)
+        with pytest.raises(lockorder.LockOrderError):
+            _force_lock_inversion_in_span()
+
+        bundles = list(tmp_path.glob("flight-*.json"))
+        assert len(bundles) == 1
+        doc = json.loads(bundles[0].read_text())
+        assert doc["reason"].startswith("LockOrderError")
+        partitions = {e["partition"] for e in doc["events"]}
+        # the acceptance bar: >= 3 distinct partitions present
+        assert {"Ledger", "Overlay", "History"} <= partitions
+        # Process carries the inversion event itself
+        assert "Process" in partitions
+        # active span stack, innermost first
+        names = [s["name"] for s in doc["span_stack"]]
+        assert names == ["ledger.seal", "ledger.close"]
+        assert all(s["span_id"] for s in doc["span_stack"])
+        # full metric snapshot rides along
+        assert doc["metrics"], "metric snapshot missing"
+        assert "eventlog.record.count" in doc["metrics"]
+
+    def test_invariant_failstop_writes_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+        from stellar_core_tpu.invariant.invariants import (
+            InvariantDoesNotHold, _fail_invariant)
+        with pytest.raises(InvariantDoesNotHold):
+            _fail_invariant("ConservationOfLumens: 7 stroops vanished")
+        bundles = list(tmp_path.glob("flight-*.json"))
+        assert len(bundles) == 1
+        doc = json.loads(bundles[0].read_text())
+        assert "ConservationOfLumens" in doc["reason"]
+        assert any(e["partition"] == "Invariant" for e in doc["events"])
+
+    def test_no_crash_dir_means_no_write(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("STPU_CRASH_DIR", raising=False)
+        assert eventlog.write_crash_bundle("test") is None
+
+    def test_bundle_sources_and_errors_localized(self, monkeypatch):
+        eventlog.register_bundle_source("good", lambda: {"x": 1})
+        eventlog.register_bundle_source(
+            "bad", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        try:
+            doc = eventlog.flight_bundle("live")
+        finally:
+            eventlog.unregister_bundle_source("good")
+            eventlog.unregister_bundle_source("bad")
+        assert doc["good"] == {"x": 1}
+        assert doc["bad"] == {"error": "boom"}
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_thread_excepthook_writes_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+        eventlog.install_thread_excepthook()
+
+        def die():
+            raise RuntimeError("worker exploded")
+
+        t = threading.Thread(target=die, name="doomed")
+        t.start()
+        t.join(10)
+        bundles = list(tmp_path.glob("flight-*.json"))
+        assert len(bundles) == 1
+        doc = json.loads(bundles[0].read_text())
+        assert "worker exploded" in doc["reason"]
+        ev = next(e for e in doc["events"]
+                  if e["msg"] == "unhandled exception in thread")
+        assert ev["fields"]["thread"] == "doomed"
+
+
+# ---------------------------------------------------------------------------
+# structured (JSON) logging + span correlation
+# ---------------------------------------------------------------------------
+
+class TestJsonLogging:
+    def _capture(self):
+        buf = io.StringIO()
+        h = pylog.StreamHandler(buf)
+        h.setFormatter(slog.JsonFormatter())
+        pylog.getLogger("stellar").addHandler(h)
+        return buf, h
+
+    def test_log_inside_close_span_carries_span_id(self):
+        buf, h = self._capture()
+        try:
+            with tracing.span("ledger.close", seq=5) as s:
+                slog.get("Ledger").warning("slow close at seq %d", 5)
+                span_id = s.span_id
+        finally:
+            pylog.getLogger("stellar").removeHandler(h)
+        line = [ln for ln in buf.getvalue().splitlines()
+                if "slow close" in ln][0]
+        doc = json.loads(line)
+        assert doc["span"] == span_id
+        assert doc["partition"] == "Ledger"
+        assert doc["level"] == "WARNING"
+        assert doc["msg"] == "slow close at seq 5"
+        assert isinstance(doc["ts"], float)
+
+    def test_log_outside_span_has_no_span_key(self):
+        buf, h = self._capture()
+        try:
+            slog.get("Ledger").warning("no span here")
+        finally:
+            pylog.getLogger("stellar").removeHandler(h)
+        doc = json.loads([ln for ln in buf.getvalue().splitlines()
+                          if "no span here" in ln][0])
+        assert "span" not in doc
+
+    def test_set_format_roundtrip(self):
+        assert slog.current_format() == "text"
+        slog.set_format("json")
+        try:
+            assert slog.current_format() == "json"
+            with pytest.raises(ValueError):
+                slog.set_format("xml")
+        finally:
+            slog.set_format("text")
+
+    def test_config_log_format_plumbs(self):
+        cfg = Config.from_dict({"LOG_FORMAT": "json"})
+        assert cfg.LOG_FORMAT == "json"
+        assert Config().LOG_FORMAT == "text"
+
+
+# ---------------------------------------------------------------------------
+# rate_limited helper
+# ---------------------------------------------------------------------------
+
+class TestRateLimited:
+    def test_first_and_every_nth_are_loud(self):
+        slog.reset_rate_limits()
+        log = slog.get("History")
+        levels = []
+        for _ in range(12):
+            emit, n = slog.rate_limited(log, "test-key", 5)
+            levels.append("warn" if emit == log.warning else "debug")
+        # 1st, 5th and 10th loud; everything else quiet
+        assert [i + 1 for i, lv in enumerate(levels) if lv == "warn"] \
+            == [1, 5, 10]
+
+    def test_keys_are_independent(self):
+        slog.reset_rate_limits()
+        log = slog.get("History")
+        slog.rate_limited(log, "k1", 10)
+        emit, n = slog.rate_limited(log, "k2", 10)
+        assert n == 1 and emit == log.warning
+
+
+# ---------------------------------------------------------------------------
+# StatusManager + /health
+# ---------------------------------------------------------------------------
+
+class TestStatusManager:
+    def test_newest_status_per_category_and_clear(self):
+        from stellar_core_tpu.main.status import StatusManager
+        sm = StatusManager()
+        sm.set_status("history-catchup", "downloading checkpoint 63")
+        sm.set_status("history-catchup", "applying checkpoint 63")
+        assert sm.get_status("history-catchup") == "applying checkpoint 63"
+        assert sm.status_lines() == \
+            ["[history-catchup] applying checkpoint 63"]
+        sm.clear_status("history-catchup")
+        assert sm.status_lines() == []
+        with pytest.raises(ValueError):
+            sm.set_status("nope", "x")
+
+
+@pytest.fixture()
+def app_node(tmp_path):
+    """A standalone in-process node with a live admin HTTP server."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.http_admin import CommandHandler
+    from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+    metrics.reset_registry()
+    cfg = Config.from_dict({
+        "NETWORK_PASSPHRASE": "eventlog test net",
+        "RUN_STANDALONE": True,
+        "PEER_PORT": 0,
+    })
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application(cfg, clock=clock, listen=False)
+    http = CommandHandler(app, 0)
+    http.start()
+    app.start()
+    assert clock.crank_until(
+        lambda: app.lm.last_closed_ledger_seq >= 3, timeout=60)
+    try:
+        yield app, clock, http.port
+    finally:
+        http.stop()
+        app.stop()
+
+
+def _get(port, path):
+    """GET returning (status_code, parsed_json) — 4xx/5xx included."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHealth:
+    def test_health_ok_then_degraded_on_stall(self, app_node):
+        from stellar_core_tpu.main.status import evaluate_health
+        app, clock, port = app_node
+        code, doc = _get(port, "/health")
+        assert code == 200 and doc["status"] == "ok", doc
+        assert doc["checks"]["herder_state"] == "tracking"
+        # node.health gauge reads 1.0 while healthy
+        snap = metrics.registry().snapshot()
+        assert snap["node.health"]["value"] == 1.0
+
+        # simulated stall: consensus stops closing ledgers while virtual
+        # time advances well past the close target
+        app.herder.is_validator = False
+        seq = app.lm.last_closed_ledger_seq
+        clock.crank_for(10 * app.herder.ledger_timespan)
+        assert app.lm.last_closed_ledger_seq == seq  # genuinely stalled
+
+        code, doc = _get(port, "/health")
+        assert code == 503 and doc["status"] == "degraded", doc
+        assert any("ledger age" in r for r in doc["reasons"])
+        assert metrics.registry().snapshot()["node.health"]["value"] == 0.0
+        # direct evaluation agrees with the endpoint
+        assert evaluate_health(app)["status"] == "degraded"
+
+    def test_info_carries_status_lines(self, app_node):
+        app, clock, port = app_node
+        app.status.set_status("history-publish", "uploading checkpoint 127")
+        code, doc = _get(port, "/info")
+        assert code == 200
+        assert "[history-publish] uploading checkpoint 127" \
+            in doc["info"]["status"]
+        app.status.clear_status("history-publish")
+
+
+class TestAdminErrorPathsAndDumpflight:
+    def test_unknown_endpoint_404_lists_endpoints(self, app_node):
+        from stellar_core_tpu.main.http_admin import _ENDPOINTS
+        app, clock, port = app_node
+        code, doc = _get(port, "/definitely-not-real")
+        assert code == 404
+        assert doc["error"] == "unknown endpoint"
+        assert doc["endpoints"] == sorted(_ENDPOINTS)
+        assert "/health" in doc["endpoints"]
+        assert "/dumpflight" in doc["endpoints"]
+
+    @pytest.mark.parametrize("path", [
+        "/unban?node=not-hex",
+        "/ban?node=zz",
+        "/ban",                      # missing required param
+        "/droppeer?node=0xnope",
+        "/connect?peer=h&port=eleven",
+        "/getledgerentry?key=nothex",
+        "/ll?level=shouty",
+        "/ll?level=info&partition=Nope",
+        "/ll?format=xml",
+        "/upgrades?mode=set&upgradetime=tomorrow",
+    ])
+    def test_malformed_params_return_400(self, app_node, path):
+        app, clock, port = app_node
+        code, doc = _get(port, path)
+        assert code == 400, (path, code, doc)
+        assert "error" in doc
+
+    def test_ll_rejected_request_is_side_effect_free(self, app_node):
+        # a 400 must not have half-applied: format stays untouched when
+        # the level (validated after it in the old code) is bogus
+        app, clock, port = app_node
+        assert slog.current_format() == "text"
+        code, doc = _get(port, "/ll?format=json&level=shouty")
+        assert code == 400
+        assert slog.current_format() == "text"
+
+    def test_ll_format_switch_roundtrip(self, app_node):
+        app, clock, port = app_node
+        try:
+            code, doc = _get(port, "/ll?format=json")
+            assert code == 200 and doc["format"] == "json"
+            assert slog.current_format() == "json"
+            code, doc = _get(port, "/ll")
+            assert doc["format"] == "json"
+        finally:
+            _get(port, "/ll?format=text")
+        assert slog.current_format() == "text"
+
+    def test_dumpflight_roundtrip(self, app_node):
+        app, clock, port = app_node
+        eventlog.record("Main", "INFO", "marker for dumpflight")
+        code, doc = _get(port, "/dumpflight")
+        assert code == 200
+        assert doc["reason"] == "live dump via /dumpflight"
+        assert any(e["msg"] == "marker for dumpflight"
+                   for e in doc["events"])
+        assert "metrics" in doc and "span_stack" in doc
+        # the application's registered sources ride along
+        assert doc["herder"]["state"] == "tracking"
+        assert doc["config"]["network_passphrase"] == "eventlog test net"
+
+    def test_health_gauge_null_after_teardown(self, tmp_path):
+        # weak_gauge: a torn-down node must read null, not resurrect
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+        metrics.reset_registry()
+        cfg = Config.from_dict({"NETWORK_PASSPHRASE": "gone net",
+                                "RUN_STANDALONE": True, "PEER_PORT": 0})
+        app = Application(cfg, clock=VirtualClock(ClockMode.VIRTUAL_TIME),
+                          listen=False)
+        assert metrics.registry().snapshot()["node.health"]["value"] \
+            is not None
+        app.stop()
+        del app
+        import gc
+        gc.collect()
+        assert metrics.registry().snapshot()["node.health"]["value"] is None
+
+
+# ---------------------------------------------------------------------------
+# lifecycle-edge instrumentation (the sweep actually fires)
+# ---------------------------------------------------------------------------
+
+class TestLifecycleEvents:
+    def test_ledger_close_and_scp_events_from_live_node(self, app_node):
+        app, clock, port = app_node
+        evs = eventlog.event_log().events()
+        assert any(e.partition == "Ledger"
+                   and e.msg == "ledger close sealed" for e in evs)
+        assert any(e.partition == "SCP"
+                   and e.msg == "slot externalized" for e in evs)
+        assert any(e.partition == "SCP"
+                   and e.msg == "herder state transition" for e in evs)
+
+    def test_ban_events(self, app_node):
+        app, clock, port = app_node
+        nid = SecretKey(b"\x42" * 32).public_key.ed25519
+        app.overlay.ban_manager.ban_node(nid)
+        app.overlay.ban_manager.unban_node(nid)
+        msgs = [e.msg for e in eventlog.event_log().events()
+                if e.partition == "Overlay"]
+        assert "node banned" in msgs and "node unbanned" in msgs

@@ -647,7 +647,15 @@ def test_collect_race_loss_degrades_to_cpu(tmp_path, monkeypatch):
     """When the device cannot beat the group's libsodium cost, collect()
     loses the CPU race: seeding is skipped (the apply verifies on CPU —
     identical hashes), losses are counted, and repeated losses disable
-    the pipeline for the rest of the catchup."""
+    the pipeline for the rest of the catchup.
+
+    The race is made DETERMINISTIC via the injectable DEVICE_GATE
+    barrier: every group after the first blocks inside the device worker
+    until the test releases it, so collect() ALWAYS times out at its
+    (tiny, monkeypatched) race budget — the old version only shrank the
+    budget and flaked whenever CPU-jax still finished within 0.25s."""
+    import threading
+
     from stellar_core_tpu.catchup.catchup import PreverifyPipeline
     from stellar_core_tpu.crypto.keys import SecretKey
     from stellar_core_tpu.testutils import (TestAccount, create_account_op,
@@ -682,11 +690,23 @@ def test_collect_race_loss_degrades_to_cpu(tmp_path, monkeypatch):
             history.published_checkpoints[-1] != mgr.last_closed_ledger_seq:
         close([])
 
-    # an impossible race budget: every post-first collect loses instantly
+    # minimal race budget (0.25s floor) + a barrier that HOLDS every
+    # group after the first: those collects deterministically miss
     monkeypatch.setattr(PreverifyPipeline, "RACE_CPU_S_PER_SIG", 1e-12)
-    keys.clear_verify_cache()
-    cm = CatchupManager(nid, "race loss net", accel=True, accel_chunk=256)
-    replayed = cm.catchup_complete(archive)
+    released = threading.Event()
+
+    def gate(group_idx: int) -> None:
+        if group_idx >= 1:
+            released.wait()
+
+    monkeypatch.setattr(PreverifyPipeline, "DEVICE_GATE", staticmethod(gate))
+    try:
+        keys.clear_verify_cache()
+        cm = CatchupManager(nid, "race loss net", accel=True,
+                            accel_chunk=256)
+        replayed = cm.catchup_complete(archive)
+    finally:
+        released.set()   # unblock the parked device worker
     assert replayed.lcl_hash == mgr.lcl_hash   # verdicts identical, on CPU
     assert cm.stats.get("race_losses", 0) >= 1, cm.stats
     assert cm.offload_hit_rate() < 1.0

@@ -494,6 +494,48 @@ class TestMetricRegistry:
 
 
 # ---------------------------------------------------------------------------
+# eventlog-partitions
+# ---------------------------------------------------------------------------
+
+class TestEventlogPartitions:
+    def test_fires_on_unknown_partition_literal(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            from stellar_core_tpu.util import eventlog
+            def f():
+                eventlog.record("Ledgerz", "INFO", "typo'd partition")
+            """, ["eventlog-partitions"])
+        assert len(rule_hits(rep, "eventlog-partitions")) == 1
+
+    def test_fires_on_bare_imported_record(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            from stellar_core_tpu.util.eventlog import record
+            def f():
+                record("NotAPartition", "WARNING", "x", k=1)
+            """, ["eventlog-partitions"])
+        assert len(rule_hits(rep, "eventlog-partitions")) == 1
+
+    def test_quiet_on_known_partitions_and_dynamic(self, tmp_path):
+        rep = lint_src(tmp_path, "m.py", """
+            from stellar_core_tpu.util import eventlog
+            def f(part):
+                eventlog.record("Ledger", "INFO", "close sealed", seq=1)
+                eventlog.record("Overlay", "WARNING", "peer dropped")
+                eventlog.record(part, "INFO", "dynamic: runtime checks")
+            """, ["eventlog-partitions"])
+        assert not rule_hits(rep, "eventlog-partitions")
+
+    def test_quiet_on_unrelated_record_methods(self, tmp_path):
+        # TraceBuffer.record(span) and friends must not be mistaken for
+        # the flight recorder
+        rep = lint_src(tmp_path, "m.py", """
+            def f(buf, root, rec):
+                buf.record(root)
+                rec.record("whatever string")
+            """, ["eventlog-partitions"])
+        assert not rule_hits(rep, "eventlog-partitions")
+
+
+# ---------------------------------------------------------------------------
 # lock-order (static)
 # ---------------------------------------------------------------------------
 
