@@ -1,4 +1,5 @@
-.PHONY: native test lint metrics obs bucketdb bucketdb-slow clean
+.PHONY: native test lint metrics obs bucketdb bucketdb-slow chaos \
+	chaos-soak clean
 
 native:
 	python setup.py build_ext --inplace
@@ -38,6 +39,19 @@ bucketdb-slow:
 obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
 		tests/test_eventlog.py -q -m 'not slow' \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# chaos campaigns: the small-topology scenario tier (12-51 nodes —
+# partition/flap/heal, stall+rejoin, corrupted floods, link-fault ramps,
+# the quorum-split liveness-detection proof) plus the scheduler/replay/
+# health unit tests.  `chaos-soak` adds the -m slow 100- and 300-node
+# campaigns.
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+chaos-soak:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must

@@ -110,7 +110,7 @@ def _degraded_report(detail: str) -> dict:
         value = sig["values"].get("ed25519_tpu_sigs_per_sec", 0.0)
         base = sig["values"].get("ed25519_libsodium_1core_sigs_per_sec", 0.0)
         vs = round(value / base, 2) if base else 0.0
-    for section in ("sigs", "replay", "quorum", "bucketlistdb"):
+    for section in ("sigs", "replay", "quorum", "bucketlistdb", "chaos"):
         got = cache.get(section)
         if not got:
             continue
@@ -243,6 +243,54 @@ def bench_lint():
         "lint_suppressed": len(rep.suppressed),
         "lint_rule_counts": rep.counts_by_rule(),
     }
+
+
+def bench_chaos(time_left_fn):
+    """Chaos campaign section (ISSUE 6): run the small-topology scenario
+    tier — partition/flap/heal, stall+rejoin, corrupted floods, link
+    degradation — and report per-scenario ledgers-closed + measured
+    virtual recovery times.  Scenarios are attempted smallest-first under
+    the remaining global budget; ones that no longer fit emit
+    SKIPPED(budget) rows like every other section."""
+    import logging as _pylogging
+
+    from stellar_core_tpu.simulation import chaos as chaos_mod
+
+    # the sims log one INFO line per peer auth: thousands of lines at
+    # 50 nodes drown the bench stderr, so clamp to WARNING for the section
+    prev_level = _pylogging.getLogger("stellar").level
+    _pylogging.getLogger("stellar").setLevel(_pylogging.WARNING)
+    # the catalogue IS the plan (cheapest first) — the flagship 51-node
+    # campaign dominates; its estimate tracks the tier-1 test's runtime
+    plan = sorted(chaos_mod.SMALL_SCENARIOS, key=lambda fe: fe[1])
+    vals = {"chaos_scenarios": {}}
+    total_ledgers = 0
+    failures = 0
+    try:
+        for make, est in plan:
+            sc = make()
+            if time_left_fn() < est * 1.25 + 30.0:
+                vals["chaos_scenarios"][sc.name] = "SKIPPED(budget)"
+                continue
+            _stage(f"chaos scenario {sc.name}...")
+            t0 = time.perf_counter()
+            res = chaos_mod.run_scenario(sc)
+            row = res.to_report()
+            row["wall_s"] = round(time.perf_counter() - t0, 1)
+            vals["chaos_scenarios"][sc.name] = row
+            total_ledgers += res.ledgers_closed
+            if not res.passed:
+                failures += 1
+    finally:
+        _pylogging.getLogger("stellar").setLevel(prev_level)
+    vals["chaos_total_ledgers"] = total_ledgers
+    vals["chaos_failed_scenarios"] = failures
+    recs = [max(r["recovery_s"])
+            for r in vals["chaos_scenarios"].values()
+            if isinstance(r, dict) and r.get("recovery_s")]
+    if recs:
+        vals["chaos_recovery_s_max"] = max(recs)
+    return vals
 
 
 def bench_merge_throughput(workdir):
@@ -753,6 +801,17 @@ def main():
     else:
         extra["bucketlistdb"] = "SKIPPED(budget)"
         _stale_fill(extra, "bucketlistdb")
+
+    # chaos campaigns are CPU-only too; the section degrades scenario by
+    # scenario under the global deadline (cheapest first)
+    if budget_fits("chaos", 150):
+        _stage("chaos campaign bench (CPU-only)...")
+        chaos_vals = bench_chaos(time_left)
+        _cache_put("chaos", chaos_vals)
+        extra.update(chaos_vals)
+    else:
+        extra["chaos"] = "SKIPPED(budget)"
+        _stale_fill(extra, "chaos")
 
     if not budget_fits("device probe + accel sections", 240):
         # nothing device-side fits anymore: emit what the CPU sections

@@ -29,12 +29,132 @@ _EMPTY_HEX = "0" * 64
 
 
 class BucketDir:
+    # every bucket file this directory is supposed to hold, one hex hash
+    # per line — written alongside the files so a startup audit can tell
+    # "this file was deleted/lost" apart from "this file was never ours".
+    # Content addressing alone cannot: a vanished file leaves no trace.
+    MANIFEST_NAME = "MANIFEST"
+
     def __init__(self, path: str):
         self.path = path
+        self._manifest_cache: Optional[Set[str]] = None
         os.makedirs(path, exist_ok=True)
 
     def _file_for(self, hex_hash: str) -> str:
         return os.path.join(self.path, f"bucket-{hex_hash}.xdr")
+
+    # -- manifest ------------------------------------------------------------
+    @property
+    def _manifest_path(self) -> str:
+        return os.path.join(self.path, self.MANIFEST_NAME)
+
+    def _manifest_read(self) -> Set[str]:
+        """Well-formed entries only (64 hex chars): a torn tail line from
+        a crash mid-append must read as absent — the full-file hash scan
+        in audit() still covers whatever file the lost entry named — not
+        as a permanently unstartable 'missing bucket <garbage>'.
+
+        Cached in memory after the first read (this instance is the only
+        writer for its directory — Application aliases bucket_dir to the
+        store): save() membership-checks on every ledger close, and
+        re-reading the file each time puts O(manifest) disk reads on the
+        persistence hot path.  Only a missing file means 'legacy dir';
+        a real I/O error must surface — swallowing EIO here would
+        silently disable the missing-bucket half of the startup audit
+        exactly when the disk is in trouble."""
+        if self._manifest_cache is None:
+            try:
+                with open(self._manifest_path) as f:
+                    self._manifest_cache = {
+                        line.strip() for line in f
+                        if len(line.strip()) == 64
+                        and all(c in "0123456789abcdef"
+                                for c in line.strip())}
+            except FileNotFoundError:
+                self._manifest_cache = set()   # pre-manifest legacy dir
+        return set(self._manifest_cache)
+
+    def _manifest_write(self, hashes: Set[str]) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("".join(hh + "\n" for hh in sorted(hashes)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+        self._manifest_cache = set(hashes)
+
+    def _manifest_add(self, hex_hash: str) -> None:
+        # O_APPEND one-line write: atomic enough for concurrent adopters;
+        # duplicates are harmless (the reader is a set).  If a crash left
+        # a torn tail line (no trailing newline), lead with one so this
+        # entry does not glue onto the fragment and invalidate both —
+        # blank lines are filtered by the reader.
+        lead = ""
+        try:
+            with open(self._manifest_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() > 0:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        lead = "\n"
+        except FileNotFoundError:
+            pass
+        with open(self._manifest_path, "a") as f:
+            f.write(lead + hex_hash + "\n")
+        if self._manifest_cache is not None:
+            self._manifest_cache.add(hex_hash)
+
+    def _manifest_has(self, hex_hash: str) -> bool:
+        # membership against the cache directly — _manifest_read()'s
+        # defensive copy is O(manifest) and this runs per ledger close
+        if self._manifest_cache is None:
+            self._manifest_read()
+        return hex_hash in self._manifest_cache
+
+    def _manifest_readopt(self, hex_hash: str) -> None:
+        """Re-adopt a file left untracked by a crash between its durable
+        rename and the manifest append — otherwise it can never become
+        manifest-tracked and its later loss escapes audit()."""
+        if not self._manifest_has(hex_hash):
+            self._manifest_add(hex_hash)
+
+    def _fsync_dir(self) -> None:
+        dfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def audit(self) -> int:
+        """Fail-stop integrity scan, run before on-disk state is trusted
+        (reference shape: BucketManager::assumeState verifying buckets
+        against the HAS): every manifest-listed bucket file must exist,
+        and every bucket file present must hash to its own name — a
+        flipped byte or a vanished file raises RuntimeError with the
+        offending path instead of surfacing later as wrong ledger state.
+        Returns the number of files verified."""
+        on_disk: Set[str] = set()
+        for name in os.listdir(self.path):
+            if name.startswith("bucket-") and name.endswith(".xdr"):
+                on_disk.add(name[len("bucket-"):-len(".xdr")])
+        for hh in sorted(self._manifest_read()):
+            if hh != _EMPTY_HEX and hh not in on_disk:
+                raise RuntimeError(f"missing bucket {hh} "
+                                   f"(manifest-listed, not on disk)")
+        verified = 0
+        for hh in sorted(on_disk):
+            sha = SHA256()
+            with open(self._file_for(hh), "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    sha.add(chunk)
+            if sha.finish().hex() != hh:
+                raise RuntimeError(
+                    f"bucket file {self._file_for(hh)} fails hash check")
+            verified += 1
+        return verified
 
     def save(self, bucket: Bucket) -> str:
         """Persist a bucket; returns its hex hash.  Existing files are
@@ -44,6 +164,7 @@ class BucketDir:
             return _EMPTY_HEX
         target = self._file_for(hh)
         if os.path.exists(target):
+            self._manifest_readopt(hh)
             return hh
         tmp = target + ".tmp"
         with open(tmp, "wb") as f:
@@ -53,11 +174,12 @@ class BucketDir:
         os.replace(tmp, target)
         # fsync the directory so the rename itself survives power loss —
         # the DB that points at this bucket commits after us
-        dfd = os.open(self.path, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        self._fsync_dir()
+        # manifest entry only AFTER the rename is durable: a crash in
+        # between leaves an untracked-but-intact file (harmless), never a
+        # durable manifest entry whose file rename was lost (a false
+        # missing-bucket fail-stop at the next startup audit)
+        self._manifest_add(hh)
         return hh
 
     def load(self, hex_hash: str) -> Optional[Bucket]:
@@ -80,18 +202,32 @@ class BucketDir:
 
     def gc(self, referenced: Iterable[str]) -> int:
         """Delete bucket files not in `referenced` (reference:
-        BucketManager::forgetUnreferencedBuckets).  Returns removed count."""
+        BucketManager::forgetUnreferencedBuckets).  Returns removed count.
+        The manifest is rewritten BEFORE any unlink: a crash in between
+        leaves an untracked-but-intact file (re-collected next pass), never
+        a manifest entry whose file is gone (a false missing-bucket
+        fail-stop at the next startup audit)."""
         keep: Set[str] = set(referenced)
         keep.update(self._protected_hashes())
-        removed = 0
+        victims: List[str] = []
         for name in os.listdir(self.path):
             if not (name.startswith("bucket-") and name.endswith(".xdr")):
                 continue
             hh = name[len("bucket-"):-len(".xdr")]
             if hh not in keep:
-                os.unlink(os.path.join(self.path, name))
-                self._on_removed(hh)
-                removed += 1
+                victims.append(hh)
+        if victims:
+            self._manifest_write(self._manifest_read() - set(victims))
+            # the rewrite must be durable BEFORE any unlink: a crash that
+            # persists the unlinks but loses the manifest rename would
+            # leave durable entries for vanished files — the very false
+            # fail-stop this ordering exists to prevent
+            self._fsync_dir()
+        removed = 0
+        for hh in victims:
+            os.unlink(self._file_for(hh))
+            self._on_removed(hh)
+            removed += 1
         return removed
 
     def _protected_hashes(self) -> Set[str]:
@@ -223,14 +359,13 @@ class BucketListStore(BucketDir):
             if os.path.exists(target):
                 deduped = True
                 os.unlink(tmp_path)  # dedup: identical content already stored
+                self._manifest_readopt(hh)
             else:
                 deduped = False
                 os.replace(tmp_path, target)
-                dfd = os.open(self.path, os.O_RDONLY)
-                try:
-                    os.fsync(dfd)
-                finally:
-                    os.close(dfd)
+                self._fsync_dir()
+                # after the rename is durable — same ordering as save()
+                self._manifest_add(hh)
         # recorded OUTSIDE the store lock: the event-log lock is a leaf
         eventlog.record("Bucket", "INFO", "stream merge output adopted",
                         hash=hh[:16], entries=len(idx._keys),
@@ -271,6 +406,7 @@ class BucketListStore(BucketDir):
         if idx is not None:
             return idx
         if os.path.exists(self._file_for(hh)):
+            self._manifest_readopt(hh)
             return self.index_for(hh)
         self.save(bucket)
         idx = DiskBucketIndex.from_bucket(bucket, self._file_for(hh))

@@ -94,6 +94,7 @@ class Herder(SCPDriver):
         self.ledger_timespan = EXP_LEDGER_TIMESPAN_SECONDS
         self._timers: Dict[Tuple[int, int], VirtualTimer] = {}
         self._trigger_timer: Optional[VirtualTimer] = None
+        self._tracking_timer: Optional[VirtualTimer] = None
         self._last_trigger_at: float = clock.now()
         # slot -> externalized StellarValue waiting for its ledger turn
         self._buffered: Dict[int, X.StellarValue] = {}
@@ -101,6 +102,13 @@ class Herder(SCPDriver):
         # slot -> perf_counter at nomination trigger (scp.slot.externalize
         # timer: nomination start -> value applied)
         self._nominate_started: Dict[int, float] = {}
+        # recovery bookkeeping: how often this node fell out of sync and
+        # how many ledgers it applied from the buffered-externalize queue
+        # while catching back up — the chaos runner asserts a stalled
+        # validator actually exercised these paths after rejoin instead of
+        # inferring recovery from the LCL alone
+        self.recovery_stats: Dict[str, int] = {"out_of_sync": 0,
+                                               "buffered_applied": 0}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -110,6 +118,7 @@ class Herder(SCPDriver):
         Reference: HerderImpl::bootstrap (FORCE_SCP path)."""
         self._set_state(HerderState.TRACKING, "bootstrap")
         self._last_trigger_at = self.clock.now()
+        self._arm_tracking_heartbeat()
         self.trigger_next_ledger(self.tracking_consensus_ledger_index() + 1)
 
     def start(self) -> None:
@@ -438,6 +447,7 @@ class Herder(SCPDriver):
         lcl = self.tracking_consensus_ledger_index()
         for s in [s for s in self._buffered if s <= lcl]:
             del self._buffered[s]
+        applied = 0
         while True:
             nxt = self.tracking_consensus_ledger_index() + 1
             sv = self._buffered.pop(nxt, None)
@@ -450,9 +460,16 @@ class Herder(SCPDriver):
                 self._lost_sync()
                 return
             txset, frames = got
+            applied += 1
+            if applied > 1:
+                # second-and-later ledgers in one drain call were sitting
+                # in the buffer while this node lagged: that's the
+                # buffered-externalize catchup path, not live consensus
+                self.recovery_stats["buffered_applied"] += 1
             arts = self.lm.close_ledger(frames, sv.closeTime, tx_set=txset,
                                         stellar_value=sv)
             self._set_state(HerderState.TRACKING, "externalized value applied")
+            self._arm_tracking_heartbeat()
             _registry().meter("herder.ledger.externalize").mark()
             t0 = self._nominate_started.pop(nxt, None)
             if t0 is not None:
@@ -477,11 +494,37 @@ class Herder(SCPDriver):
                 self.tracking_consensus_ledger_index() + 1:
             self._lost_sync()
 
+    def _arm_tracking_heartbeat(self) -> None:
+        """Reference: HerderImpl::trackingHeartBeat — while this node
+        believes it is tracking consensus, an externalized value must
+        arrive within CONSENSUS_STUCK_TIMEOUT_SECONDS.  One-shot: rearmed
+        on every applied value, NOT on expiry, so an idle herder arms no
+        perpetual timer.  Expiry while still TRACKING means the node is
+        stuck (isolated validator, partitioned minority) and must declare
+        itself out of sync so the recovery machinery — SCP-state pull
+        from peers, archive catchup handoff — takes over instead of
+        waiting forever for envelopes that cannot arrive."""
+        if self._tracking_timer is not None:
+            self._tracking_timer.cancel()
+        self._tracking_timer = VirtualTimer(self.clock)
+        self._tracking_timer.expires_from_now(
+            CONSENSUS_STUCK_TIMEOUT_SECONDS, self._herder_stuck)
+
+    def _herder_stuck(self) -> None:
+        if self.state != HerderState.TRACKING:
+            return
+        log.warning("no ledger externalized for %ds at lcl=%d: "
+                    "declaring out of sync",
+                    CONSENSUS_STUCK_TIMEOUT_SECONDS,
+                    self.tracking_consensus_ledger_index())
+        self._lost_sync()
+
     def _lost_sync(self) -> None:
         if self.state != HerderState.SYNCING:
             log.warning("herder out of sync at lcl=%d buffered=%s",
                         self.tracking_consensus_ledger_index(),
                         sorted(self._buffered))
+            self.recovery_stats["out_of_sync"] += 1
             self._set_state(HerderState.SYNCING, "lost sync")
             self.lost_sync_hook()
             self.out_of_sync_handler()

@@ -572,6 +572,14 @@ class LedgerManager:
         from ..history.archive import HistoryArchiveState
         from ..bucket.bucket_list import NUM_LEVELS
 
+        # integrity audit BEFORE any on-disk state is trusted: every
+        # manifest-listed bucket file must be present and every file must
+        # hash to its name — a corrupted or vanished file (even one only a
+        # pinned snapshot or publish queue still needs) fail-stops with a
+        # diagnostic here instead of serving wrong ledger state later
+        verified = bucket_dir.audit()
+        log.info("bucket dir audit: %d files hash-verified", verified)
+
         lcl_hex = database.get_state(PersistentState.LAST_CLOSED_LEDGER)
         if lcl_hex is None:
             raise RuntimeError("database has no last closed ledger")

@@ -142,7 +142,11 @@ def evaluate_health(app) -> dict:
         reasons.append(f"tx queue depth {depth} exceeds {max_depth}")
 
     peers = app.overlay.num_authenticated()
-    standalone = app.config.RUN_STANDALONE or not app.config.KNOWN_PEERS
+    # an app without a config (e.g. a simulated in-process node) is by
+    # definition part of a network and expects peers
+    cfg = getattr(app, "config", None)
+    standalone = cfg is not None and (cfg.RUN_STANDALONE
+                                      or not cfg.KNOWN_PEERS)
     if peers == 0 and not standalone:
         reasons.append("no authenticated peers")
 

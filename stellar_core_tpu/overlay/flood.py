@@ -104,17 +104,33 @@ class ItemFetcher:
 
     Reference: src/overlay/ItemFetcher.{h,cpp} + Tracker — one tracker per
     wanted hash, asking one peer at a time, advancing on DONT_HAVE or peer
-    drop, re-asking as new peers authenticate."""
+    drop, re-asking as new peers authenticate, and RETRYING on a timer
+    (reference: MS_TO_WAIT_FOR_FETCH_REPLY): a request or reply frame
+    lost in flight (lossy link, peer severed mid-fetch) must not wedge
+    the tracker until some unrelated peer happens to authenticate — the
+    chaos link-degradation campaigns wedge exactly there without it.
+    Once every current peer has been asked, a retry round clears the
+    asked set and starts over; after RETRY_LIMIT rounds the tracker is
+    dropped (the item is gone network-wide — e.g. a tx set purged past
+    the peers' slot memory) so dead hashes don't re-arm timers forever."""
 
-    def __init__(self, ask: Callable):
+    RETRY_PERIOD_S = 1.5
+    RETRY_LIMIT = 64
+
+    def __init__(self, ask: Callable, clock=None,
+                 peers_fn: Optional[Callable[[], List]] = None):
         self._ask = ask               # (peer, item_type, hash)
+        self._clock = clock
+        self._peers_fn = peers_fn
         self._tracking: Dict[bytes, dict] = {}
 
     def fetch(self, item_type: str, h: bytes, peers: List) -> None:
         if h in self._tracking:
             return
-        self._tracking[h] = {"type": item_type, "asked": set()}
+        self._tracking[h] = {"type": item_type, "asked": set(),
+                             "retries": 0, "timer": None}
         self._try_next(h, peers)
+        self._arm_retry(h)
 
     def _try_next(self, h: bytes, peers: List) -> None:
         tr = self._tracking.get(h)
@@ -125,7 +141,37 @@ class ItemFetcher:
                 tr["asked"].add(peer)
                 self._ask(peer, tr["type"], h)
                 return
-        # nobody left to ask; tracker stays until stop_fetch or new peers
+        # nobody left to ask; the retry timer (or a new peer) re-opens
+
+    def _arm_retry(self, h: bytes) -> None:
+        if self._clock is None:
+            return
+        from ..util.clock import VirtualTimer
+        tr = self._tracking.get(h)
+        if tr is None:
+            return
+        timer = VirtualTimer(self._clock)
+        tr["timer"] = timer
+        timer.expires_from_now(self.RETRY_PERIOD_S,
+                               lambda: self._retry(h))
+
+    def _retry(self, h: bytes) -> None:
+        tr = self._tracking.get(h)
+        if tr is None:
+            return   # answered (stop_fetch) since the timer was armed
+        peers = self._peers_fn() if self._peers_fn is not None else []
+        if all(p in tr["asked"] for p in peers):
+            # full round exhausted (vacuously so when no peers exist):
+            # count ROUNDS, not timer fires — with more peers than
+            # RETRY_LIMIT every peer must still be asked once before the
+            # tracker can be declared dead
+            tr["retries"] += 1
+            if tr["retries"] > self.RETRY_LIMIT:
+                del self._tracking[h]
+                return
+            tr["asked"].clear()
+        self._try_next(h, peers)
+        self._arm_retry(h)
 
     def dont_have(self, h: bytes, from_peer, peers: List) -> None:
         self._try_next(h, peers)
@@ -135,7 +181,9 @@ class ItemFetcher:
             self._try_next(h, peers)
 
     def stop_fetch(self, h: bytes) -> None:
-        self._tracking.pop(h, None)
+        tr = self._tracking.pop(h, None)
+        if tr is not None and tr.get("timer") is not None:
+            tr["timer"].cancel()
 
     def wanted(self) -> List[bytes]:
         return list(self._tracking)

@@ -52,7 +52,8 @@ class OverlayManager:
                                         self_port=listening_port)
         self.floodgate = Floodgate()
         self.adverts = TxAdverts(self._send_advert, self._send_demand)
-        self.fetcher = ItemFetcher(self._ask_for_item)
+        self.fetcher = ItemFetcher(self._ask_for_item, clock=clock,
+                                   peers_fn=self._auth_peer_list)
         self.ban_manager = BanManager(database)
         self.survey = SurveyManager(self, node_secret)
         herder.lost_sync_hook = self.survey.record_lost_sync

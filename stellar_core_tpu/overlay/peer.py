@@ -346,17 +346,23 @@ class LoopbackPeer(Peer):
     src/overlay/test/LoopbackPeer) — bytes are delivered to the partner via
     clock-posted actions, so delivery interleaves with timers."""
 
-    def __init__(self, overlay, we_called_remote: bool):
+    def __init__(self, overlay, we_called_remote: bool,
+                 fault_rng=None):
         super().__init__(overlay, we_called_remote)
         self.partner: Optional["LoopbackPeer"] = None
         # fault-injection knobs (reference: LoopbackPeer's damage/drop/
-        # reorder probabilities used by overlay tests)
+        # reorder probabilities used by overlay tests).  The RNG feeding
+        # them is injectable: the Simulation derives one seeded stream per
+        # loopback pair so an entire chaos campaign replays bit-identically
+        # from its logged seed; standalone tests keep the fixed default.
         self.drop_outbound = False       # black hole
         self.damage_probability = 0.0    # flip a byte in outbound frames
         self.drop_probability = 0.0      # silently drop outbound frames
         self.reorder_probability = 0.0   # delay a frame behind the next
-        import random as _random
-        self.fault_rng = _random.Random(0)  # deterministic by default
+        if fault_rng is None:
+            import random as _random
+            fault_rng = _random.Random(0)  # deterministic by default
+        self.fault_rng = fault_rng
         self._held_back: Optional[bytes] = None
         self._backstop_gen = 0
 
@@ -436,10 +442,15 @@ class LoopbackPeer(Peer):
             partner.drop("partner closed")
 
 
-def make_loopback_pair(overlay_a, overlay_b):
-    """Wire two overlays with a loopback connection; a dials b."""
-    pa = LoopbackPeer(overlay_a, we_called_remote=True)
-    pb = LoopbackPeer(overlay_b, we_called_remote=False)
+def make_loopback_pair(overlay_a, overlay_b, fault_rng=None):
+    """Wire two overlays with a loopback connection; a dials b.
+
+    ``fault_rng`` (a seeded ``random.Random``) is shared by both
+    directions of the link: every damage/drop/reorder decision on the
+    pair draws from one deterministic stream, so a simulation that logs
+    its seed can replay the exact same fault sequence."""
+    pa = LoopbackPeer(overlay_a, we_called_remote=True, fault_rng=fault_rng)
+    pb = LoopbackPeer(overlay_b, we_called_remote=False, fault_rng=fault_rng)
     pa.partner, pb.partner = pb, pa
     overlay_a._register_peer(pa)
     overlay_b._register_peer(pb)

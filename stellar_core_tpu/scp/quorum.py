@@ -69,6 +69,65 @@ def is_v_blocking(qset, nodes: Set[NodeIDb]) -> bool:
     return False
 
 
+def compile_qset(qset) -> tuple:
+    """Flatten a qset into plain nested tuples ``(threshold,
+    (validator_bytes, ...), (inner, ...))`` — slice checks over the
+    compiled form skip the per-field XDR descriptor machinery, which
+    dominates `is_quorum` wall time on large simulated networks (a
+    51-node hierarchical sim spent 21s of a 37s consensus run inside
+    `is_quorum_slice` before this)."""
+    return (qset.threshold,
+            tuple(v.value for v in qset.validators),
+            tuple(compile_qset(i) for i in qset.innerSets))
+
+
+# id(qset) -> (qset, compiled form).  XDR structs are __slots__-bound (no
+# per-instance memo field) and hashing the canonical encoding per lookup
+# costs more than the walk it would save, so the cache key is the object
+# id — made safe by pinning a strong reference to the keyed object in the
+# value (an id is only ever reused after its object is collected, and a
+# pinned object never is).  SCP treats quorum sets as immutable once
+# announced; mutating a cached instance in place would go unseen.
+# Bounded: distinct qset instances per process are few (one per herder
+# per topology shape), but a long fuzz run must not grow this without
+# limit — on overflow the cache is dropped wholesale, unpinning ids.
+_COMPILED_CACHE_MAX = 4096
+_compiled_cache: Dict[int, tuple] = {}
+
+
+def compile_qset_cached(qset) -> tuple:
+    got = _compiled_cache.get(id(qset))
+    if got is not None:
+        return got[1]
+    if len(_compiled_cache) >= _COMPILED_CACHE_MAX:
+        _compiled_cache.clear()
+    cq = compile_qset(qset)
+    _compiled_cache[id(qset)] = (qset, cq)
+    return cq
+
+
+def _compiled_slice_ok(cq: tuple, nodes: Set[NodeIDb]) -> bool:
+    threshold, validators, inners = cq
+    if threshold <= 0:
+        # is_quorum_slice returns count >= 0 == True for a threshold-0
+        # set; the early-exit walk below would return False when no
+        # member matches, silently diverging on (insane but legal-to-
+        # construct) inputs is_qset_sane never vetted
+        return True
+    count = 0
+    for v in validators:
+        if v in nodes:
+            count += 1
+            if count >= threshold:
+                return True
+    for inner in inners:
+        if _compiled_slice_ok(inner, nodes):
+            count += 1
+            if count >= threshold:
+                return True
+    return False
+
+
 def is_quorum(local_qset, stmt_map: Dict[NodeIDb, object],
               qset_of: Callable[[object], Optional[object]],
               voted: Callable[[object], bool]) -> bool:
@@ -78,18 +137,33 @@ def is_quorum(local_qset, stmt_map: Dict[NodeIDb, object],
     Transitive fixpoint: repeatedly drop nodes whose own quorum set (looked up
     from their statement via `qset_of`) has no slice inside the surviving set.
     Reference: LocalNode::isQuorum.
+
+    Nodes sharing one qset object (the common case: every validator in a
+    tier-1-shaped network announces the same hierarchical set) share ONE
+    compiled form and ONE slice evaluation per fixpoint iteration instead
+    of re-walking the XDR tree per node.
     """
     nodes = {n for n, st in stmt_map.items() if voted(st)}
+    node_cq: Dict[NodeIDb, Optional[tuple]] = {}
+    for n in nodes:
+        q = qset_of(stmt_map[n])
+        node_cq[n] = None if q is None else compile_qset_cached(q)
     while True:
+        verdicts: Dict[int, bool] = {}  # id(compiled) -> slice-in-`nodes`
         keep = set()
         for n in nodes:
-            q = qset_of(stmt_map[n])
-            if q is not None and is_quorum_slice(q, nodes):
+            cq = node_cq[n]
+            if cq is None:
+                continue
+            ok = verdicts.get(id(cq))
+            if ok is None:
+                ok = verdicts[id(cq)] = _compiled_slice_ok(cq, nodes)
+            if ok:
                 keep.add(n)
         if keep == nodes:
             break
         nodes = keep
-    return is_quorum_slice(local_qset, nodes)
+    return _compiled_slice_ok(compile_qset_cached(local_qset), nodes)
 
 
 def find_closest_v_blocking(qset, nodes: Set[NodeIDb],

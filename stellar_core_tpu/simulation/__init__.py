@@ -1,13 +1,15 @@
-"""Simulation layer: in-process multi-node networks + load generation.
+"""Simulation layer: in-process multi-node networks + load generation +
+chaos campaigns.
 
 Reference: src/simulation/ (SURVEY.md §2.1).
 """
 
 from .loadgen import LoadGenerator
-from .simulation import (SimNode, Simulation, make_core_topology,
-                         make_cycle_topology,
+from .simulation import (SimNode, Simulation, make_asymmetric_topology,
+                         make_core_topology, make_cycle_topology,
                          make_hierarchical_topology, qset_of)
 
-__all__ = ["LoadGenerator", "SimNode", "Simulation", "make_core_topology",
+__all__ = ["LoadGenerator", "SimNode", "Simulation",
+           "make_asymmetric_topology", "make_core_topology",
            "make_cycle_topology", "make_hierarchical_topology",
            "qset_of"]

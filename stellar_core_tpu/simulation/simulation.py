@@ -17,6 +17,7 @@ Partitions sever the loopback connections; healing redials them.
 
 from __future__ import annotations
 
+import random
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import xdr as X
@@ -25,6 +26,7 @@ from ..crypto.sha import sha256
 from ..herder.herder import Herder, HerderState
 from ..herder.upgrades import Upgrades
 from ..ledger.manager import LedgerManager
+from ..main.status import StatusManager
 from ..overlay.overlay_manager import OverlayManager
 from ..overlay.peer import make_loopback_pair
 from ..scp.quorum import qset_hash
@@ -60,6 +62,9 @@ class SimNode:
                                       secret)
         self.partition = 0   # connection-group tag (see partition_nodes)
         self.closed: Dict[int, bytes] = {}  # seq -> ledger hash
+        # per-category status lines, same manager a full Application runs
+        # (main/status) — evaluate_health reuses it unchanged
+        self.status = StatusManager()
         self.herder.ledger_closed_hook = self._on_ledger_closed
         self.herder.out_of_sync_handler = self._on_out_of_sync
 
@@ -81,21 +86,55 @@ class SimNode:
     def lcl_hash(self) -> bytes:
         return self.lm.lcl_hash
 
+    @property
+    def clock(self) -> VirtualClock:
+        return self.sim.clock
+
     def submit(self, frame) -> object:
         return self.herder.recv_transaction(frame)
+
+    def evaluate_health(self) -> dict:
+        """The same ``/health`` document a full Application serves
+        (main/status.evaluate_health over this node's ledger age, herder
+        state, tx-queue depth and peer count) — the chaos runner's
+        degraded/recovered assertions reuse production health logic
+        instead of re-deriving it."""
+        from ..main.status import evaluate_health
+        return evaluate_health(self)
+
+    def is_healthy(self) -> bool:
+        return self.evaluate_health()["status"] == "ok"
 
 
 class Simulation:
     OVER_LOOPBACK = "loopback"
 
     def __init__(self, network_passphrase: bytes = b"sim network",
-                 mode: str = OVER_LOOPBACK):
+                 mode: str = OVER_LOOPBACK,
+                 seed: Optional[int] = None):
         self.network_id = sha256(network_passphrase)
         self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
         self.nodes: List[SimNode] = []
         self.by_id: Dict[bytes, SimNode] = {}
         # live loopback connections: frozenset({id_a, id_b}) -> (pa, pb)
         self._connections: Dict[frozenset, Tuple] = {}
+        # fault-injection determinism: when a seed is given, every
+        # loopback pair gets its own random stream derived from
+        # (seed, the two node ids) — stable under dial order and under
+        # redials, so one logged integer replays a whole campaign's
+        # damage/drop/reorder decisions.  `self.rng` is the scheduler-level
+        # stream (fault timing jitter etc.).
+        self.seed = seed
+        self.rng = random.Random(seed)
+
+    def _pair_rng(self, id_a: bytes, id_b: bytes) -> Optional[random.Random]:
+        if self.seed is None:
+            return None
+        lo, hi = sorted((id_a, id_b))
+        material = sha256(b"link-fault-rng|" +
+                          self.seed.to_bytes(8, "big", signed=True) +
+                          lo + hi)
+        return random.Random(int.from_bytes(material, "big"))
 
     # -- topology ----------------------------------------------------------
     def add_node(self, secret: SecretKey, qset,
@@ -124,21 +163,42 @@ class Simulation:
                     pair[1].state != Peer.CLOSING:
                 return  # still live
             del self._connections[key]
-        self._connections[key] = make_loopback_pair(a.overlay, b.overlay)
+        self._connections[key] = make_loopback_pair(
+            a.overlay, b.overlay,
+            fault_rng=self._pair_rng(a.node_id, b.node_id))
 
     def disconnect(self, a: SimNode, b: SimNode) -> None:
+        """Sever a link.  BOTH ends are dropped explicitly: drop() on a
+        peer that is already CLOSING (it dropped itself earlier — ban,
+        overlay error) is a no-op that never reaches its partner, so
+        dropping only pair[0] could leak a half-open connection that a
+        later flapping redial would then refuse to replace."""
+        from ..overlay.peer import Peer
         key = frozenset((a.node_id, b.node_id))
         pair = self._connections.pop(key, None)
         if pair is not None:
             pair[0].drop("sim disconnect")
+            pair[1].drop("sim disconnect")
+            assert pair[0].state == Peer.CLOSING \
+                and pair[1].state == Peer.CLOSING, \
+                "severed loopback pair must close both ends"
 
-    def start_all_nodes(self) -> None:
+    def is_connected(self, a: SimNode, b: SimNode) -> bool:
+        from ..overlay.peer import Peer
+        pair = self._connections.get(frozenset((a.node_id, b.node_id)))
+        return pair is not None and pair[0].state != Peer.CLOSING \
+            and pair[1].state != Peer.CLOSING
+
+    def start_all_nodes(self, mesh: bool = True) -> None:
         # default mesh: every node pair connected (the bus the herder sims
         # assume); explicit connect() calls before start override nothing —
-        # connect() is idempotent per pair
-        for i, a in enumerate(self.nodes):
-            for b in self.nodes[i + 1:]:
-                self.connect(a, b)
+        # connect() is idempotent per pair.  mesh=False keeps whatever
+        # sparse graph the caller dialed (large chaos topologies would be
+        # O(n^2) links otherwise).
+        if mesh:
+            for i, a in enumerate(self.nodes):
+                for b in self.nodes[i + 1:]:
+                    self.connect(a, b)
         # let the auth handshakes complete before consensus starts
         self.clock.crank_for(0.1)
         for n in self.nodes:
@@ -202,10 +262,11 @@ def qset_of(node_ids: List[bytes], threshold: int):
 
 
 def make_core_topology(n: int, threshold: Optional[int] = None,
-                       passphrase: bytes = b"sim network") -> Simulation:
+                       passphrase: bytes = b"sim network",
+                       seed: Optional[int] = None) -> Simulation:
     """Fully-connected n-validator network with a shared flat qset.
     Reference: Topologies::core."""
-    sim = Simulation(passphrase)
+    sim = Simulation(passphrase, seed=seed)
     secrets = [SecretKey(bytes([i + 1]) * 32) for i in range(n)]
     ids = [s.public_key.ed25519 for s in secrets]
     q = qset_of(ids, threshold if threshold is not None else (2 * n + 2) // 3)
@@ -215,12 +276,13 @@ def make_core_topology(n: int, threshold: Optional[int] = None,
 
 
 def make_cycle_topology(n: int,
-                        passphrase: bytes = b"sim cycle") -> Simulation:
+                        passphrase: bytes = b"sim cycle",
+                        seed: Optional[int] = None) -> Simulation:
     """Ring: each validator trusts itself and both ring neighbours (2-of-3
     slices).  Reference: Topologies::cycle — connectivity-limited liveness
     testing; intersection holds because adjacent slices chain around the
     ring."""
-    sim = Simulation(passphrase)
+    sim = Simulation(passphrase, seed=seed)
     secrets = [SecretKey(bytes([i + 1]) * 32) for i in range(n)]
     ids = [s.public_key.ed25519 for s in secrets]
     for i, s in enumerate(secrets):
@@ -230,13 +292,14 @@ def make_cycle_topology(n: int,
 
 
 def make_hierarchical_topology(n_orgs: int, nodes_per_org: int = 3,
-                               passphrase: bytes = b"sim tiers"
+                               passphrase: bytes = b"sim tiers",
+                               seed: Optional[int] = None
                                ) -> Simulation:
     """Tiered: org-inner 2-of-3 qsets nested under a 2/3-of-orgs outer
     threshold — the tier-1 shape (reference: Topologies::hierarchicalQuorum;
     same org structure the quorum-intersection bench uses)."""
     from ..crypto.sha import sha256
-    sim = Simulation(passphrase)
+    sim = Simulation(passphrase, seed=seed)
     secrets = [[SecretKey(sha256(b"hier-node-%d-%d" % (o, g)))
                 for g in range(nodes_per_org)] for o in range(n_orgs)]
     inner = [qset_of([s.public_key.ed25519 for s in org],
@@ -247,4 +310,31 @@ def make_hierarchical_topology(n_orgs: int, nodes_per_org: int = 3,
     for org in secrets:
         for s in org:
             sim.add_node(s, outer)
+    return sim
+
+
+def make_asymmetric_topology(n_core_orgs: int, nodes_per_org: int = 3,
+                             n_leaf: int = 10,
+                             passphrase: bytes = b"sim asym",
+                             seed: Optional[int] = None) -> Simulation:
+    """Asymmetric tiers: a hierarchical tier-1 core plus ``n_leaf``
+    second-tier validators whose quorum slices point AT the core's org
+    structure but who appear in nobody else's slices — they vote and
+    close ledgers yet cannot block the core (the shape of real public
+    networks, where most validators trust the tier-1 orgs one-way).
+    Reference shape: Topologies::hierarchicalQuorumSimplified's
+    middle-tier variants."""
+    from ..crypto.sha import sha256
+    sim = Simulation(passphrase, seed=seed)
+    secrets = [[SecretKey(sha256(b"asym-core-%d-%d" % (o, g)))
+                for g in range(nodes_per_org)] for o in range(n_core_orgs)]
+    inner = [qset_of([s.public_key.ed25519 for s in org],
+                     (2 * nodes_per_org + 2) // 3) for org in secrets]
+    outer = SX.SCPQuorumSet(threshold=(2 * n_core_orgs + 2) // 3,
+                            validators=[], innerSets=inner)
+    for org in secrets:
+        for s in org:
+            sim.add_node(s, outer)
+    for i in range(n_leaf):
+        sim.add_node(SecretKey(sha256(b"asym-leaf-%d" % i)), outer)
     return sim

@@ -223,13 +223,18 @@ def flight_bundle(reason: str) -> dict:
     return bundle
 
 
-def write_crash_bundle(reason: str) -> Optional[str]:
-    """Write the flight bundle to ``$STPU_CRASH_DIR`` (one JSON file per
-    incident); returns the path, or None when the env var is unset or the
-    write fails — a crash dump must never mask the original fail-stop."""
+def write_crash_bundle(reason: str,
+                       crash_dir: Optional[str] = None) -> Optional[str]:
+    """Write the flight bundle to ``crash_dir`` (defaulting to
+    ``$STPU_CRASH_DIR``; one JSON file per incident); returns the path, or
+    None when no directory is configured or the write fails — a crash dump
+    must never mask the original fail-stop.  The explicit parameter lets
+    in-process harnesses (the chaos campaign runner) route bundles into a
+    per-campaign artifact directory without mutating process environment."""
     if getattr(_dumping, "active", False):
         return None
-    crash_dir = os.environ.get("STPU_CRASH_DIR")
+    if crash_dir is None:
+        crash_dir = os.environ.get("STPU_CRASH_DIR")
     if not crash_dir:
         return None
     _dumping.active = True

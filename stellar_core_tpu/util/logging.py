@@ -21,7 +21,7 @@ from typing import Dict
 PARTITIONS = (
     "Fs", "SCP", "Bucket", "Overlay", "History", "Ledger", "Herder", "Tx",
     "Database", "Process", "Work", "Invariant", "Perf", "Main",
-    "CommandHandler", "Fuzz",
+    "CommandHandler", "Fuzz", "Sim",
 )
 
 LOG_FORMATS = ("text", "json")

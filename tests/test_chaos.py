@@ -1,0 +1,371 @@
+"""Chaos campaign runner tests: the fault-event scheduler, deterministic
+replay, symmetric link severance, sim-node health, artifact emission, and
+the 50+-node scenario catalogue (300-node soaks ride behind -m slow).
+
+Reference test model: src/simulation/test/ + HerderTests partition cases,
+composed at fleet scale with scripted fault schedules.
+"""
+
+import json
+import os
+
+import pytest
+
+from stellar_core_tpu.simulation import chaos as C
+from stellar_core_tpu.simulation.chaos import (Ban, ChaosRunner,
+                                               ChaosScenario, CorruptFlood,
+                                               Flap, Heal, LinkFault,
+                                               Partition, RejoinNode,
+                                               StallNode, run_scenario)
+from stellar_core_tpu.simulation.simulation import (Simulation,
+                                                    make_core_topology)
+from stellar_core_tpu.util import eventlog
+
+
+def _mini_core_scenario(seed, schedule, n=6, duration_s=25.0, **kw):
+    return ChaosScenario(name="mini", build=C._core_build(n),
+                         schedule=schedule, duration_s=duration_s,
+                         seed=seed, **kw)
+
+
+# ---------------------------------------------------------------------------
+# scheduler mechanics
+# ---------------------------------------------------------------------------
+
+class TestFaultScheduler:
+    def test_events_fire_at_their_virtual_times_in_order(self):
+        # no-op link faults: pure scheduling, no consensus disturbance
+        sched = [LinkFault(11.0), LinkFault(3.0), LinkFault(7.0)]
+        res = run_scenario(_mini_core_scenario(1, sched, n=3,
+                                               duration_s=14.0))
+        fired = [(t, m) for t, m in res.event_trace
+                 if m.startswith("LinkFault")]
+        assert [t for t, _ in fired] == [3.0, 7.0, 11.0]
+        assert res.passed, res.violations
+
+    def test_flap_expands_into_alternating_partition_heal(self):
+        flap = Flap(5.0, [[0]], period=2.0, count=3, name="f")
+        expanded = flap.expand()
+        kinds = [type(e).__name__ for e in expanded]
+        assert kinds == ["Partition", "Heal"] * 3
+        assert [e.at for e in expanded] == [5.0, 7.0, 9.0, 11.0, 13.0, 15.0]
+        # partition i and heal i share a name so each flap closes itself
+        assert expanded[0].name == expanded[1].name == "f-0"
+
+    def test_overlapping_partitions_compose(self):
+        sim = make_core_topology(4, seed=0)
+        links = C.mesh_links(4)
+        sc = _mini_core_scenario(0, [], n=4)
+        runner = ChaosRunner(sc)
+        runner.sim, runner.base_links = sim, links
+        for key in links:
+            ia, ib = tuple(key)
+            sim.connect(sim.nodes[ia], sim.nodes[ib])
+        sim.clock.crank_for(0.2)
+        runner._start_vt = sim.clock.now()
+        n = sim.nodes
+
+        runner._apply(Partition(0.0, [[0]], name="a"))      # severs 0-*
+        runner._apply(Partition(0.0, [[0, 1]], name="b"))   # severs {0,1}-*
+        assert not sim.is_connected(n[0], n[1])   # cut a splits 0 from 1
+        assert not sim.is_connected(n[1], n[2])   # cut b splits 1 from 2
+        assert sim.is_connected(n[2], n[3])
+
+        runner._apply(Heal(0.0, name="a"))
+        # b alone: {0,1} vs {2,3} — the 0-1 link comes back, 1-2 stays cut
+        assert sim.is_connected(n[0], n[1])
+        assert not sim.is_connected(n[1], n[2])
+        assert not sim.is_connected(n[0], n[3])
+
+        runner._apply(Heal(0.0, name="b"))
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert sim.is_connected(n[i], n[j])
+
+    def test_link_faults_reapply_to_redialed_links(self):
+        """A link lost to a fail-stop (or severed and healed) must come
+        back with the ACTIVE LinkFault probabilities, not a clean slate —
+        otherwise every redial silently erodes the declared ramp."""
+        sim = make_core_topology(3, seed=0)
+        links = C.mesh_links(3)
+        runner = ChaosRunner(_mini_core_scenario(0, [], n=3))
+        runner.sim, runner.base_links = sim, links
+        for key in links:
+            ia, ib = tuple(key)
+            sim.connect(sim.nodes[ia], sim.nodes[ib])
+        sim.clock.crank_for(0.2)
+        runner._start_vt = sim.clock.now()
+        runner._apply(LinkFault(0.0, drop=0.25, reorder=0.5))
+        runner._apply(Partition(0.0, [[0]], name="p"))
+        runner._apply(Heal(0.0, name="p"))   # 0-1 and 0-2 redialed fresh
+        pair = sim._connections[
+            frozenset((sim.nodes[0].node_id, sim.nodes[1].node_id))]
+        for peer in pair:
+            assert peer.drop_probability == 0.25
+            assert peer.reorder_probability == 0.5
+
+    def test_redial_restores_latest_link_fault_not_lowest_index(self):
+        """When two per-node LinkFaults cover one link, a redial must
+        restore what the LAST event left on the live link — not whichever
+        endpoint happens to have the lower node index."""
+        sim = make_core_topology(3, seed=0)
+        links = C.mesh_links(3)
+        runner = ChaosRunner(_mini_core_scenario(0, [], n=3))
+        runner.sim, runner.base_links = sim, links
+        for key in links:
+            ia, ib = tuple(key)
+            sim.connect(sim.nodes[ia], sim.nodes[ib])
+        sim.clock.crank_for(0.2)
+        runner._start_vt = sim.clock.now()
+        runner._apply(LinkFault(0.0, node=0, drop=0.5))
+        runner._apply(LinkFault(0.0, node=1, drop=0.0))  # clears 0-1 too
+        runner._apply(Partition(0.0, [[0]], name="p"))
+        runner._apply(Heal(0.0, name="p"))   # 0-1 redialed
+        pair = sim._connections[
+            frozenset((sim.nodes[0].node_id, sim.nodes[1].node_id))]
+        for peer in pair:
+            assert peer.drop_probability == 0.0
+        # the 0-2 link is untouched by the node-1 event: still ramped
+        pair02 = sim._connections[
+            frozenset((sim.nodes[0].node_id, sim.nodes[2].node_id))]
+        for peer in pair02:
+            assert peer.drop_probability == 0.5
+
+    def test_unmet_recovery_produces_crash_bundle_artifact(self, tmp_path):
+        """A scenario whose post-heal convergence cannot happen (one node
+        stays stalled through the measured heal) must emit the artifact,
+        not swallow the failure."""
+        sched = [
+            Partition(4.0, [[0, 1]], name="a"),
+            StallNode(5.0, node=0),
+            Heal(8.0, name="a", measure_recovery=True),
+        ]
+        sc = _mini_core_scenario(9, sched, n=6, duration_s=20.0,
+                                 recovery_close_targets=4.0)
+        res = run_scenario(sc, artifact_dir=str(tmp_path))
+        assert not res.passed
+        assert {v.kind for v in res.violations} == {"recovery"}
+        assert res.artifact_path and os.path.exists(res.artifact_path)
+        art = json.load(open(res.artifact_path))
+        assert art["seed"] == 9
+        assert any("StallNode" in s for s in art["schedule"])
+        assert len(art["node_records"]) == 6
+        # the flight-recorder crash bundle rode along, with the chaos
+        # bundle source inside, and the source was unregistered after
+        assert res.crash_bundle_path and os.path.exists(res.crash_bundle_path)
+        bundle = json.load(open(res.crash_bundle_path))
+        assert bundle["chaos"]["seed"] == 9
+        assert "events" in bundle and "metrics" in bundle
+        assert "chaos" not in eventlog._bundle_sources
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection / replay
+# ---------------------------------------------------------------------------
+
+class TestDeterministicReplay:
+    def test_pair_rng_is_seed_and_pair_derived(self):
+        sim = Simulation(b"rng net", seed=5)
+        a, b = b"\x01" * 32, b"\x02" * 32
+        r1 = sim._pair_rng(a, b)
+        r2 = sim._pair_rng(b, a)   # order-insensitive
+        assert [r1.random() for _ in range(4)] == \
+            [r2.random() for _ in range(4)]
+        other = sim._pair_rng(a, b"\x03" * 32)
+        assert r1.random() != other.random()
+        assert Simulation(b"rng net")._pair_rng(a, b) is None
+
+    def test_same_seed_replays_identical_event_log(self):
+        sched = lambda: [LinkFault(4.0, drop=0.05, reorder=0.10),  # noqa: E731
+                         LinkFault(10.0, damage=0.01),
+                         LinkFault(16.0)]
+        r1 = run_scenario(_mini_core_scenario(42, sched(), n=6))
+        r2 = run_scenario(_mini_core_scenario(42, sched(), n=6))
+        assert r1.event_trace == r2.event_trace
+        assert r1.slot_hashes == r2.slot_hashes
+        assert r1.ledgers_closed == r2.ledgers_closed
+        assert r1.passed and r2.passed
+
+
+# ---------------------------------------------------------------------------
+# symmetric severance
+# ---------------------------------------------------------------------------
+
+class TestSymmetricDisconnect:
+    def test_disconnect_closes_both_ends(self):
+        from stellar_core_tpu.overlay.peer import Peer
+        sim = make_core_topology(2)
+        a, b = sim.nodes
+        sim.connect(a, b)
+        pair = sim._connections[frozenset((a.node_id, b.node_id))]
+        sim.disconnect(a, b)
+        assert pair[0].state == Peer.CLOSING
+        assert pair[1].state == Peer.CLOSING
+
+    def test_disconnect_after_one_end_self_dropped_closes_other(self):
+        """drop() on an already-CLOSING peer is a no-op that never reaches
+        its partner — the old single-ended disconnect leaked the partner
+        half-open here."""
+        from stellar_core_tpu.overlay.peer import Peer
+        sim = make_core_topology(2)
+        a, b = sim.nodes
+        sim.connect(a, b)
+        key = frozenset((a.node_id, b.node_id))
+        pa, pb = sim._connections[key]
+        # one end drops itself with the pair already unlinked (the shape a
+        # ban/overlay error path produces mid-teardown)
+        pa.partner = None
+        pb.partner = None
+        pa.drop("self drop")
+        assert pb.state != Peer.CLOSING   # the would-be leak
+        sim.disconnect(a, b)
+        assert pa.state == Peer.CLOSING and pb.state == Peer.CLOSING
+        # flapping redial replaces the severed pair instead of refusing
+        sim.connect(a, b)
+        assert sim.is_connected(a, b)
+
+
+# ---------------------------------------------------------------------------
+# sim-node health (main/status reuse)
+# ---------------------------------------------------------------------------
+
+class TestSimNodeHealth:
+    def test_partitioned_minority_degrades_then_recovers(self):
+        sim = make_core_topology(4, threshold=3)
+        sim.start_all_nodes()
+        assert sim.crank_until_ledger(2, timeout=60)
+        loner, rest = sim.nodes[0], sim.nodes[1:]
+        assert loner.evaluate_health()["status"] == "ok"
+        sim.partition_nodes([[loner], rest])
+        # stall past 2x the close target: ledger age pushes it degraded
+        start = min(n.lcl for n in rest)
+        assert sim.crank_until(
+            lambda: all(n.lcl >= start + 3 for n in rest), timeout=120)
+        health = loner.evaluate_health()
+        assert health["status"] == "degraded"
+        assert any("ledger age" in r for r in health["reasons"])
+        assert any("peers" in r for r in health["reasons"])
+        # the healthy majority stayed healthy
+        assert rest[0].evaluate_health()["status"] == "ok"
+        sim.heal_partitions()
+        target = max(n.lcl for n in rest) + 2
+        assert sim.crank_until(
+            lambda: all(n.lcl >= target for n in sim.nodes), timeout=240)
+        assert loner.evaluate_health()["status"] == "ok"
+        assert sim.hashes_agree()
+
+
+# ---------------------------------------------------------------------------
+# scenario catalogue — small tier (tier-1-eligible; `make chaos`)
+# ---------------------------------------------------------------------------
+
+class TestSmallScenarios:
+    def test_link_degradation_survives_fault_ramp(self):
+        res = run_scenario(C.scenario_link_degradation(12))
+        assert res.passed, res.violations
+        # the ramp is real (faults persist across redials), so progress
+        # slows — the liveness assertion inside the run already proves no
+        # stall; this floor just proves consensus moved through the ramp
+        assert res.ledgers_closed >= 4
+
+    def test_stall_rejoin_reconverges(self):
+        res = run_scenario(C.scenario_stall_rejoin(4, 3))
+        assert res.passed, res.violations
+        assert len(res.recoveries) == 1
+        assert res.recoveries[0]["recovery_s"] < 60.0
+        # the stalled node (index 0) actually exercised the recovery
+        # machinery — it fell out of sync and/or applied buffered
+        # externalize values — rather than reconverging by some route
+        # that would leave the herder recovery paths untested
+        stats = res.node_records[0]["recovery_stats"]
+        assert stats["out_of_sync"] >= 1 or stats["buffered_applied"] >= 1, \
+            stats
+
+    def test_corrupt_flood_fail_stops_never_forks(self):
+        res = run_scenario(C.scenario_corrupt_flood(4, 3))
+        assert res.passed, res.violations
+        # the corrupted frames actually went out
+        assert any("corrupt-flood sent" in m for _, m in res.event_trace)
+
+    def test_cycle_partition_heals(self):
+        res = run_scenario(C.scenario_cycle_partition(12))
+        assert res.passed, res.violations
+        assert len(res.recoveries) == 1
+
+    def test_asymmetric_tier_partition(self):
+        res = run_scenario(C.scenario_asym_tier_partition(4, 3, 6))
+        assert res.passed, res.violations
+
+    def test_quorum_split_detected_as_liveness_failure(self, tmp_path):
+        """The intentionally-broken scenario: a quorum-splitting partition
+        must be DETECTED (liveness violation) and emit a replayable
+        artifact carrying the RNG seed, the fault schedule and per-node
+        flight records."""
+        sc = C.scenario_quorum_split(4, 3)
+        assert sc.expect_failure == "liveness"
+        res = run_scenario(sc, artifact_dir=str(tmp_path))
+        assert not res.passed
+        assert {v.kind for v in res.violations} == {"liveness"}
+        art = json.load(open(res.artifact_path))
+        assert art["seed"] == sc.seed
+        assert any("Partition" in s for s in art["schedule"])
+        assert len(art["node_records"]) == 12
+        for rec in art["node_records"]:
+            assert "recent_closes" in rec and "herder_state" in rec
+
+    def test_catalogue_entries_build_and_are_unique(self):
+        """The catalogue lists are the single enumeration bench.py
+        iterates: every entry must construct a valid scenario with a
+        positive wall-clock estimate, names must be unique, and the
+        flagship must be in the small tier — so catalogue drift breaks
+        here instead of silently losing bench coverage."""
+        names = []
+        for make, est in C.SMALL_SCENARIOS + C.SOAK_SCENARIOS:
+            sc = make()
+            assert isinstance(sc, ChaosScenario) and sc.schedule
+            assert est > 0.0
+            names.append(sc.name)
+        assert len(names) == len(set(names)), names
+        assert "partition-flap-heal-51" in names
+        small = [make().name for make, _ in C.SMALL_SCENARIOS]
+        assert all(n not in small
+                   for n in (m().name for m, _ in C.SOAK_SCENARIOS))
+
+    def test_50_node_partition_flap_heal(self):
+        """The flagship 51-validator hierarchical campaign: minority
+        partition -> flapping cut -> heal; zero safety violations, the
+        majority keeps closing throughout, and the fleet reconverges
+        within the recovery budget with a finite measured recovery."""
+        res = run_scenario(C.scenario_partition_flap_heal(17, 3))
+        assert res.passed, res.violations
+        assert res.nodes == 51
+        assert res.ledgers_closed >= 7
+        assert len(res.recoveries) == 1
+        assert 0.0 <= res.recoveries[0]["recovery_s"] \
+            <= 12 * 5.0   # recovery_close_targets * close target
+        # every node record is healthy at campaign end
+        assert all(r["health"] == "ok" for r in res.node_records)
+
+
+# ---------------------------------------------------------------------------
+# soak tier (-m slow): 100-300 nodes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSoaks:
+    def test_100_node_hierarchical_partition_flap_heal(self):
+        res = run_scenario(C.scenario_partition_flap_heal(34, 3))
+        assert res.passed, res.violations
+        assert res.nodes == 102
+        assert len(res.recoveries) == 1
+        assert res.recoveries[0]["recovery_s"] < 12 * 5.0
+
+    def test_large_soak_every_fault_class(self):
+        """150 nodes by default; STPU_CHAOS_SOAK_ORGS=100 escalates to
+        the 300-node variant (offline-scale — per-envelope SCP cost grows
+        ~n^2 with fleet size; see ROADMAP item 5 follow-ups)."""
+        orgs = int(os.environ.get("STPU_CHAOS_SOAK_ORGS", "50"))
+        res = run_scenario(C.scenario_soak(orgs, 3))
+        assert res.passed, res.violations
+        assert res.nodes == orgs * 3
+        assert len(res.recoveries) == 1

@@ -645,3 +645,45 @@ class TestLoopbackFaultInjection:
         # the single (held) frame was flushed by the backstop and, being
         # alone, arrives in order: connection stays healthy
         assert pa.is_authenticated() and pb.is_authenticated()
+
+
+class TestItemFetcherRetry:
+    """A fetch request or reply frame lost in flight (lossy link, peer
+    severed mid-fetch) must not wedge the tracker until an unrelated peer
+    authenticates: the retry timer re-asks, a fully-exhausted round clears
+    the asked set, and RETRY_LIMIT rounds drop a network-wide-dead hash."""
+
+    def _fetcher(self, peers):
+        from stellar_core_tpu.overlay.flood import ItemFetcher
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        asked = []
+        f = ItemFetcher(lambda p, t, h: asked.append(p), clock=clock,
+                        peers_fn=lambda: list(peers))
+        return clock, f, asked
+
+    def test_lost_reply_is_retried_on_timer(self):
+        peers = ["peer-a", "peer-b"]
+        clock, f, asked = self._fetcher(peers)
+        f.fetch("txset", b"h" * 32, list(peers))
+        assert asked == ["peer-a"]
+        # replies never arrive; two retry rounds re-ask the other peer,
+        # then (round exhausted, asked set cleared) the first one again
+        clock.crank_for(2 * f.RETRY_PERIOD_S + 0.1)
+        assert asked[:3] == ["peer-a", "peer-b", "peer-a"]
+        clock.stop()
+
+    def test_answer_cancels_retry(self):
+        peers = ["peer-a", "peer-b"]
+        clock, f, asked = self._fetcher(peers)
+        f.fetch("txset", b"h" * 32, list(peers))
+        f.stop_fetch(b"h" * 32)
+        clock.crank_for(5 * f.RETRY_PERIOD_S)
+        assert asked == ["peer-a"] and f.wanted() == []
+        clock.stop()
+
+    def test_retry_limit_drops_dead_hash(self):
+        clock, f, asked = self._fetcher([])
+        f.fetch("qset", b"g" * 32, [])
+        clock.crank_for((f.RETRY_LIMIT + 2) * f.RETRY_PERIOD_S)
+        assert f.wanted() == []
+        clock.stop()

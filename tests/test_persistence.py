@@ -132,6 +132,40 @@ def test_load_detects_corrupt_bucket_file(tmp_path):
         LedgerManager.load_last_known_ledger(NID, db, bdir)
 
 
+def test_manifest_torn_line_does_not_brick_startup(tmp_path):
+    """A crash mid manifest append leaves a malformed tail line; the
+    startup audit must treat it as absent (the full-file hash scan still
+    covers every real file), not fail-stop on garbage forever."""
+    db = Database(str(tmp_path / "node.db"))
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    mgr.enable_persistence(db, bdir)
+    root = _root_of(mgr)
+    _run_some_ledgers(mgr, root, n_extra=0)
+    with open(bdir._manifest_path, "a") as f:
+        f.write("deadbeef\n")            # truncated entry
+        f.write("bucket-trailing-junk")  # no newline, wrong shape
+    mgr2 = LedgerManager.load_last_known_ledger(NID, db, bdir)
+    assert mgr2.lcl_hash == mgr.lcl_hash
+
+
+def test_manifest_append_after_torn_tail_stays_tracked(tmp_path):
+    """An append landing after a crash-torn tail line must not glue onto
+    the fragment (invalidating both): the new entry has to survive a
+    fresh read so the bucket stays audit-tracked."""
+    bdir = BucketDir(str(tmp_path / "buckets"))
+    with open(bdir._manifest_path, "w") as f:
+        f.write("a" * 64 + "\n")
+        f.write("bb")  # torn tail, no newline
+    bdir._manifest_cache = None  # cold read, like a restart
+    hh = "c" * 64
+    bdir._manifest_add(hh)
+    fresh = BucketDir(str(tmp_path / "buckets"))
+    assert hh in fresh._manifest_read()
+    assert "a" * 64 in fresh._manifest_read()
+
+
 def test_load_detects_missing_bucket(tmp_path):
     db = Database(str(tmp_path / "node.db"))
     bdir = BucketDir(str(tmp_path / "buckets"))

@@ -11,6 +11,7 @@ import pytest
 
 from stellar_core_tpu import scp as S
 from stellar_core_tpu.scp.driver import SCPDriver, ValidationLevel
+from stellar_core_tpu.scp.quorum import _compiled_slice_ok, compile_qset
 from stellar_core_tpu.xdr import scp as SX
 from stellar_core_tpu.xdr import types as XT
 
@@ -43,6 +44,25 @@ class TestQuorumMath:
     def test_v_blocking_zero_threshold(self):
         q = make_qset([nid(0)], 0)
         assert not S.is_v_blocking(q, {nid(0)})
+
+    def test_compiled_slice_matches_is_quorum_slice(self):
+        inner = make_qset([nid(4), nid(5), nid(6)], 2)
+        q = make_qset([nid(0), nid(1), nid(2)], 2, inner=[inner])
+        cq = compile_qset(q)
+        for nodes in ({nid(0), nid(1)}, {nid(0)}, {nid(0), nid(4), nid(5)},
+                      {nid(4), nid(5)}, set(),
+                      {nid(0), nid(1), nid(2), nid(4), nid(5), nid(6)}):
+            assert _compiled_slice_ok(cq, nodes) \
+                == S.is_quorum_slice(q, nodes)
+
+    def test_compiled_slice_zero_threshold(self):
+        # is_quorum_slice returns count >= 0 == True unconditionally for
+        # a threshold-0 set; the compiled walker must agree even when no
+        # member matches (is_qset_sane never vets locally-built sets)
+        q = make_qset([nid(0)], 0)
+        assert _compiled_slice_ok(compile_qset(q), set())
+        assert _compiled_slice_ok(compile_qset(q), {nid(9)})
+        assert S.is_quorum_slice(q, set())
 
     def test_nested_qset(self):
         innerA = make_qset([nid(1), nid(2), nid(3)], 2)
