@@ -1,5 +1,5 @@
 .PHONY: native test lint metrics obs bucketdb bucketdb-slow chaos \
-	chaos-soak clean
+	chaos-soak loadgen loadgen-slow clean
 
 native:
 	python setup.py build_ext --inplace
@@ -52,6 +52,19 @@ chaos:
 
 chaos-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# sustained-ingestion suite: AdmissionPipeline latency floor + batching +
+# overload semantics through the admission path, back-pressure into
+# overlay flow control and /health, and the small-tier (60k-account)
+# load campaign over BucketListDB.  `loadgen-slow` adds the -m slow
+# million-account campaign (RSS-guarded).
+loadgen:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py -q \
+		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+loadgen-slow:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py -q \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must

@@ -325,7 +325,12 @@ class TPUQuorumIntersectionChecker:
                 in_specs=(spec_b, Pspec(None), Pspec(None), Pspec(None),
                           Pspec(None, None), Pspec(None, None),
                           Pspec(None, None, None)),
-                out_specs=(Pspec("data"), Pspec("data"), Pspec("data")))
+                out_specs=(Pspec("data"), Pspec("data"), Pspec("data")),
+                # the contraction fixpoint is a lax.while_loop over
+                # replicated operands; this jax has no replication rule
+                # for `while`, and every output is explicitly sharded
+                # along "data" anyway — replication checking buys nothing
+                check_rep=False)
             self._step = jax.jit(sharded)
             self._pad_to = ndev
         else:

@@ -184,6 +184,20 @@ class PreverifyPipeline:
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
 
+    def job_done(self, checkpoint: int) -> bool:
+        """True when `checkpoint`'s device verdicts have materialized (its
+        collect would return without waiting).  Non-blocking — the
+        admission pipeline polls this to keep kernel warmup off the
+        submission critical path."""
+        group = self._groups.get(checkpoint)
+        if group is None or group.get("collected"):
+            return True
+        job = group["job"]
+        if job is None:
+            return True
+        _box, ev, _q = job
+        return ev.is_set()
+
     def _add_sigs_total(self, n: int) -> None:
         """One accounting seam for the offload hit-rate denominator —
         mirrored into the registry so /metrics and bench agree with

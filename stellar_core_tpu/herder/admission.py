@@ -1,0 +1,397 @@
+"""AdmissionPipeline — TPU-batched admission verification with back-pressure.
+
+Reference seams: src/herder/TransactionQueue.{h,cpp} (``tryAdd`` is the
+admission gate), src/overlay/FlowControl.{h,cpp} (capacity-granted flood
+intake — the natural back-pressure valve), src/herder/TxSetUtils (surge
+pricing — the eviction economics applied when the node is full).
+
+The reference verifies every live-submitted envelope's signatures one at a
+time inside ``tryAdd``'s checkValid.  This subsystem batches that work:
+envelopes arriving from ``Application.submit_tx`` and overlay TRANSACTION
+floods accumulate into accel-sized batches, the batch is verified through
+the SAME dispatch-ahead/race-bounded-collect machinery ``PreverifyPipeline``
+proved out for catchup (catchup/catchup.py), and the verified frames are
+handed to ``TransactionQueue.try_add`` — whose SignatureChecker then hits
+the seeded verify cache instead of calling libsodium per signature.
+
+Latency floor guarantee:
+
+- a batch is flushed on SIZE or DEADLINE, and when the pipeline is idle a
+  lone submission is admitted synchronously (no deadline wait at all) —
+  at low offered load admission IS the single-sig libsodium path plus a
+  few dict operations;
+- the race-bounded collect waits for the device no longer than libsodium
+  would charge for the batch; a miss skips seeding and ``try_add``
+  recomputes on CPU — so admission latency never regresses below the
+  single-sig path, it only improves when the device genuinely wins.
+
+Back-pressure, end to end:
+
+- ``depth`` (submitted-but-unverified envelopes) is exported as
+  ``herder.admission.depth`` and feeds three valves:
+  1. at ``max_backlog`` new submissions answer ``try-again-later``
+     (bounded queue, never unbounded growth);
+  2. at ``backpressure_high`` the overlay STOPS handing peers fresh
+     flow-control capacity (overlay/peer.py defers SEND_MORE grants) until
+     the backlog drains to ``backpressure_low`` — hysteresis so the valve
+     doesn't chatter;
+  3. a full downstream TransactionQueue applies surge-pricing economics
+     BEFORE verification: a tx priced under the queue's fee floor is
+     rejected without spending any verify compute.
+- ``/health`` reports a degraded node while back-pressure is engaged
+  (main/status.evaluate_health), and engage/release edges are flight-
+  recorded for post-mortems.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time  # perf_counter only (latency stats); timers use clock
+from typing import Callable, Dict, List, Optional
+
+from ..util import eventlog
+from ..util import logging as slog
+from ..util.clock import VirtualClock, VirtualTimer
+from ..util.metrics import registry as _registry
+from .tx_queue import AddResult, TransactionQueue
+
+log = slog.get("Herder")
+
+# batch ids share one process-wide counter so two pipelines (tests build
+# several) can never collide inside a shared PreverifyPipeline
+_BATCH_IDS = itertools.count(1)
+
+
+class _Pending:
+    __slots__ = ("frame", "t0", "origin", "on_result")
+
+    def __init__(self, frame, t0: float, origin: str, on_result):
+        self.frame = frame
+        self.t0 = t0
+        self.origin = origin
+        self.on_result = on_result
+
+
+class AdmissionPipeline:
+    """Batched, back-pressured admission in front of a TransactionQueue.
+
+    ``submit(frame)`` is the one entry point.  When the pipeline is idle
+    the frame is admitted synchronously (identical observable semantics to
+    calling ``try_add`` directly); under load frames accumulate into
+    batches that flush on size or deadline, with the final verdict
+    delivered through the optional ``on_result`` callback.
+    """
+
+    # default knobs (config: ADMISSION_*)
+    BATCH_SIZE = 256          # flush when this many signatures are pending
+    FLUSH_DELAY_S = 0.05      # deadline flush for a partial batch
+    MAX_BACKLOG = 4096        # pending envelopes before try-again-later
+    ACCEL_MIN_SIGS = 64       # below this the device overhead loses; CPU
+
+    def __init__(self, tx_queue: TransactionQueue, lm, clock: VirtualClock,
+                 accel: bool = False, accel_chunk: int = 2048,
+                 batch_size: int = BATCH_SIZE,
+                 flush_delay_s: float = FLUSH_DELAY_S,
+                 max_backlog: int = MAX_BACKLOG,
+                 accel_min_sigs: int = ACCEL_MIN_SIGS,
+                 on_admitted: Optional[Callable] = None):
+        self.tx_queue = tx_queue
+        self.lm = lm
+        self.clock = clock
+        self.accel = accel
+        self.accel_chunk = accel_chunk
+        self.batch_size = batch_size
+        self.flush_delay_s = flush_delay_s
+        self.max_backlog = max_backlog
+        self.accel_min_sigs = accel_min_sigs
+        # hysteresis valve thresholds (overlay grant deferral)
+        self.backpressure_high = max(1, max_backlog // 2)
+        self.backpressure_low = max(0, max_backlog // 4)
+        self.backpressured = False
+        # fires when back-pressure RELEASES (overlay re-grants deferred
+        # flow-control capacity); wired by Application/tests
+        self.on_backpressure_release: Callable[[], None] = lambda: None
+        # fires per ADMITTED frame (herder wires tx flooding here)
+        self.on_admitted = on_admitted or (lambda frame, origin: None)
+
+        self._pending: List[_Pending] = []
+        # hashes of every frame the pipeline owns but try_add hasn't seen
+        # yet — pending AND in-flight — so a duplicate submitted while
+        # the original's batch is still verifying answers DUPLICATE
+        # instead of burning a second verification
+        self._tracked_hashes: set = set()
+        self._pending_sigs = 0
+        # burst detector: a submission arriving within one flush window of
+        # the previous one is sustained load and joins a batch; a sparse
+        # arrival takes the synchronous single-sig path (latency floor)
+        self._last_submit_at = float("-inf")
+        # batches dispatched to the device but not yet collected:
+        # [(batch_id, [_Pending, ...])] in dispatch (collect) order
+        self._inflight: List[tuple] = []
+        self._inflight_count = 0
+        self._flush_timer: Optional[VirtualTimer] = None
+        self._collect_posted = False
+
+        self.stats: Dict[str, int] = {
+            "submitted": 0, "admitted": 0, "rejected": 0, "overload": 0,
+            "prefiltered": 0, "sync_path": 0, "batches": 0,
+            "sigs_offloaded": 0,
+        }
+
+        # accel: the PreverifyPipeline IS the device machinery — batches
+        # are dispatched as synthetic "checkpoints" and collected with the
+        # race-bounded wait it proved out for catchup.  The kernel compile
+        # happens off the critical path: a warmup batch is dispatched at
+        # construction and admission stays on the CPU path until its
+        # verdicts materialize (job_done), so no submission ever blocks
+        # behind a cold compile or a wedged tunnel.
+        self._preverify = None
+        self._warm_id: Optional[int] = None
+        self._warmed = False
+        if accel:
+            from ..catchup.catchup import PreverifyPipeline
+            self._preverify = PreverifyPipeline(
+                lm.network_id, chunk_size=accel_chunk, stats=self.stats)
+            self._dispatch_warmup()
+
+        _registry().weak_gauge("herder.admission.depth", self,
+                               lambda a: a.depth)
+
+    # ------------------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        """Submitted-but-unverified envelopes (the back-pressure signal)."""
+        return len(self._pending) + self._inflight_count
+
+    def _set_backpressure(self, engaged: bool) -> None:
+        if engaged == self.backpressured:
+            return
+        self.backpressured = engaged
+        eventlog.record("Herder", "WARNING" if engaged else "INFO",
+                        "admission back-pressure "
+                        + ("engaged" if engaged else "released"),
+                        depth=self.depth,
+                        high=self.backpressure_high,
+                        low=self.backpressure_low)
+        if engaged:
+            log.warning("admission back-pressure engaged at depth %d "
+                        "(high=%d): deferring overlay flood grants",
+                        self.depth, self.backpressure_high)
+        else:
+            self.on_backpressure_release()
+
+    def _update_backpressure(self) -> None:
+        d = self.depth
+        if not self.backpressured and d >= self.backpressure_high:
+            self._set_backpressure(True)
+        elif self.backpressured and d <= self.backpressure_low:
+            self._set_backpressure(False)
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, frame, origin: str = "api",
+               on_result: Optional[Callable[[AddResult], None]] = None
+               ) -> AddResult:
+        """Admit one envelope.  Fast-fail gates (ban/duplicate/overload/
+        fee floor) answer immediately; an idle pipeline admits
+        synchronously (the exact ``try_add`` verdict); otherwise the frame
+        joins the current batch and the optimistic ``pending`` answer is
+        returned, with the final verdict delivered to ``on_result`` after
+        the batch verifies."""
+        self.stats["submitted"] += 1
+        q = self.tx_queue
+        h = frame.content_hash()
+        # gates that need no signature verification, in try_add's order
+        if q.is_banned(h):
+            return self._reject(AddResult(AddResult.STATUS_BANNED),
+                                on_result)
+        if h in q.by_hash or h in self._tracked_hashes:
+            return self._reject(AddResult(AddResult.STATUS_DUPLICATE),
+                                on_result)
+        if self.depth >= self.max_backlog:
+            # bounded intake: overload answers try-again-later instead of
+            # growing the backlog without limit
+            self.stats["overload"] += 1
+            _registry().meter("herder.admission.overload").mark()
+            eventlog.record("Herder", "WARNING", "admission overload",
+                            depth=self.depth, max_backlog=self.max_backlog)
+            return self._reject(
+                AddResult(AddResult.STATUS_TRY_AGAIN_LATER), on_result)
+        if q.below_fee_floor(frame):
+            # surge-pricing economics BEFORE verification: a full queue
+            # would evict-or-reject this tx anyway; don't verify it
+            self.stats["prefiltered"] += 1
+            return self._reject(
+                AddResult(AddResult.STATUS_TRY_AGAIN_LATER), on_result)
+
+        t0 = _time.perf_counter()
+        now = self.clock.now()
+        burst = (now - self._last_submit_at) < self.flush_delay_s
+        self._last_submit_at = now
+        if not burst and not self._pending and not self._inflight:
+            # idle pipeline, sparse arrival: the latency floor.  Verify
+            # and admit NOW on the single-sig CPU path — no deadline
+            # wait, no batching tax.  Under sustained load (arrivals
+            # within one flush window of each other) frames accumulate
+            # into batches instead.
+            self.stats["sync_path"] += 1
+            res = self._admit(frame, t0, origin)
+            if on_result is not None:
+                on_result(res)
+            return res
+
+        self._pending.append(_Pending(frame, t0, origin, on_result))
+        self._tracked_hashes.add(h)
+        self._pending_sigs += len(frame.signatures)
+        self._update_backpressure()
+        if self._pending_sigs >= self.batch_size:
+            self._flush()
+        else:
+            self._arm_flush_timer()
+        return AddResult(AddResult.STATUS_PENDING)
+
+    def _reject(self, res: AddResult, on_result) -> AddResult:
+        self.stats["rejected"] += 1
+        _registry().meter("herder.admission.rejected").mark()
+        if on_result is not None:
+            on_result(res)
+        return res
+
+    # ------------------------------------------------------------------
+    # flush machinery
+    # ------------------------------------------------------------------
+    def _arm_flush_timer(self) -> None:
+        if self._flush_timer is not None and self._flush_timer.seated:
+            return
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(self.flush_delay_s, self._deadline_flush)
+        self._flush_timer = t
+
+    def _deadline_flush(self) -> None:
+        if self._pending:
+            self._flush()
+
+    def _flush(self) -> None:
+        """Form a batch from everything pending and move it to the
+        verification stage: device dispatch (accel, warmed, big enough) or
+        straight to the CPU finish action."""
+        if self._flush_timer is not None:
+            self._flush_timer.cancel()
+            self._flush_timer = None
+        batch, self._pending = self._pending, []
+        # _tracked_hashes keeps the batch's hashes until collect: the
+        # frames are in flight, not gone
+        sigs, self._pending_sigs = self._pending_sigs, 0
+        if not batch:
+            return
+        self.stats["batches"] += 1
+        _registry().meter("herder.admission.flush").mark()
+        _registry().histogram("herder.admission.batch-size").update(
+            len(batch))
+        eventlog.record("Herder", "INFO", "admission batch flushed",
+                        txs=len(batch), sigs=sigs, depth=self.depth)
+        bid = next(_BATCH_IDS)
+        self._maybe_collect_warmup()
+        if self._preverify is not None and self._warmed \
+                and sigs >= self.accel_min_sigs:
+            # dispatch-ahead: the device batch is enqueued NOW (no sync);
+            # the race-bounded collect runs as a posted action, so batch
+            # k+1 can form (and dispatch) while batch k computes
+            self._preverify.dispatch({bid: [p.frame for p in batch]},
+                                     ledger_state=self.lm.root)
+            self.stats["sigs_offloaded"] += sigs
+            _registry().counter("herder.admission.sigs-offloaded").inc(sigs)
+        else:
+            bid = -bid   # CPU batch: no device group to collect
+        self._inflight.append((bid, batch))
+        self._inflight_count += len(batch)
+        self._post_collect()
+
+    def _post_collect(self) -> None:
+        if not self._collect_posted and self._inflight:
+            self._collect_posted = True
+            self.clock.post_action(self._collect_next,
+                                   name="admission-collect")
+
+    def _collect_next(self) -> None:
+        """Finish the oldest in-flight batch: race-bounded collect of its
+        device verdicts (seeds the verify cache; a miss just means
+        ``try_add`` recomputes on CPU — verdicts identical), then hand
+        every frame to the TransactionQueue."""
+        self._collect_posted = False
+        if not self._inflight:
+            return
+        bid, batch = self._inflight.pop(0)
+        self._inflight_count -= len(batch)
+        if bid > 0:
+            self._preverify.collect(bid)
+        for p in batch:
+            self._tracked_hashes.discard(p.frame.content_hash())
+            res = self._admit(p.frame, p.t0, p.origin)
+            if p.on_result is not None:
+                p.on_result(res)
+        self._update_backpressure()
+        self._post_collect()
+
+    def _admit(self, frame, t0: float, origin: str) -> AddResult:
+        res = self.tx_queue.try_add(frame)
+        dt = _time.perf_counter() - t0
+        _registry().timer("herder.admission.latency").update(dt)
+        if res.code == AddResult.STATUS_PENDING:
+            self.stats["admitted"] += 1
+            _registry().meter("herder.admission.admitted").mark()
+            self.on_admitted(frame, origin)
+        else:
+            self.stats["rejected"] += 1
+            _registry().meter("herder.admission.rejected").mark()
+        return res
+
+    # ------------------------------------------------------------------
+    # accel warmup
+    # ------------------------------------------------------------------
+    def _dispatch_warmup(self) -> None:
+        """Enqueue a throwaway batch so the device kernel compiles off the
+        critical path.  Admission keeps using the CPU path until the warm
+        verdicts materialize; a wedged tunnel therefore degrades to CPU
+        admission without ever blocking a submission."""
+        from ..crypto.keys import SecretKey
+        from ..crypto.sha import sha256
+        from ..testutils import build_tx, native_payment_op
+        from .. import xdr as X
+        sk = SecretKey(sha256(b"admission warmup throwaway key"))
+        frame = build_tx(self.lm.network_id, sk, 1, [native_payment_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 1)])
+        self._warm_id = next(_BATCH_IDS)
+        self._preverify.dispatch({self._warm_id: [frame]})
+
+    def _maybe_collect_warmup(self) -> None:
+        if self._warmed or self._preverify is None \
+                or self._warm_id is None:
+            return
+        if self._preverify.job_done(self._warm_id):
+            # non-blocking: the device event is already set
+            self._preverify.collect(self._warm_id)
+            self._warm_id = None
+            self._warmed = True
+            log.info("admission accel path warmed (kernel compiled); "
+                     "batches >= %d sigs now dispatch to the device",
+                     self.accel_min_sigs)
+
+    # ------------------------------------------------------------------
+    def drain(self, max_crank: int = 10_000) -> None:
+        """Crank the clock until every submitted envelope has a verdict
+        (loadgen/test convenience; the live node just cranks)."""
+        n = 0
+        while self.depth > 0 and n < max_crank:
+            if self._pending and (self._flush_timer is None
+                                  or not self._flush_timer.seated):
+                self._flush()
+            self.clock.crank()
+            n += 1
+
+    def close(self) -> None:
+        if self._preverify is not None:
+            self._preverify.close()
+        if self._flush_timer is not None:
+            self._flush_timer.cancel()

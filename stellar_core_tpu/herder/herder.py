@@ -77,6 +77,9 @@ class Herder(SCPDriver):
         self.scp = SCP(self, self.node_id, is_validator, qset)
         self.pending = PendingEnvelopes()
         self.tx_queue = TransactionQueue(ledger_manager)
+        # batched admission (herder/admission.py); None = legacy inline
+        # single-sig intake.  Installed via enable_admission().
+        self.admission = None
         self.quorum_tracker = QuorumTracker(self.node_id)
         self.pending.add_qset(qset)
 
@@ -198,14 +201,29 @@ class Herder(SCPDriver):
             self._process_scp_queue()
         return ok
 
-    def recv_transaction(self, frame) -> AddResult:
+    def recv_transaction(self, frame, origin: str = "api") -> AddResult:
         """Reference: HerderImpl::recvTransaction (from /tx or overlay).
-        Newly-pending txs are flooded to peers (overlay broadcast; pull-mode
-        adverts once the TCP overlay is wired)."""
+        With an admission pipeline enabled, intake is batched: the frame
+        joins the current admission batch (verified on the accel path when
+        it wins the CPU race) and flooding happens once admitted.  Without
+        one, the legacy single-sig path runs inline.  Newly-pending txs
+        are flooded to peers either way."""
+        if self.admission is not None:
+            return self.admission.submit(frame, origin=origin)
         res = self.tx_queue.try_add(frame)
         if res.code == AddResult.STATUS_PENDING:
             self.tx_flood(frame)
         return res
+
+    def enable_admission(self, accel: bool = False, **knobs) -> None:
+        """Install the batched admission pipeline in front of the
+        tx-queue (herder/admission.py).  Admitted frames flood exactly
+        like the legacy path did."""
+        from .admission import AdmissionPipeline
+        self.admission = AdmissionPipeline(
+            self.tx_queue, self.lm, self.clock, accel=accel,
+            on_admitted=lambda frame, origin: self.tx_flood(frame),
+            **knobs)
 
     def _process_scp_queue(self) -> None:
         if self._processing_ready:

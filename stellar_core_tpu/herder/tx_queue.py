@@ -57,6 +57,14 @@ def surge_sort_key(frame: TransactionFrame):
     return (-fee_per_op(frame), frame.content_hash())
 
 
+# Eviction order = exact REVERSE of the surge sort BY CONSTRUCTION: max()
+# over the same key picks the lowest fee-per-op tx, largest content hash
+# among equal rates — precisely the tx surge pricing would include last
+# (a bare min-by-fee left equal-rate ties to dict insertion order).  One
+# key function so the two orders can never drift apart.
+eviction_key = surge_sort_key
+
+
 class TransactionQueue:
     def __init__(self, ledger_manager, pool_ledger_multiplier: int =
                  QUEUE_SIZE_MULTIPLIER):
@@ -67,6 +75,12 @@ class TransactionQueue:
         self.by_hash: Dict[bytes, TransactionFrame] = {}
         # banned tx hash -> ledgers remaining
         self.banned: Dict[bytes, int] = {}
+        # eviction-victim cache: (mutation counter, victim frame).  The
+        # victim scan is O(queue); under overload the admission prefilter
+        # and try_add both need it for every submission against an
+        # unchanged full queue — cache until by_hash actually mutates
+        self._mutations = 0
+        self._victim_cache: Optional[tuple] = None
         # depth gauges: registry is process-global, so the last-created
         # queue wins (multi-node simulations share one registry; per-node
         # depth stays in /metrics' herder section); weak_gauge so a
@@ -111,7 +125,7 @@ class TransactionQueue:
         if existing is not None:
             self._drop(existing)
         elif len(self.by_hash) >= self._max_queue_size():
-            victim = min(self.by_hash.values(), key=fee_per_op)
+            victim = self._eviction_victim()
             if fee_per_op(victim) >= fee_per_op(frame):
                 return AddResult(AddResult.STATUS_TRY_AGAIN_LATER)
             self._drop(victim)
@@ -119,10 +133,23 @@ class TransactionQueue:
 
         self.by_account[akey] = frame
         self.by_hash[h] = frame
+        self._mutations += 1
         return AddResult(AddResult.STATUS_PENDING)
+
+    def _eviction_victim(self) -> TransactionFrame:
+        """The frame a full queue evicts first (see eviction_key), cached
+        across the admission prefilter -> try_add double lookup and across
+        submissions that leave the queue untouched."""
+        cached = self._victim_cache
+        if cached is not None and cached[0] == self._mutations:
+            return cached[1]
+        victim = max(self.by_hash.values(), key=eviction_key)
+        self._victim_cache = (self._mutations, victim)
+        return victim
 
     def _drop(self, frame: TransactionFrame) -> None:
         self.by_hash.pop(frame.content_hash(), None)
+        self._mutations += 1
         akey = self._account_key(frame)
         if self.by_account.get(akey) is frame:
             del self.by_account[akey]
@@ -158,6 +185,19 @@ class TransactionQueue:
 
     def is_banned(self, tx_hash: bytes) -> bool:
         return tx_hash in self.banned
+
+    def below_fee_floor(self, frame: TransactionFrame) -> bool:
+        """True when a FULL queue would refuse this tx on fee grounds
+        alone: it does not beat the current eviction victim's fee rate
+        (and is not a replace-by-fee candidate for its own account's
+        pending tx).  The admission pipeline applies this surge-pricing
+        economics check BEFORE spending signature verification on a tx
+        that try_add would reject anyway."""
+        if len(self.by_hash) < self._max_queue_size():
+            return False
+        if self._account_key(frame) in self.by_account:
+            return False  # replace-by-fee path decides, not eviction
+        return fee_per_op(self._eviction_victim()) >= fee_per_op(frame)
 
     # ------------------------------------------------------------------
     def get_transactions(self) -> List[TransactionFrame]:

@@ -487,6 +487,49 @@ class LedgerManager:
             self._emit_close_meta(header_entry, tx_set, result_pairs)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)
 
+    def close_ledger_synthetic(self, init_entries: Sequence[X.LedgerEntry],
+                               close_time: int) -> None:
+        """Advance one ledger injecting `init_entries` directly — no txs,
+        no fees, no signatures, no invariants (reference shape:
+        BucketApplicator seeding state during ApplyBucketsWork, repurposed
+        as the load-campaign seam for synthesizing account universes at
+        millions-of-entries scale without replaying millions of
+        CreateAccount ops).  Entries must be NEW keys (they land as INIT
+        in the bucket list); the header advances and hashes exactly like
+        a real close of an empty tx set over the mutated bucket list."""
+        release_assert(self.root is not None,
+                       "start_new_ledger/load first")
+        seq = self.lcl_header.ledgerSeq + 1
+        entries = list(init_entries)
+        for e in entries:
+            e.lastModifiedLedgerSeq = seq
+        self.bucket_list.add_batch(seq, self.lcl_header.ledgerVersion,
+                                   entries, [], [])
+        if self.root.disk_backed:
+            with tracing.span("bucket.snapshot"):
+                self._refresh_snapshot(seq)
+            self.bucket_list.enforce_residency()
+            self._maybe_gc_buckets(seq)
+        ltx = LedgerTxn(self.root)
+        header = ltx.load_header()
+        header.ledgerSeq = seq
+        header.previousLedgerHash = self.lcl_hash
+        header.scpValue = X.StellarValue(txSetHash=b"\x00" * 32,
+                                         closeTime=close_time)
+        header.txSetResultHash = sha256(
+            X.TransactionResultSet(results=[]).to_xdr())
+        header.bucketListHash = self.bucket_list.hash()
+        self._update_skip_list(header)
+        ltx.commit_header(header)
+        if not self.root.disk_backed:
+            for e in entries:
+                ltx.create(e)
+        ltx.commit()
+        self.lcl_header = self.root.get_header()
+        self.lcl_hash = sha256(self.lcl_header.to_xdr())
+        if self.db is not None:
+            self._persist_lcl()
+
     def _emit_close_meta(self, header_entry, tx_set, result_pairs) -> None:
         """Emit LedgerCloseMeta v0 (reference: METADATA_OUTPUT_STREAM —
         one length-prefixed XDR frame per close)."""

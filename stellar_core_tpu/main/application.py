@@ -161,10 +161,26 @@ class Application:
             self.herder.attach_persistence(self.database)
         if config.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING:
             self.herder.ledger_timespan = 1.0
+        if config.ADMISSION:
+            # batched admission verification in front of the tx-queue
+            # (herder/admission.py): /tx + overlay floods accumulate into
+            # accel-sized batches; back-pressure feeds overlay flow
+            # control (wired below) and /health
+            self.herder.enable_admission(
+                accel=config.ACCEL == "tpu",
+                accel_chunk=config.ACCEL_CHUNK_SIZE,
+                batch_size=config.ADMISSION_BATCH_SIZE,
+                flush_delay_s=config.ADMISSION_FLUSH_DELAY_S,
+                max_backlog=config.ADMISSION_MAX_BACKLOG)
         self.overlay = OverlayManager(self.clock, self.herder,
                                       self.network_id, self.node_secret,
                                       listening_port=config.PEER_PORT,
                                       database=self.database)
+        if self.herder.admission is not None:
+            # backlog drained -> re-grant the flow-control capacity the
+            # peers earned while the valve was closed
+            self.herder.admission.on_backpressure_release = \
+                self.overlay.release_flood_grants
         for addr in config.KNOWN_PEERS:
             host, _, port = addr.partition(":")
             self.overlay.peer_manager.add_address(host, int(port or 11625))
@@ -344,6 +360,8 @@ class Application:
 
     def stop(self) -> None:
         self._stopped = True
+        if self.herder.admission is not None:
+            self.herder.admission.close()
         if self.lm.meta_stream is not None \
                 and not callable(self.lm.meta_stream):
             self.lm.meta_stream.close()
@@ -402,11 +420,14 @@ class Application:
         }
 
     def submit_tx(self, envelope_xdr: bytes) -> dict:
-        """POST /tx backend (reference: CommandHandler::tx)."""
+        """POST /tx backend (reference: CommandHandler::tx).  Malformed
+        submissions surface as XDR/validation errors (XdrError IS-A
+        ValueError) — the structured rejection path; anything else is a
+        bug worth a loud traceback, not a silent ERROR reply."""
         try:
             env = X.TransactionEnvelope.from_xdr(envelope_xdr)
             frame = self.lm.make_frame(env)
-        except Exception as e:
+        except ValueError as e:
             log.debug("rejecting submitted tx: %s", e)
             return {"status": "ERROR", "detail": f"malformed: {e}"}
         res = self.herder.recv_transaction(frame)

@@ -71,6 +71,14 @@ class Config:
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
+    # Batched admission (herder/admission.py): /tx + overlay TRANSACTION
+    # intake accumulates into accel-sized verification batches with
+    # back-pressure wired to overlay flow control and surge pricing.
+    # false = legacy inline single-sig intake.
+    ADMISSION: bool = True
+    ADMISSION_BATCH_SIZE: int = 256          # flush at this many sigs
+    ADMISSION_FLUSH_DELAY_S: float = 0.05    # deadline flush, partial batch
+    ADMISSION_MAX_BACKLOG: int = 4096        # then: try-again-later
     LOG_LEVEL: str = "INFO"
     # "json" = one-JSON-object-per-line structured records carrying the
     # current span id (trace correlation); runtime-switchable via
@@ -127,6 +135,8 @@ class Config:
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
             "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
+            "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
+            "ADMISSION_MAX_BACKLOG",
         }
         for key, val in raw.items():
             if key in simple:

@@ -141,6 +141,17 @@ def evaluate_health(app) -> dict:
     if depth > max_depth:
         reasons.append(f"tx queue depth {depth} exceeds {max_depth}")
 
+    # sustained admission backlog: the pipeline's hysteresis valve is the
+    # "sustained" filter (engages at the high watermark, clears only at
+    # the low one) — while engaged, this node is shedding/throttling
+    # intake and a load balancer should route around it
+    adm = getattr(app.herder, "admission", None)
+    adm_depth = adm.depth if adm is not None else 0
+    if adm is not None and adm.backpressured:
+        reasons.append(f"admission backlog {adm_depth} "
+                       f"(back-pressure engaged at "
+                       f"{adm.backpressure_high})")
+
     peers = app.overlay.num_authenticated()
     # an app without a config (e.g. a simulated in-process node) is by
     # definition part of a network and expects peers
@@ -162,6 +173,7 @@ def evaluate_health(app) -> dict:
             "close_target_s": close_target,
             "herder_state": state,
             "tx_queue_depth": depth,
+            "admission_backlog": adm_depth,
             "authenticated_peers": peers,
             "bucket_gc_backlog": backlog,
         },

@@ -233,6 +233,21 @@ class OverlayManager:
     def flush_adverts(self) -> None:
         self.adverts.flush_all()
 
+    # -- admission back-pressure (reference: FlowControl's capacity model
+    #    is the valve; the admission queue depth is the signal) ------------
+    def flood_grants_paused(self) -> bool:
+        """True while the herder's admission pipeline is back-pressured:
+        peers' earned flow-control capacity is deferred, throttling the
+        flood intake feeding the backlog (overlay/peer.py)."""
+        adm = getattr(self.herder, "admission", None)
+        return adm is not None and adm.backpressured
+
+    def release_flood_grants(self) -> None:
+        """Back-pressure released: ship every deferred grant (wired to
+        AdmissionPipeline.on_backpressure_release)."""
+        for peer in self._auth_peer_list():
+            peer.release_deferred_grant()
+
     def clear_below(self, ledger_seq: int) -> None:
         self.floodgate.clear_below(ledger_seq)
         self.survey.maybe_expire()
@@ -336,7 +351,12 @@ class OverlayManager:
             self.stats["deduped"] += 1
             _registry().meter("overlay.flood.duplicate").mark()
             return
-        res = self.herder.recv_transaction(frame)
+        res = self.herder.recv_transaction(frame, origin="overlay")
+        if self.herder.admission is not None:
+            # batched admission floods via on_admitted -> flood_transaction
+            # once the frame actually verifies; re-advertising here would
+            # announce txs that may still fail admission
+            return
         if getattr(res, "code", None) == "pending":
             # re-advertise to everyone who hasn't seen it
             for p in self._auth_peer_list():

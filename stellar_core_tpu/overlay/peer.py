@@ -102,6 +102,9 @@ class Peer:
         self._flood_queue: List[X.StellarMessage] = []
         self._processed_since_grant = 0
         self._processed_bytes_since_grant = 0
+        # back-pressure: grants the admission pipeline told us to hold —
+        # (messages, bytes) owed to the peer once the backlog drains
+        self._deferred_grant: Optional[List[int]] = None
 
     # -- transport interface (subclass-provided) ----------------------------
     def _write_bytes(self, data: bytes) -> None:
@@ -337,8 +340,30 @@ class Peer:
                 nb = self._processed_bytes_since_grant
                 self._processed_since_grant = 0
                 self._processed_bytes_since_grant = 0
+                if self.overlay.flood_grants_paused():
+                    # admission back-pressure: the capacity is EARNED but
+                    # not granted — the sender stays throttled until the
+                    # local backlog drains, then the deferred grant ships
+                    # in one SEND_MORE_EXTENDED (release_deferred_grant)
+                    if self._deferred_grant is None:
+                        self._deferred_grant = [0, 0]
+                    self._deferred_grant[0] += n
+                    self._deferred_grant[1] += nb
+                    _registry().meter("overlay.flood.grant-deferred").mark()
+                    return
                 self.send_message(X.StellarMessage.sendMoreExtendedMessage(
                     X.SendMoreExtended(numMessages=n, numBytes=nb)))
+
+    def release_deferred_grant(self) -> None:
+        """Ship every flow-control grant withheld while admission was
+        back-pressured (overlay_manager.release_flood_grants)."""
+        if self._deferred_grant is None or self.state != Peer.GOT_AUTH:
+            return
+        n, nb = self._deferred_grant
+        self._deferred_grant = None
+        if n or nb:
+            self.send_message(X.StellarMessage.sendMoreExtendedMessage(
+                X.SendMoreExtended(numMessages=n, numBytes=nb)))
 
 
 class LoopbackPeer(Peer):

@@ -52,6 +52,15 @@ CANONICAL_METRICS = frozenset({
     "herder.ledger.externalize",
     "herder.tx-queue.depth",
     "herder.tx-queue.banned",
+    # admission (batched intake verification, herder/admission.py)
+    "herder.admission.depth",
+    "herder.admission.latency",
+    "herder.admission.batch-size",
+    "herder.admission.flush",
+    "herder.admission.admitted",
+    "herder.admission.rejected",
+    "herder.admission.overload",
+    "herder.admission.sigs-offloaded",
     # overlay
     "overlay.peer.drop",
     "overlay.peer.authenticated",
@@ -61,6 +70,7 @@ CANONICAL_METRICS = frozenset({
     "overlay.message.read",
     "overlay.message.write",
     "overlay.flood.duplicate",
+    "overlay.flood.grant-deferred",
     # catchup / historywork
     "catchup.download.checkpoint",
     "catchup.apply.checkpoint",

@@ -1,0 +1,501 @@
+"""Sustained-ingestion suite: AdmissionPipeline + TransactionQueue overload
+semantics exercised THROUGH the admission path, back-pressure wiring into
+overlay flow control, /health degradation, and the seed-derived load
+campaign over BucketListDB.
+
+Reference models: src/herder/test/TransactionQueueTests.cpp (surge
+pricing, replace-by-fee, bans), src/overlay/FlowControl (capacity
+valve), src/simulation/LoadGenerator (traffic shapes).
+"""
+
+import tempfile
+from fractions import Fraction
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.herder.admission import AdmissionPipeline
+from stellar_core_tpu.herder.tx_queue import (AddResult, BAN_DEPTH,
+                                              FEE_MULTIPLIER,
+                                              TransactionQueue, eviction_key,
+                                              fee_per_op)
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.testutils import (TestAccount, build_tx,
+                                        create_account_op,
+                                        native_payment_op)
+from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+
+def _fund(lm, root, sks, balance=10**11):
+    lm.close_ledger([root.tx([create_account_op(
+        X.AccountID.ed25519(sk.public_key.ed25519), balance)
+        for sk in sks])], close_time=lm.lcl_header.scpValue.closeTime + 5)
+    out = []
+    for sk in sks:
+        e = lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(
+                sk.public_key.ed25519))).to_xdr())
+        out.append(TestAccount(lm, sk, e.data.value.seqNum))
+    return out
+
+
+@pytest.fixture
+def env():
+    lm = LedgerManager(sha256(b"admission test net"))
+    lm.start_new_ledger()
+    root_sk = lm.root_account_secret()
+    e = lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+        accountID=X.AccountID.ed25519(
+            root_sk.public_key.ed25519))).to_xdr())
+    root = TestAccount(lm, root_sk, e.data.value.seqNum)
+    accts = _fund(lm, root, [SecretKey(bytes([i + 1]) * 32)
+                             for i in range(12)])
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    clock.crank_for(1.0)   # move off t=0 so the burst detector is sane
+    q = TransactionQueue(lm)
+    adm = AdmissionPipeline(q, lm, clock, max_backlog=64)
+    yield lm, clock, q, adm, accts
+    adm.close()
+
+
+def pay(src, dst, amount=1000, fee=None, n_ops=1):
+    ops = [native_payment_op(dst.account_id, amount)] * n_ops
+    return src.tx(ops, fee=fee) if fee else src.tx(ops)
+
+
+def submit_burst(adm, frames, collect=None):
+    """Submit without cranking (one burst), then drain; returns the final
+    per-frame verdicts delivered through on_result."""
+    out = {}
+    for f in frames:
+        adm.submit(f, on_result=lambda res, h=f.content_hash():
+                   out.__setitem__(h, res))
+    adm.drain()
+    return out
+
+
+class TestLatencyFloorAndBatching:
+    def test_sparse_arrival_is_synchronous(self, env):
+        lm, clock, q, adm, accts = env
+        f = pay(accts[0], accts[1])
+        res = adm.submit(f)
+        # idle pipeline: the verdict is the REAL try_add verdict, computed
+        # inline on the single-sig path — no deadline wait
+        assert res.code == AddResult.STATUS_PENDING
+        assert q.size == 1
+        assert adm.stats["sync_path"] == 1
+        assert adm.depth == 0
+
+    def test_burst_forms_batches_and_delivers_callbacks(self, env):
+        lm, clock, q, adm, accts = env
+        frames = [pay(a, accts[0]) for a in accts[1:9]]
+        verdicts = submit_burst(adm, frames)
+        assert q.size == 8
+        assert adm.stats["batches"] >= 1
+        assert all(v.code == AddResult.STATUS_PENDING
+                   for v in verdicts.values())
+        assert len(verdicts) == 8
+
+    def test_deadline_flush_bounds_partial_batch_wait(self, env):
+        lm, clock, q, adm, accts = env
+        adm.submit(pay(accts[0], accts[1]))              # sync (sparse)
+        adm.submit(pay(accts[1], accts[0]))              # burst -> pending
+        assert adm.depth == 1
+        # nothing else arrives: the deadline timer must flush it
+        clock.crank_for(adm.flush_delay_s * 2)
+        assert adm.depth == 0
+        assert q.size == 2
+
+    def test_duplicate_detected_in_pending_batch(self, env):
+        lm, clock, q, adm, accts = env
+        adm.submit(pay(accts[0], accts[1]))              # sync
+        f = pay(accts[1], accts[0])
+        assert adm.submit(f).code == AddResult.STATUS_PENDING
+        assert adm.submit(f).code == AddResult.STATUS_DUPLICATE
+        adm.drain()
+        assert q.size == 2
+
+    def test_duplicate_detected_in_inflight_batch(self, env):
+        lm, clock, q, adm, accts = env
+        adm.submit(pay(accts[0], accts[1]))              # sync
+        f = pay(accts[1], accts[0])
+        assert adm.submit(f).code == AddResult.STATUS_PENDING
+        adm._flush()                                     # dispatched, not
+        assert adm._inflight and not adm._pending        # yet collected
+        # the original is in flight: a replay must answer DUPLICATE, not
+        # burn a second verification behind an optimistic PENDING
+        assert adm.submit(f).code == AddResult.STATUS_DUPLICATE
+        adm.drain()
+        assert q.size == 2
+        assert adm.stats["admitted"] == 2
+
+    def test_invalid_tx_verdict_delivered_async(self, env):
+        lm, clock, q, adm, accts = env
+        adm.submit(pay(accts[0], accts[1]))              # make it busy
+        bad = build_tx(lm.network_id, accts[1].secret,
+                       accts[1].seq_num + 999,
+                       [native_payment_op(accts[0].account_id, 1)])
+        got = submit_burst(adm, [bad])
+        assert got[bad.content_hash()].code == AddResult.STATUS_ERROR
+        assert q.size == 1
+
+
+class TestOverloadSemantics:
+    """tx_queue overload semantics through the admission path (ISSUE 7
+    satellite): surge eviction order, replace-by-fee boundary, ban
+    expiry."""
+
+    def _fill_queue(self, env, fee=200):
+        """Fill the downstream queue to capacity via admission."""
+        lm, clock, q, adm, accts = env
+        lm.lcl_header.maxTxSetSize = 2   # pool = 4 * 2 = 8
+        cap = q._max_queue_size()
+        fillers = [pay(a, accts[0], fee=fee) for a in accts[1:1 + cap]]
+        verdicts = submit_burst(adm, fillers)
+        assert q.size == cap
+        assert all(v.code == AddResult.STATUS_PENDING
+                   for v in verdicts.values())
+        return fillers
+
+    def test_surge_eviction_order_exact_fraction_and_hash_tiebreak(
+            self, env):
+        lm, clock, q, adm, accts = env
+        lm.lcl_header.maxTxSetSize = 2
+        cap = q._max_queue_size()
+        # graded fees, two equal-rate cheapest txs -> hash tiebreak decides
+        lo_a = pay(accts[1], accts[0], fee=100)             # 100/op
+        lo_b = pay(accts[2], accts[0], fee=200, n_ops=2)    # 100/op
+        rest = [pay(accts[3 + i], accts[0], fee=300 + i)
+                for i in range(cap - 2)]
+        submit_burst(adm, [lo_a, lo_b] + rest)
+        assert q.size == cap
+        assert fee_per_op(lo_a) == fee_per_op(lo_b) == Fraction(100, 1)
+        # the deterministic victim: lowest fee-per-op, LARGEST hash
+        victim = max((lo_a, lo_b), key=lambda f: f.content_hash())
+        survivor = lo_a if victim is lo_b else lo_b
+        assert max(q.by_hash.values(), key=eviction_key) is victim
+        newcomer = pay(accts[11], accts[0], fee=5000)
+        got = submit_burst(adm, [newcomer])
+        assert got[newcomer.content_hash()].code == \
+            AddResult.STATUS_PENDING
+        assert victim.content_hash() not in q.by_hash
+        assert survivor.content_hash() in q.by_hash
+        # the evicted tx is banned (reference: eviction bans)
+        assert q.is_banned(victim.content_hash())
+
+    def test_cheaper_than_floor_prefiltered_before_verification(self, env):
+        lm, clock, q, adm, accts = env
+        self._fill_queue(env, fee=200)
+        from stellar_core_tpu.util.metrics import registry
+        before = registry().counter("crypto.verify.recompute").value
+        cheap = pay(accts[11], accts[0], fee=100)
+        res = adm.submit(cheap)
+        # surge economics BEFORE verification: try-again-later without
+        # spending a single signature verify
+        assert res.code == AddResult.STATUS_TRY_AGAIN_LATER
+        assert adm.stats["prefiltered"] == 1
+        assert registry().counter("crypto.verify.recompute").value == before
+
+    def test_replace_by_fee_exact_10x_boundary(self, env):
+        lm, clock, q, adm, accts = env
+        a = accts[0]
+        f1 = pay(a, accts[1], fee=100)
+        assert adm.submit(f1).code == AddResult.STATUS_PENDING
+        clock.crank_for(1.0)
+        # 10x - 1: refused (same seq as f1 -> a real replacement attempt)
+        under = build_tx(lm.network_id, a.secret, f1.seq_num,
+                         [native_payment_op(accts[1].account_id, 2)],
+                         fee=FEE_MULTIPLIER * 100 - 1)
+        got = submit_burst(adm, [pay(accts[2], accts[0]), under])
+        assert got[under.content_hash()].code == \
+            AddResult.STATUS_TRY_AGAIN_LATER
+        clock.crank_for(1.0)
+        # exactly 10x: replaces
+        exact = build_tx(lm.network_id, a.secret, f1.seq_num,
+                         [native_payment_op(accts[1].account_id, 3)],
+                         fee=FEE_MULTIPLIER * 100)
+        got = submit_burst(adm, [pay(accts[3], accts[0]), exact])
+        assert got[exact.content_hash()].code == AddResult.STATUS_PENDING
+        assert exact.content_hash() in q.by_hash
+        assert f1.content_hash() not in q.by_hash
+
+    def test_ban_depth_expiry_through_admission(self, env):
+        lm, clock, q, adm, accts = env
+        f = pay(accts[0], accts[1])
+        q.ban([f])
+        assert adm.submit(f).code == AddResult.STATUS_BANNED
+        for _ in range(BAN_DEPTH - 1):
+            q.shift()
+        assert adm.submit(f).code == AddResult.STATUS_BANNED
+        q.shift()   # ban depth exhausted
+        clock.crank_for(1.0)
+        assert adm.submit(f).code == AddResult.STATUS_PENDING
+
+    def test_overload_answers_try_again_later_and_bounds_depth(self, env):
+        lm, clock, q, adm, accts = env
+        adm.max_backlog = 8
+        adm.backpressure_high = 4
+        adm.backpressure_low = 2
+        adm.submit(pay(accts[0], accts[1]))             # sync
+        shed = 0
+        for i in range(30):
+            f = build_tx(lm.network_id, accts[1 + i % 10].secret,
+                         1_000_000 + i,   # never admitted (bad seq) — but
+                         [native_payment_op(accts[0].account_id, 1)])
+            res = adm.submit(f)
+            assert adm.depth <= adm.max_backlog   # NEVER unbounded
+            if res.code == AddResult.STATUS_TRY_AGAIN_LATER:
+                shed += 1
+        assert shed > 0
+        assert adm.stats["overload"] == shed
+        adm.drain()
+        assert adm.depth == 0
+
+
+class TestBackpressureValve:
+    def test_hysteresis_and_release_hook(self, env):
+        lm, clock, q, adm, accts = env
+        adm.max_backlog = 64
+        adm.backpressure_high = 4
+        adm.backpressure_low = 1
+        released = []
+        adm.on_backpressure_release = lambda: released.append(True)
+        adm.submit(pay(accts[0], accts[1]))             # sync
+        frames = [pay(accts[1 + i], accts[0]) for i in range(6)]
+        for f in frames:
+            adm.submit(f)
+        assert adm.backpressured          # engaged at >= high
+        assert not released
+        adm.drain()
+        assert not adm.backpressured      # drained through low watermark
+        assert released == [True]
+
+    def test_peer_grants_deferred_while_backpressured(self, env):
+        """overlay/peer.py defers SEND_MORE grants while admission is
+        back-pressured and ships them on release — driven through a fake
+        overlay so the valve is tested in isolation."""
+        lm, clock, q, adm, accts = env
+        from stellar_core_tpu.overlay.peer import (
+            FLOW_CONTROL_SEND_MORE_BATCH, Peer)
+
+        class FakeOverlay:
+            network_id = lm.network_id
+            node_id = b"\x01" * 32
+
+            def __init__(self):
+                self.herder = type("H", (), {"admission": adm})()
+                self.peer_auth = None
+
+            def flood_grants_paused(self):
+                return adm.backpressured
+
+            def _peer_dropped(self, peer):
+                pass
+
+        sent = []
+        peer = Peer(FakeOverlay(), we_called_remote=True)
+        peer.state = Peer.GOT_AUTH
+        peer._send_key = b"\x02" * 32
+        peer._write_bytes = lambda data: None
+        peer.send_message = lambda msg: sent.append(msg)
+
+        adm.backpressured = True
+        tx = X.StellarMessage.transaction(
+            pay(accts[0], accts[1]).envelope)
+        for _ in range(FLOW_CONTROL_SEND_MORE_BATCH):
+            peer._account_flood_processing(tx, 100)
+        assert not sent                     # grant earned but DEFERRED
+        assert peer._deferred_grant == [FLOW_CONTROL_SEND_MORE_BATCH,
+                                        100 * FLOW_CONTROL_SEND_MORE_BATCH]
+        adm.backpressured = False
+        peer.release_deferred_grant()
+        assert len(sent) == 1
+        sm = sent[0].value
+        assert sm.numMessages == FLOW_CONTROL_SEND_MORE_BATCH
+        assert peer._deferred_grant is None
+
+    def test_health_degrades_on_sustained_backlog(self, env):
+        lm, clock, q, adm, accts = env
+        from stellar_core_tpu.herder.herder import HerderState
+        from stellar_core_tpu.main.status import (StatusManager,
+                                                  evaluate_health)
+
+        class FakeApp:
+            herder = type("H", (), {
+                "admission": adm, "tx_queue": q,
+                "ledger_timespan": 5.0,
+                "get_state_human": staticmethod(
+                    lambda: HerderState.TRACKING)})()
+            overlay = type("O", (), {
+                "num_authenticated": staticmethod(lambda: 1)})()
+            status = StatusManager()
+            bucket_store = None
+            config = None
+
+        FakeApp.lm = lm
+        FakeApp.clock = clock
+        # keep ledger age fresh
+        lm.lcl_header.scpValue.closeTime = int(clock.system_now())
+        doc = evaluate_health(FakeApp)
+        assert doc["status"] == "ok"
+        adm.backpressured = True
+        doc = evaluate_health(FakeApp)
+        assert doc["status"] == "degraded"
+        assert any("admission backlog" in r for r in doc["reasons"])
+        assert "admission_backlog" in doc["checks"]
+        adm.backpressured = False
+
+
+class TestFloodViaAdmission:
+    def test_admitted_frames_flood_once_verified(self, env):
+        lm, clock, q, adm, accts = env
+        flooded = []
+        adm.on_admitted = lambda frame, origin: flooded.append(
+            (frame.content_hash(), origin))
+        f_sync = pay(accts[0], accts[1])
+        adm.submit(f_sync, origin="overlay")
+        assert flooded == [(f_sync.content_hash(), "overlay")]
+        frames = [pay(accts[1 + i], accts[0]) for i in range(4)]
+        bad = build_tx(lm.network_id, accts[11].secret, 999_999,
+                       [native_payment_op(accts[0].account_id, 1)])
+        submit_burst(adm, frames + [bad])
+        hashes = {h for h, _ in flooded}
+        assert {f.content_hash() for f in frames} <= hashes
+        assert bad.content_hash() not in hashes   # failed admission
+
+
+class TestHerderWiring:
+    def test_enable_admission_routes_recv_transaction(self, env):
+        lm, clock, q, adm, accts = env
+        from stellar_core_tpu.herder.herder import Herder
+        h = Herder(clock, lm, SecretKey(b"\x77" * 32),
+                   X.SCPQuorumSet(threshold=1, validators=[
+                       X.NodeID.ed25519(
+                           SecretKey(b"\x77" * 32).public_key.ed25519)],
+                       innerSets=[]))
+        flooded = []
+        h.tx_flood = lambda frame: flooded.append(frame.content_hash())
+        h.enable_admission(batch_size=64, max_backlog=32)
+        clock.crank_for(1.0)
+        f = pay(accts[0], accts[1])
+        res = h.recv_transaction(f)
+        assert res.code == AddResult.STATUS_PENDING
+        assert f.content_hash() in h.tx_queue.by_hash
+        assert flooded == [f.content_hash()]
+        h.admission.close()
+
+
+class TestAccelAdmission:
+    def test_accel_batches_seed_verify_cache(self, env, monkeypatch):
+        """The accel path dispatches through PreverifyPipeline and seeds
+        the verify cache so try_add's SignatureChecker hits instead of
+        recomputing.  The device backend is faked with a sodium-exact
+        stand-in (the real-kernel differential lives in
+        test_accel_ed25519.py) — this test pins the PIPELINE contract:
+        warmup off the critical path, dispatch-ahead, cache seeding."""
+        lm, clock, q, _adm, accts = env
+        from stellar_core_tpu.accel import ed25519 as aed
+        from stellar_core_tpu.crypto import keys as ckeys
+        from stellar_core_tpu.crypto import sodium
+
+        calls = []
+
+        def fake_async(pks, sigs, msgs, **kw):
+            verdicts = [sodium.verify_detached(s, m, p)
+                        for p, s, m in zip(pks, sigs, msgs)]
+            calls.append(len(pks))
+            return lambda: verdicts
+
+        monkeypatch.setattr(aed, "verify_batch_async", fake_async)
+        ckeys.clear_verify_cache()
+        adm = AdmissionPipeline(q, lm, clock, accel=True,
+                                accel_min_sigs=4, batch_size=64,
+                                max_backlog=256)
+        try:
+            # warmup dispatched at construction; completes on the worker
+            import time
+            deadline = time.monotonic() + 10
+            while not adm._preverify.job_done(adm._warm_id) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            adm.submit(pay(accts[0], accts[1]))          # sync path
+            frames = [pay(accts[1 + i], accts[0]) for i in range(8)]
+            verdicts = submit_burst(adm, frames)
+            assert adm._warmed
+            assert all(v.code == AddResult.STATUS_PENDING
+                       for v in verdicts.values())
+            assert q.size == 9
+            # the batch (>= accel_min_sigs) went to the fake device and
+            # its verdicts were seeded: try_add hit the cache
+            assert any(c >= 8 for c in calls)
+            from stellar_core_tpu.util.metrics import registry
+            assert adm.stats["sigs_offloaded"] >= 8
+            assert registry().counter("crypto.verify.cache-hit").value >= 8
+        finally:
+            adm.close()
+
+
+class TestCampaign:
+    def test_small_campaign_over_bucketlistdb(self):
+        """The tier-1 load campaign: 60k seed-derived accounts installed
+        over BucketListDB in O(1) RAM, paced submission through admission,
+        overload shed by try-again-later/eviction, bounded everything."""
+        from stellar_core_tpu.simulation.loadgen import AdmissionCampaign
+        with tempfile.TemporaryDirectory() as d:
+            c = AdmissionCampaign(n_accounts=60_000, workdir=d,
+                                  install_chunk=15_000,
+                                  max_tx_set_ops=300, max_backlog=600)
+            try:
+                live = c.mgr.root.entry_count()
+                assert live == 60_000 + 1   # pool + network root
+                rep = c.run(n_ledgers=4, offered_per_ledger=900)
+            finally:
+                c.close()
+            assert rep["applied"] > 0
+            assert rep["sustained_tps"] > 0
+            # O(1) RAM: decoded entries bounded by the install chunk and
+            # the resident top levels, NOT the pool size
+            assert rep["peak_decoded_entries"] <= 6 * 15_000
+            # bounded queues under ~3x apply overload
+            assert rep["peak_queue_depth"] <= 4 * 300
+            assert rep["peak_admission_depth"] <= c.admission.max_backlog
+            # batching actually happened (not a sync-path degenerate run)
+            assert rep.get("batches", 0) > 0
+            assert rep["admission_p99_us"] > 0
+
+    @pytest.mark.slow
+    def test_million_account_campaign(self):
+        """ISSUE 7 acceptance: the million-account campaign completes over
+        BucketListDB inside the RSS guard, with overload answered by
+        try-again-later/eviction rather than unbounded growth."""
+        import resource
+        from stellar_core_tpu.simulation.loadgen import AdmissionCampaign
+        # ru_maxrss is a process-lifetime high-water mark: when the full
+        # suite runs first (chaos soaks, JAX warmup) the peak is already
+        # polluted, so the guard bounds the CAMPAIGN'S OWN growth of the
+        # peak — standalone (`make loadgen-slow`, fresh interpreter) that
+        # IS the absolute guard
+        rss0_mb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss // 1024
+        with tempfile.TemporaryDirectory() as d:
+            c = AdmissionCampaign(n_accounts=1_000_000, workdir=d,
+                                  max_tx_set_ops=500, max_backlog=2000)
+            try:
+                assert c.mgr.root.entry_count() == 1_000_001
+                rep = c.run(n_ledgers=4, offered_per_ledger=2500)
+            finally:
+                c.close()
+            assert rep["applied"] > 0
+            assert rep["peak_decoded_entries"] <= 6 * 20_000
+            assert rep["peak_admission_depth"] <= 2000
+            assert rep["peak_queue_depth"] <= 4 * 500
+            # the account pool is O(1) RAM: a million accounts must not
+            # grow the process past the campaign guard
+            rss_mb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss // 1024
+            grew_mb = rss_mb - rss0_mb
+            assert grew_mb < 4096, (
+                f"campaign grew peak RSS by {grew_mb} MB "
+                f"({rss0_mb} -> {rss_mb}), exceeding the guard")
