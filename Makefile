@@ -1,4 +1,4 @@
-.PHONY: native test lint metrics obs bucketdb bucketdb-slow chaos \
+.PHONY: native test lint race metrics obs bucketdb bucketdb-slow chaos \
 	chaos-soak loadgen loadgen-slow clean
 
 native:
@@ -16,6 +16,19 @@ lint:
 
 test: lint
 	python -m pytest tests/ -q
+
+# race-sanitizer soak (ISSUE 9): the threaded test subset — admission
+# (incl. the loopback-flood hysteresis soak and the http-style marshalled
+# submission test), the thread-safety suite itself, and the chaos
+# scenario tier — with STPU_RACE_TRACE=1 so every @race_checked class is
+# instrumented and every make_lock lock feeds the per-field locksets.
+# An unguarded cross-thread write fail-stops with DataRaceError + crash
+# bundle.  Overhead: ~1.1µs per tracked access (PROFILE.md round 8).
+race:
+	env JAX_PLATFORMS=cpu STPU_RACE_TRACE=1 python -m pytest \
+		tests/test_thread_safety.py tests/test_admission.py \
+		tests/test_chaos.py -q -m 'not slow' \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # BucketListDB differential suite: on-disk index round-trip + corruption
 # fail-stop, snapshot consistency across closes, LRU bound, the

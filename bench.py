@@ -246,6 +246,51 @@ def bench_lint():
     }
 
 
+def bench_racetrace(n: int = 200_000):
+    """Race-sanitizer overhead row (ISSUE 9): µs per tracked attribute
+    access with the sanitizer ON vs the identical un-instrumented class,
+    plus the on/off ratio — the `make race` tax, reported next to the
+    lock-tracer note in PROFILE.md.  Runs in-process with enable()/
+    disable() so the rest of the bench stays uninstrumented."""
+    from stellar_core_tpu.util import lockorder, racetrace
+    from stellar_core_tpu.util.racetrace import race_checked
+
+    class _Plain:
+        def __init__(self):
+            self.x = 0
+
+    @race_checked
+    class _Checked:
+        def __init__(self):
+            self.x = 0
+
+    def loop(obj):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            obj.x = obj.x + 1        # one read + one write per iteration
+        return (time.perf_counter() - t0) / (2 * n) * 1e6
+
+    off_us = loop(_Plain())
+    prev_race = racetrace.enabled()
+    prev_lock = lockorder.enabled()
+    racetrace.enable()
+    try:
+        on_us = loop(_Checked())
+    finally:
+        # restore, don't clobber: under STPU_RACE_TRACE=1 the sanitizer
+        # must stay armed for the rest of the bench
+        if not prev_race:
+            racetrace.disable()
+        if not prev_lock:
+            lockorder.disable()
+    return {
+        "racetrace_off_us_per_access": round(off_us, 4),
+        "racetrace_on_us_per_access": round(on_us, 4),
+        "racetrace_overhead_x": round(on_us / off_us, 1)
+        if off_us > 0 else 0.0,
+    }
+
+
 def bench_chaos(time_left_fn):
     """Chaos campaign section (ISSUE 6): run the small-topology scenario
     tier — partition/flap/heal, stall+rejoin, corrupted floods, link
@@ -920,6 +965,13 @@ def main():
     lint_vals = bench_lint()
     _cache_put("lint", lint_vals)
     extra.update(lint_vals)
+
+    # race-sanitizer overhead: pure CPU, sub-second — alongside corelint
+    # so every report carries the `make race` tax (ISSUE 9)
+    _stage("racetrace overhead bench...")
+    rt_vals = bench_racetrace()
+    _cache_put("racetrace", rt_vals)
+    extra.update(rt_vals)
 
     # BucketListDB differential runs on CPU — measure it before touching
     # the (occasionally wedged) device so the numbers exist either way

@@ -22,6 +22,7 @@ from typing import Dict, Iterable, List, Optional, Set
 from ..crypto.sha import SHA256
 from ..util import eventlog
 from ..util.lockorder import make_rlock
+from ..util.racetrace import race_checked
 from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
 
@@ -310,6 +311,7 @@ class BucketStreamWriter:
             self._tmp = None
 
 
+@race_checked
 class BucketListStore(BucketDir):
     """BucketDir + per-file ``DiskBucketIndex`` cache + snapshot pinning —
     the storage half of BucketListDB (reference: BucketManager +

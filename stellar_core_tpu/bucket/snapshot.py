@@ -29,6 +29,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from ..util.lockorder import make_lock
 from ..util.metrics import registry as _registry
+from ..util.racetrace import race_checked
 from ..xdr import LedgerEntry
 from .bucket import _BE, Bucket, _is_dead
 
@@ -78,12 +79,13 @@ class _ResidentView:
                 yield kb, rec[4:]   # strip the BucketEntry type tag
 
 
+@race_checked
 class _DiskView:
     """Read view over an on-disk bucket file via its DiskBucketIndex.
     One persistent file handle per view; reads are lock-serialized (the
     admin HTTP thread may share a snapshot with the main thread)."""
 
-    __slots__ = ("index", "_f", "_lock")
+    __slots__ = ("index", "_f", "_lock", "_race_fields_")
 
     def __init__(self, index):
         self.index = index
@@ -167,9 +169,10 @@ class _DiskView:
                 yield kb, dead, f.read(end - off)
 
 
+@race_checked
 class SearchableBucketListSnapshot:
     __slots__ = ("ledger_seq", "_views", "_store", "_pinned", "_load_timer",
-                 "_probe_counters", "_live_count")
+                 "_probe_counters", "_live_count", "_race_fields_")
 
     def __init__(self, bucket_list, ledger_seq: int = 0, store=None):
         self.ledger_seq = ledger_seq

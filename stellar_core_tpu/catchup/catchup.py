@@ -211,7 +211,15 @@ class PreverifyPipeline:
 
     def _submit(self, fn):
         """Run fn on the single daemon device-worker; returns (box, event).
-        box["result"]/box["error"] is set before event fires."""
+        box["result"]/box["error"] is set before event fires.
+
+        Thread contract (ISSUE 9 audit): the worker body touches NO
+        pipeline instance state — only its own job tuple (box/event) and
+        the generation-tagged queue, handed over through Queue's internal
+        lock and Event's release ordering.  `_worker`/`_jobs` themselves
+        are written only from the dispatching (main) thread, which is why
+        the thread-safety reachability rule finds the worker role
+        field-free."""
         import queue
         import threading
         if self._worker is None:

@@ -53,6 +53,7 @@ from ..util import eventlog
 from ..util import logging as slog
 from ..util.clock import VirtualClock, VirtualTimer
 from ..util.metrics import registry as _registry
+from ..util.racetrace import race_checked
 from .tx_queue import AddResult, TransactionQueue
 
 log = slog.get("Herder")
@@ -72,6 +73,7 @@ class _Pending:
         self.on_result = on_result
 
 
+@race_checked
 class AdmissionPipeline:
     """Batched, back-pressured admission in front of a TransactionQueue.
 
@@ -114,7 +116,11 @@ class AdmissionPipeline:
         # fires per ADMITTED frame (herder wires tx flooding here)
         self.on_admitted = on_admitted or (lambda frame, origin: None)
 
-        self._pending: List[_Pending] = []
+        # Pipeline state is owned by the main crank loop: submit() runs
+        # either on it directly or marshalled there by http_admin, and
+        # flush/collect are clock actions.  The depth gauge read from
+        # admin threads is a GIL-atomic pair of len()s.
+        self._pending: List[_Pending] = []  # corelint: owned-by=main -- submit/flush/collect all run on the crank loop; gauge reads are GIL-atomic
         # hashes of every frame the pipeline owns but try_add hasn't seen
         # yet — pending AND in-flight — so a duplicate submitted while
         # the original's batch is still verifying answers DUPLICATE
@@ -127,7 +133,7 @@ class AdmissionPipeline:
         self._last_submit_at = float("-inf")
         # batches dispatched to the device but not yet collected:
         # [(batch_id, [_Pending, ...])] in dispatch (collect) order
-        self._inflight: List[tuple] = []
+        self._inflight: List[tuple] = []  # corelint: owned-by=main -- dispatched/collected only by clock actions on the crank loop
         self._inflight_count = 0
         self._flush_timer: Optional[VirtualTimer] = None
         self._collect_posted = False

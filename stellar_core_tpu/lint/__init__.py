@@ -8,10 +8,13 @@ Encodes the repo's cross-PR invariants as AST checks (see rules/):
   exception-hygiene  no silently swallowed `except Exception`
   metric-registry    static layer.subsystem.event + canonical-list check
   lock-order         cycle-free static lock-acquisition graph
+  thread-safety      cross-thread fields lock-guarded or owned-by annotated
+  raw-lock           threading.Lock/RLock only via util.lockorder.make_lock
 
 Run `python -m stellar_core_tpu.lint` (or `make lint`); suppress a
 finding with `# corelint: disable=<rule> -- reason` — suppressions are
-ratcheted by LINT_BASELINE.json.
+ratcheted by LINT_BASELINE.json.  The thread-safety rule's runtime twin
+is util/racetrace.py (`make race`).
 """
 
 from .core import (FileContext, LintReport, Rule, Violation,  # noqa: F401

@@ -12,6 +12,7 @@ from .exceptions import ExceptionHygieneRule
 from .ledger_txn import LedgerTxnPathsRule
 from .lock_order import LockOrderRule
 from .metric_names import MetricRegistryRule
+from .thread_safety import RawLockRule, ThreadSafetyRule
 
 ALL_RULE_CLASSES = (
     ClockDisciplineRule,
@@ -21,6 +22,8 @@ ALL_RULE_CLASSES = (
     MetricRegistryRule,
     EventlogPartitionRule,
     LockOrderRule,
+    ThreadSafetyRule,
+    RawLockRule,
 )
 
 

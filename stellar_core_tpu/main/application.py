@@ -423,7 +423,14 @@ class Application:
         """POST /tx backend (reference: CommandHandler::tx).  Malformed
         submissions surface as XDR/validation errors (XdrError IS-A
         ValueError) — the structured rejection path; anything else is a
-        bug worth a loud traceback, not a silent ERROR reply."""
+        bug worth a loud traceback, not a silent ERROR reply.
+
+        Thread contract (ISSUE 9 audit): MAIN THREAD ONLY.  http_admin
+        marshals /tx here via _on_main, so the whole admission chain
+        (recv_transaction -> AdmissionPipeline.submit -> tx_queue.try_add)
+        mutates queue state on the crank loop exclusively — that is the
+        ownership the tx_queue/admission `owned-by=main` annotations
+        attest and `make race` proves."""
         try:
             env = X.TransactionEnvelope.from_xdr(envelope_xdr)
             frame = self.lm.make_frame(env)

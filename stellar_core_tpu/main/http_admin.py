@@ -207,8 +207,14 @@ class CommandHandler:
                         self._reply(handler_self._on_main(
                             lambda: app.manual_close(), name="manualclose"))
                     elif url.path == "/bans":
-                        self._reply({"bans": [n.hex() for n in
-                                     app.overlay.ban_manager.banned_nodes()]})
+                        # _snap: sorted() iterates the ban set while the
+                        # main thread may ban/unban — retry the GIL-atomic
+                        # snapshot instead of surfacing a transient 500
+                        # (found by the thread-safety audit, ISSUE 9)
+                        self._reply({"bans": self._snap(
+                            lambda: [n.hex() for n in
+                                     app.overlay.ban_manager
+                                     .banned_nodes()])})
                     elif url.path == "/unban":
                         # marshalled: the ban table lives in the main
                         # thread's sqlite connection

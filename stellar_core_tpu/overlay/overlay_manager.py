@@ -19,6 +19,7 @@ from ..crypto.sha import sha256
 from ..util import eventlog
 from ..util import logging as slog
 from ..util.metrics import registry as _registry
+from ..util.racetrace import race_checked
 from .ban import BanManager
 from .flood import Floodgate, ItemFetcher, TxAdverts
 from .peer import Peer
@@ -34,6 +35,7 @@ _RECV_METER = {t: "overlay.recv." + t.name.lower().replace("_", "-")
                for t in X.MessageType}
 
 
+@race_checked
 class OverlayManager:
     def __init__(self, clock, herder, network_id: bytes,
                  node_secret: SecretKey, listening_port: int = 0,
@@ -47,7 +49,8 @@ class OverlayManager:
                                   now_fn=clock.system_now,
                                   auth_seed=auth_seed)
         self.pending_peers: List[Peer] = []
-        self.authenticated_peers: Dict[bytes, Peer] = {}  # peer_id -> Peer
+        # peer_id -> Peer; /peers snapshots this from admin threads
+        self.authenticated_peers: Dict[bytes, Peer] = {}  # corelint: owned-by=main -- peer lifecycle runs on the crank loop; admin /peers reads are GIL-atomic snapshots
         self.peer_manager = PeerManager(clock, database,
                                         self_port=listening_port)
         self.floodgate = Floodgate()

@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional
 from .clock import monotonic_now, wall_now
 from .lockorder import make_lock
 from .metrics import registry as _registry
+from .racetrace import race_checked
 from . import tracing as _tracing
 
 # Ring capacity: ~30s of a busy node (a replay close records one event
@@ -75,8 +76,12 @@ class FlightEvent:
         return out
 
 
+@race_checked
 class EventLog:
-    """Bounded ring of FlightEvents (newest kept)."""
+    """Bounded ring of FlightEvents (newest kept).  Fed from every
+    thread (main crank, admin workers via the log bridge, device worker
+    fail paths) and drained by /dumpflight — the canonical race-sanitizer
+    subject, which is why every access below is under ``_lock``."""
 
     def __init__(self, capacity: int = EVENTLOG_CAPACITY):
         self._events: deque = deque(maxlen=capacity)
@@ -183,7 +188,7 @@ def bridge_handler() -> FlightRecorderBridge:
 # by the Application (herder/SCP state, config fingerprint).  A source
 # that raises reports its error instead of sinking the whole bundle.
 _bundle_sources: Dict[str, Callable[[], dict]] = {}
-_bundle_lock = threading.Lock()
+_bundle_lock = make_lock("eventlog.bundle-sources")
 # re-entrancy latch: a fail-stop inside bundle writing (e.g. a metric
 # lock inverting while we snapshot) must not recurse forever
 _dumping = threading.local()

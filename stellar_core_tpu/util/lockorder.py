@@ -36,7 +36,11 @@ import os
 import threading
 from typing import Dict, List, Set, Tuple
 
-_enabled = bool(os.environ.get("STPU_LOCK_TRACE"))
+# STPU_RACE_TRACE implies lock tracing: the race sanitizer
+# (util/racetrace.py) computes per-field locksets from this module's
+# thread-local held stack, which only fills when locks are traced
+_enabled = bool(os.environ.get("STPU_LOCK_TRACE")) \
+    or bool(os.environ.get("STPU_RACE_TRACE"))
 _graph_mu = threading.Lock()
 # observed acquisition edges: held-class -> set of acquired-classes
 _edges: Dict[str, Set[str]] = {}
@@ -99,6 +103,14 @@ def observed_edges() -> Dict[str, Set[str]]:
 def reset_observed() -> None:
     with _graph_mu:
         _edges.clear()
+
+
+def held_locks() -> Tuple[str, ...]:
+    """Lock classes the CALLING thread currently holds, innermost last
+    (reentrant re-acquisitions appear once per acquire).  The race
+    sanitizer's lockset source; empty when tracing is off or the thread
+    holds only untraced locks."""
+    return tuple(_held_stack())
 
 
 def _held_stack() -> List[str]:

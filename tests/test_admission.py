@@ -499,3 +499,93 @@ class TestCampaign:
             assert grew_mb < 4096, (
                 f"campaign grew peak RSS by {grew_mb} MB "
                 f"({rss0_mb} -> {rss_mb}), exceeding the guard")
+
+
+class TestOverlayAdmissionSoak:
+    def test_loopback_floods_drive_hysteresis_valve_under_sanitizer(self):
+        """ISSUE 9 satellite (ROADMAP 3b): the SEND_MORE hysteresis valve
+        exercised by WIRE traffic — LoopbackPeer floods feed node B's
+        admission pipeline until the backlog crosses the high watermark,
+        B's receiving peer defers earned flow-control grants, and the
+        drain releases them in one SEND_MORE_EXTENDED restoring A's
+        capacity.  The whole soak runs under the race sanitizer so the
+        overlay/admission/tx-queue classes are lockset-checked while real
+        peer traffic drives them."""
+        from stellar_core_tpu.herder.herder import Herder
+        from stellar_core_tpu.overlay.overlay_manager import OverlayManager
+        from stellar_core_tpu.overlay.peer import (
+            PEER_FLOOD_READING_CAPACITY, make_loopback_pair)
+        from stellar_core_tpu.simulation.simulation import qset_of
+        from stellar_core_tpu.util import lockorder, racetrace
+
+        prev_race = racetrace.enabled()
+        prev_lock = lockorder.enabled()
+        racetrace.enable()   # BEFORE nodes are built: locks must be traced
+        try:
+            nid = sha256(b"overlay admission soak")
+            clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+            sk_a, sk_b = SecretKey(b"\x0a" * 32), SecretKey(b"\x0b" * 32)
+            qs = qset_of([sk_a.public_key.ed25519,
+                          sk_b.public_key.ed25519], 2)
+
+            def make_node(sk, seed):
+                lm = LedgerManager(nid)
+                lm.start_new_ledger()
+                herder = Herder(clock, lm, sk, qs)
+                overlay = OverlayManager(clock, herder, nid, sk,
+                                         auth_seed=seed)
+                return herder, overlay
+
+            ha, oa = make_node(sk_a, b"a" * 32)
+            hb, ob = make_node(sk_b, b"b" * 32)
+            # tiny backlog so wire traffic actually trips the valve
+            hb.enable_admission(batch_size=100_000, flush_delay_s=30.0,
+                                max_backlog=60)
+            hb.admission.on_backpressure_release = ob.release_flood_grants
+            pa, pb = make_loopback_pair(oa, ob)
+            for _ in range(50):
+                clock.crank()
+            assert pa.is_authenticated() and pb.is_authenticated()
+
+            root_sk = ha.lm.root_account_secret()
+            e = ha.lm.root.get_entry(X.LedgerKey.account(
+                X.LedgerKeyAccount(accountID=X.AccountID.ed25519(
+                    root_sk.public_key.ed25519))).to_xdr())
+            root = TestAccount(ha.lm, root_sk, e.data.value.seqNum)
+            n_floods = 160
+            frames = [root.tx([native_payment_op(root.account_id, 1 + i)])
+                      for i in range(n_floods)]
+
+            saw_deferred = False
+            engaged = False
+            for f in frames:
+                pa.send_message(X.StellarMessage.transaction(f.envelope))
+                clock.crank()
+                saw_deferred = saw_deferred \
+                    or pb._deferred_grant is not None
+                engaged = engaged or hb.admission.backpressured
+            for _ in range(100):
+                clock.crank()
+                saw_deferred = saw_deferred \
+                    or pb._deferred_grant is not None
+                engaged = engaged or hb.admission.backpressured
+            assert engaged, "wire floods never engaged back-pressure"
+            assert saw_deferred, "valve never deferred an earned grant"
+
+            hb.admission.drain()
+            for _ in range(50):
+                clock.crank()
+            assert not hb.admission.backpressured
+            assert pb._deferred_grant is None, \
+                "release must ship the deferred grant"
+            # every processed flood message was eventually granted back:
+            # A's capacity returns to the initial allowance
+            assert pa._outbound_capacity == PEER_FLOOD_READING_CAPACITY, \
+                pa._outbound_capacity
+            assert hb.admission.stats["submitted"] >= n_floods // 2
+            hb.admission.close()
+        finally:
+            if not prev_race:
+                racetrace.disable()
+            if not prev_lock:
+                lockorder.disable()

@@ -137,3 +137,46 @@ class TestExactFeeRate:
         assert fee_per_op(f1) == fee_per_op(f2)
         first = sorted([f1, f2], key=surge_sort_key)[0]
         assert first is min((f1, f2), key=lambda f: f.content_hash())
+
+
+class TestEvictionIndex:
+    def test_equal_key_duplicate_entries_never_compare_frames(self, env):
+        """Regression (PR 8 review): a dropped tx leaves a stale heap
+        entry; re-adding the identical envelope pushes an entry with an
+        EQUAL (fee, hash) key, and without the monotonic push counter
+        the heap sift would fall through to TransactionFrame comparison
+        (TypeError) on the overload hot path."""
+        lm, q, a, b, root = env
+        f = payment(a, b)
+        assert q.try_add(f).code == AddResult.STATUS_PENDING
+        q.remove_applied([f])            # stale heap entry stays (lazy)
+        assert q.size == 0
+        assert q.try_add(f).code == AddResult.STATUS_PENDING
+        # victim query must skip the stale twin and answer, not raise
+        assert q._eviction_victim() is f
+        assert len(q._evict_heap) >= 2   # the stale entry really is there
+
+    def test_victim_matches_exhaustive_scan_under_churn(self, env):
+        """The lazy-deletion heap must agree with the O(n) max() scan it
+        replaced, through adds, drops, bans and replace-by-fee churn."""
+        from stellar_core_tpu.herder.tx_queue import eviction_key
+        lm, q, a, b, root = env
+        sks = [SecretKey(bytes([10 + i]) * 32) for i in range(6)]
+        lm.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 100_000_000_000)
+            for sk in sks])],
+            close_time=lm.lcl_header.scpValue.closeTime + 5)
+        accts = []
+        for sk in sks:
+            e = lm.root.get_entry(X.LedgerKey.account(X.LedgerKeyAccount(
+                accountID=X.AccountID.ed25519(
+                    sk.public_key.ed25519))).to_xdr())
+            accts.append(TestAccount(lm, sk, e.data.value.seqNum))
+        frames = [payment(acct, b, fee=100 * (1 + i % 4))
+                  for i, acct in enumerate(accts)]
+        for f in frames:
+            assert q.try_add(f).code == AddResult.STATUS_PENDING
+        q.ban([frames[1]])
+        q.remove_applied([frames[4]])
+        expected = max(q.by_hash.values(), key=eviction_key)
+        assert q._eviction_victim() is expected
