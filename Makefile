@@ -1,5 +1,5 @@
 .PHONY: native test lint race metrics obs bucketdb bucketdb-slow chaos \
-	chaos-soak loadgen loadgen-slow clean
+	chaos-soak loadgen loadgen-slow catchup-par clean
 
 native:
 	python setup.py build_ext --inplace
@@ -79,6 +79,15 @@ loadgen:
 loadgen-slow:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py -q \
 		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# range-parallel catchup suite (ISSUE 10): plan/stitch units, real
+# subprocess-worker e2e hash identity vs the single-stream replay,
+# per-range retry-with-backoff, and the fail-stop discipline — tampered
+# interior ranges (corrupt assumed bucket, forged stitch record) must
+# crash-bundle and leave the authoritative ledger dir untouched.
+catchup-par:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_catchup_parallel.py \
+		-q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must
 # match layer.subsystem.event and appear in the documented canonical list

@@ -96,6 +96,35 @@ def _cache_put(section: str, values: dict, source: str = "bench.py on-chip run")
         _stage(f"cache write failed (non-fatal): {e}")
 
 
+def _merge_last_good(section: str, values: dict) -> dict:
+    """Per-ROW last-good: a section whose matrix mixes measured numbers
+    with SKIPPED/FAILED marker strings must not cache a marker OVER a
+    previously measured number — that would destroy exactly the value the
+    stale-fill path exists to preserve (a later degraded run would emit
+    the marker as the 'last-good' result).  The returned dict is what
+    gets cached: this run's rows, with any skipped/failed row restored to
+    the prior cached numeric value.  Restored rows keep honest
+    provenance: `restored_rows` maps each such key to the timestamp of
+    the run that actually MEASURED it (chained across runs), because the
+    section-level measured_at will be re-stamped to this run."""
+    got = _cache_load().get(section, {})
+    prev = got.get("values", {})
+    prev_restored = prev.get("restored_rows")
+    if not isinstance(prev_restored, dict):
+        prev_restored = {}
+    out = dict(values)
+    restored = {}
+    for k, v in values.items():
+        if isinstance(v, str) and (v.startswith("SKIPPED")
+                                   or v.startswith("FAILED")) \
+                and isinstance(prev.get(k), (int, float)):
+            out[k] = prev[k]
+            restored[k] = prev_restored.get(k, got.get("measured_at", "?"))
+    if restored:
+        out["restored_rows"] = restored
+    return out
+
+
 def _degraded_report(detail: str) -> dict:
     """Build the one-line JSON for a run that could not (fully) measure on
     chip: last-good cached numbers, each with its age, stale-flagged —
@@ -111,7 +140,7 @@ def _degraded_report(detail: str) -> dict:
         base = sig["values"].get("ed25519_libsodium_1core_sigs_per_sec", 0.0)
         vs = round(value / base, 2) if base else 0.0
     for section in ("sigs", "replay", "quorum", "bucketlistdb", "chaos",
-                    "admission"):
+                    "admission", "catchup_parallel"):
         got = cache.get(section)
         if not got:
             continue
@@ -408,6 +437,35 @@ def bench_admission(time_left_fn):
     finally:
         c.close()
 
+    # --- on-device admission row (ROADMAP 3c): the accel path batch-
+    # verifies through AdmissionPipeline's PreverifyPipeline — gated on
+    # the same ACCEL switch the node config flips, so CPU-only rigs (and
+    # tunnel-down days) emit an explicit SKIPPED row while the sections
+    # above stay measurable ---
+    if os.environ.get("ACCEL", "").lower() != "tpu":
+        vals["admission_accel"] = "SKIPPED(ACCEL!=tpu)"
+    elif time_left_fn() < 90.0:
+        vals["admission_accel"] = "SKIPPED(budget)"
+    else:
+        _stage("admission accel campaign (on-device batch verify)...")
+        c = AdmissionCampaign(n_accounts=4000, workdir=None, accel=True,
+                              batch_size=256, max_tx_set_ops=500,
+                              max_backlog=2000)
+        try:
+            rep = c.run(n_ledgers=3, offered_per_ledger=500)
+            stats = rep["admission_stats"]
+            vals["admission_accel_sustained_tps"] = rep["sustained_tps"]
+            vals["admission_accel_batches"] = rep.get("batches", 0)
+            vals["admission_accel_sigs_offloaded"] = \
+                stats.get("sigs_offloaded", 0)
+            vals["admission_accel_sync_path"] = stats.get("sync_path", 0)
+            for q in ("p50", "p99"):
+                key = f"admission_{q}_us"
+                if key in rep:
+                    vals[f"admission_accel_{q}_us"] = rep[key]
+        finally:
+            c.close()
+
     # --- 2+3. sustained campaign + 2x overload over BucketListDB ---
     if time_left_fn() < 120.0:
         vals["admission_campaign"] = "SKIPPED(budget)"
@@ -465,6 +523,103 @@ def bench_admission(time_left_fn):
                 rep2.get("peak_decoded_entries", 0)
         finally:
             c.close()
+    return vals
+
+
+def bench_catchup_parallel(time_left_fn):
+    """ISSUE 10 acceptance: range-parallel catchup wall-clock vs the
+    single-stream replay on a multi-thousand-ledger archive.  Both sides
+    run through the SAME subprocess-worker machinery (ParallelCatchup with
+    workers=1 vs 2/4) so the comparison includes every real cost — worker
+    spawn, per-range assume-state (hash-verified HAS + bucket download),
+    stitch verification.  Interleaved (single, par4) rounds with
+    replay-style mid-section pre-emption; the final ledger hash is
+    asserted bit-identical to the archive builder's on EVERY run and every
+    boundary stitch is asserted inside the orchestrator (it raises on any
+    mismatch).  CPU-only (workers default to the native apply engine)."""
+    from stellar_core_tpu.catchup.parallel import ParallelCatchup
+    from stellar_core_tpu.testutils import network_id
+
+    passphrase = "catchup parallel bench"
+    nid = network_id(passphrase)
+    n_pay = int(os.environ.get("BENCH_CATCHUP_PAR_LEDGERS", "2000"))
+    rounds = 3
+    vals = {}
+    with tempfile.TemporaryDirectory() as d:
+        _stage(f"catchup_parallel: building archive (~{n_pay} payment "
+               "ledgers)...")
+        t0 = time.perf_counter()
+        archive, mgr = build_archive(
+            nid, passphrase, os.path.join(d, "archive"),
+            n_payment_ledgers=n_pay,
+            txs_per_ledger=int(os.environ.get("BENCH_CATCHUP_PAR_TXS", "20")))
+        target = mgr.last_closed_ledger_seq
+        expected = mgr.lcl_hash.hex()
+        vals["catchup_par_ledgers"] = target
+        vals["catchup_par_build_s"] = round(time.perf_counter() - t0, 1)
+
+        run_idx = [0]
+
+        def one_run(workers: int) -> dict:
+            import shutil
+            run_idx[0] += 1
+            workdir = os.path.join(d, f"run-{run_idx[0]:02d}")
+            pc = ParallelCatchup(
+                os.path.join(d, "archive"), passphrase, workers=workers,
+                workdir=workdir)
+            report = pc.run()
+            assert report["final_hash"] == expected, \
+                f"parallel catchup (N={workers}) diverged from the builder"
+            assert report["stitches_verified"] == len(report["ranges"]) - 1
+            # the persisted final-range state is never adopted here —
+            # reclaim per run, or 7 full ledger states pile up under `d`
+            shutil.rmtree(workdir, ignore_errors=True)
+            return report
+
+        single_s, par4_s, par4_report = [], [], None
+        round_cost = None
+        rounds_skipped = 0
+        for r in range(rounds):
+            if round_cost is not None and time_left_fn() < round_cost * 1.25:
+                rounds_skipped = rounds - r
+                _stage(f"catchup_parallel: PRE-EMPTED after {r}/{rounds} "
+                       f"rounds (next needs ~{round_cost:.0f}s, "
+                       f"{time_left_fn():.0f}s left)")
+                break
+            t_round = time.perf_counter()
+            _stage(f"catchup_parallel round {r + 1}/{rounds}: "
+                   "single stream...")
+            single_s.append(one_run(1)["wall_s"])
+            _stage(f"catchup_parallel round {r + 1}/{rounds}: N=4...")
+            par4_report = one_run(4)
+            par4_s.append(par4_report["wall_s"])
+            round_cost = time.perf_counter() - t_round
+        if not single_s:
+            return None   # budget pre-empted before one full round
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        vals["catchup_par_single_s"] = med(single_s)
+        vals["catchup_par_n4_s"] = med(par4_s)
+        vals["catchup_par_speedup_n4"] = round(med(single_s) / med(par4_s),
+                                               2)
+        vals["catchup_par_single_ledgers_per_s"] = round(
+            target / med(single_s), 1)
+        vals["catchup_par_n4_ledgers_per_s"] = round(target / med(par4_s), 1)
+        vals["catchup_par_n4_stitches"] = \
+            par4_report["stitches_verified"]
+        vals["catchup_par_n4_range_rates"] = [
+            rr["ledgers_per_s"] for rr in par4_report["ranges"]]
+        if rounds_skipped:
+            vals["catchup_par_rounds_skipped_budget"] = rounds_skipped
+        # one N=2 point for the scaling curve when the budget still fits
+        if round_cost is not None and time_left_fn() > round_cost:
+            _stage("catchup_parallel: N=2...")
+            n2 = one_run(2)
+            vals["catchup_par_n2_s"] = n2["wall_s"]
+            vals["catchup_par_speedup_n2"] = round(
+                med(single_s) / n2["wall_s"], 2)
+        else:
+            vals["catchup_par_n2_s"] = "SKIPPED(budget)"
+        vals["catchup_par_hashes_identical"] = True
     return vals
 
 
@@ -803,80 +958,146 @@ def asym_org_map(n_orgs):
     return asym_org_qmap(n_orgs)
 
 
-def bench_quorum(budget_s: float = 700.0):
+def _quorum_map_for(row: str):
+    if row == "tier1":
+        return tier1_quorum_map()
+    if row == "rings16":
+        return adversarial_quorum_map()
+    if row == "rings12":
+        return adversarial_quorum_map(12)
+    if row.startswith("asym"):
+        return asym_org_map(int(row[len("asym"):]))
+    raise ValueError(f"unknown quorum bench row {row!r}")
+
+
+def _quorum_cell_main(row: str, engine: str) -> int:
+    """Body of `python bench.py --quorum-cell ROW ENGINE`: one quorum
+    matrix cell in its OWN process, so the parent can pre-empt it with a
+    hard kill when it overruns the global deadline (BENCH_r05 died rc=124
+    inside an in-process cell no soft check could interrupt).  Prints one
+    JSON line: the measured wall-clock of the check itself (imports and
+    TPU compile warm excluded, like the old in-process rows)."""
+    from stellar_core_tpu.herder.quorum_intersection import (
+        QuorumIntersectionChecker, check_intersection, _cquorum)
+
+    qmap = _quorum_map_for(row)
+    if engine == "contraction":
+        fn = lambda: check_intersection(qmap)
+    elif engine == "py":
+        # pure-Python enumeration, bypassing the native core AND the
+        # symmetric-org contraction (the oracle row of the matrix)
+        fn = lambda: QuorumIntersectionChecker(qmap)._check_python()
+    elif engine == "c":
+        if _cquorum is None:
+            # the pure-Python fallback is 14-23x slower and would blow the
+            # budget the estimates are calibrated for
+            print(json.dumps({"skipped": "no native engine"}))
+            return 0
+        fn = lambda: QuorumIntersectionChecker(qmap)._check_native()
+    elif engine == "tpu":
+        from stellar_core_tpu.accel.quorum import check_intersection_tpu
+        check_intersection_tpu(adversarial_quorum_map(12))  # compile warm
+        fn = lambda: check_intersection_tpu(qmap, batch_size=8192)
+    else:
+        print(json.dumps({"skipped": f"unknown engine {engine}"}))
+        return 2
+    t0 = time.perf_counter()
+    res = fn()
+    print(json.dumps({"s": round(time.perf_counter() - t0, 3),
+                      "intersects": bool(res.intersects)}))
+    return 0
+
+
+def _run_quorum_cell(row: str, engine: str, timeout_s: float) -> dict:
+    """Run one cell subprocess under a hard kill timeout.  Returns the
+    cell's JSON doc, {"preempted": wall_s} on timeout, or
+    {"failed": rc, "detail": ...} on an abnormal exit."""
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--quorum-cell", row, engine]
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"preempted": round(time.perf_counter() - t0, 1)}
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    if r.returncode != 0 or not lines:
+        return {"failed": r.returncode,
+                "detail": r.stderr.decode(errors="replace")[-300:]}
+    try:
+        return json.loads(lines[-1])
+    except ValueError:
+        return {"failed": r.returncode, "detail": lines[-1][-300:]}
+
+
+def bench_quorum(time_left_fn, budget_s: float = 700.0):
     """Config 3 + 5 as a CROSSOVER MATRIX (VERDICT r4 item 4): tier-1,
     rings and asym orgs=5..7 across all three engines — pure Python
     enumeration (the semantic oracle), native C (native/cquorum.c) and the
     TPU frontier enumerator — with per-engine wall-clocks in the driver
-    record.  Rows are attempted largest-last under a time budget so a
-    drifted chip degrades to SKIPPED rows, never a blown driver window.
-    r4 reference costs (slow-chip day): asym5 C 0.3s / TPU 56s; asym6
-    py 181s / C 9s / TPU 71s; asym7 C 93s / TPU 255s."""
-    from stellar_core_tpu.herder.quorum_intersection import (
-        QuorumIntersectionChecker, check_intersection, _cquorum)
-    from stellar_core_tpu.accel.quorum import check_intersection_tpu
+    record.
 
+    Every cell (one quorum core's check on one engine) runs in its own
+    subprocess with a HARD kill timeout bounded by both the matrix budget
+    and the remaining global BENCH_DEADLINE_S — the BENCH_r05 rc=124
+    post-mortem: an in-process cell that overran its estimate could not be
+    interrupted, so the driver's timeout fired before the JSON line.  Now
+    an overrunning cell is pre-empted mid-run, emits a SKIPPED row (the
+    last-good cache supplies its stale value), and the section ALWAYS
+    returns within the deadline.  r4 reference costs (slow-chip day):
+    asym5 C 0.3s / TPU 56s; asym6 py 181s / C 9s / TPU 71s; asym7 C 93s /
+    TPU 255s; TPU cells re-pay the compile warm per cell (excluded from
+    the reported number)."""
     t_start = time.perf_counter()
     matrix = {}
+    RESERVE_S = 30.0   # the reporting tail must always fit
 
     def left():
-        return budget_s - (time.perf_counter() - t_start)
+        return min(budget_s - (time.perf_counter() - t_start),
+                   time_left_fn() - RESERVE_S)
 
-    def run(row, engine, fn, estimate_s, expect=None):
-        if left() < estimate_s * 1.5:
-            matrix[f"{row}_{engine}_s"] = "SKIPPED(budget)"
-            return None
-        t0 = time.perf_counter()
-        res = fn()
-        dt = time.perf_counter() - t0
-        matrix[f"{row}_{engine}_s"] = round(dt, 3)
-        if expect is not None:
-            assert bool(res.intersects) == expect, (row, engine)
-        return res
-
-    def py_enum(qmap):
-        # the pure-Python enumeration, bypassing the native core AND the
-        # symmetric-org contraction (the oracle row of the matrix)
-        return QuorumIntersectionChecker(qmap)._check_python()
-
-    def c_enum(qmap):
-        return QuorumIntersectionChecker(qmap)._check_native()
-
-    def run_c(row, qmap, estimate_s, expect=None):
-        # the C rows are only meaningful with the native engine built —
-        # the pure-Python fallback is 14-23x slower and would blow the
-        # budget the estimates are calibrated for
-        if _cquorum is None:
-            matrix[f"{row}_c_s"] = "SKIPPED(no native engine)"
-            return None
-        return run(row, "c", lambda: c_enum(qmap), estimate_s,
-                   expect=expect)
+    def run(row, engine, estimate_s, expect=None):
+        key = f"{row}_{engine}_s"
+        lf = left()
+        if lf < estimate_s * 1.25:
+            matrix[key] = "SKIPPED(budget)"
+            return
+        # the kill bound: generous vs the estimate (4x) but never past
+        # what the global deadline still affords
+        cell = _run_quorum_cell(row, engine,
+                                timeout_s=max(5.0, min(lf, estimate_s * 4)))
+        if "preempted" in cell:
+            _stage(f"quorum cell {row}/{engine} PRE-EMPTED after "
+                   f"{cell['preempted']}s (estimate {estimate_s}s)")
+            matrix[key] = (f"SKIPPED(budget, pre-empted after "
+                           f"{cell['preempted']}s)")
+        elif "failed" in cell:
+            _stage(f"quorum cell {row}/{engine} failed rc={cell['failed']}: "
+                   f"{cell.get('detail', '')!r}")
+            matrix[key] = f"FAILED(rc={cell['failed']})"
+        elif "skipped" in cell:
+            matrix[key] = f"SKIPPED({cell['skipped']})"
+        else:
+            matrix[key] = cell["s"]
+            if expect is not None:
+                assert cell["intersects"] == expect, (row, engine)
 
     # tier-1 shape: answered by the symmetric-org contraction (product
-    # fast path) in ms — engine-independent
-    run("tier1", "contraction", lambda: check_intersection(tier1_quorum_map()),
-        1, expect=True)
-
-    rings = adversarial_quorum_map()
-    run("rings16", "py", lambda: py_enum(rings), 2, expect=True)
-    run_c("rings16", rings, 1, expect=True)
-    check_intersection_tpu(adversarial_quorum_map(12))  # compile warm
-    run("rings16", "tpu", lambda: check_intersection_tpu(rings), 30,
-        expect=True)
-
-    a5, a6, a7 = asym_org_map(5), asym_org_map(6), asym_org_map(7)
-    run("asym5", "py", lambda: py_enum(a5), 8, expect=True)
-    run_c("asym5", a5, 2, expect=True)
-    run("asym5", "tpu", lambda: check_intersection_tpu(a5, batch_size=8192),
-        70, expect=True)
+    # fast path) in ms — engine-independent; estimates include the cell's
+    # interpreter spin-up (and, for tpu, jax import + compile warm)
+    run("tier1", "contraction", 3, expect=True)
+    run("rings16", "py", 4, expect=True)
+    run("rings16", "c", 3, expect=True)
+    run("rings16", "tpu", 45, expect=True)
+    run("asym5", "py", 10, expect=True)
+    run("asym5", "c", 4, expect=True)
+    run("asym5", "tpu", 85, expect=True)
     matrix["asym6_py_s"] = "SKIPPED(~180s, over per-row budget)"
-    run_c("asym6", a6, 12, expect=True)
-    run("asym6", "tpu", lambda: check_intersection_tpu(a6, batch_size=8192),
-        90, expect=True)
+    run("asym6", "c", 14, expect=True)
+    run("asym6", "tpu", 105, expect=True)
     matrix["asym7_py_s"] = "SKIPPED(>900s measured r3)"
-    run_c("asym7", a7, 110, expect=True)
-    run("asym7", "tpu", lambda: check_intersection_tpu(a7, batch_size=8192),
-        260, expect=True)
+    run("asym7", "c", 115, expect=True)
+    run("asym7", "tpu", 275, expect=True)
     matrix["quorum_matrix_budget_s"] = budget_s
     matrix["quorum_matrix_spent_s"] = round(time.perf_counter() - t_start, 1)
     return matrix
@@ -1000,11 +1221,28 @@ def main():
     if budget_fits("admission", 90):
         _stage("admission bench (CPU-only)...")
         adm_vals = bench_admission(time_left)
-        _cache_put("admission", adm_vals)
+        _cache_put("admission", _merge_last_good("admission", adm_vals))
         extra.update(adm_vals)
     else:
         extra["admission"] = "SKIPPED(budget)"
         _stale_fill(extra, "admission")
+
+    # range-parallel catchup (ISSUE 10): CPU-only subprocess workers —
+    # wall-clock single-stream vs N=2/4 with hash identity + stitch proof
+    if budget_fits("catchup_parallel", 240):
+        _stage("catchup_parallel bench (CPU-only)...")
+        cpar = bench_catchup_parallel(time_left)
+        if cpar is None:
+            extra["catchup_parallel"] = \
+                "SKIPPED(budget, pre-empted mid-section)"
+            _stale_fill(extra, "catchup_parallel")
+        else:
+            _cache_put("catchup_parallel",
+                       _merge_last_good("catchup_parallel", cpar))
+            extra.update(cpar)
+    else:
+        extra["catchup_parallel"] = "SKIPPED(budget)"
+        _stale_fill(extra, "catchup_parallel")
 
     if not budget_fits("device probe + accel sections", 240):
         # nothing device-side fits anymore: emit what the CPU sections
@@ -1116,10 +1354,10 @@ def main():
     quorum_budget = min(700.0, time_left() - 45.0)
     if quorum_budget > 60.0:
         _stage("quorum bench (crossover matrix)...")
-        matrix = bench_quorum(budget_s=quorum_budget)
+        matrix = bench_quorum(time_left, budget_s=quorum_budget)
         from stellar_core_tpu.herder.quorum_intersection import _cquorum
         matrix["quorum_native_engine"] = _cquorum is not None
-        _cache_put("quorum", matrix)
+        _cache_put("quorum", _merge_last_good("quorum", matrix))
         extra.update(matrix)
     else:
         extra["quorum"] = "SKIPPED(budget)"
@@ -1137,6 +1375,9 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--quorum-cell":
+        # one pre-emptible quorum matrix cell (see bench_quorum)
+        sys.exit(_quorum_cell_main(sys.argv[2], sys.argv[3]))
     try:
         main()
     except AssertionError:

@@ -824,6 +824,26 @@ class CatchupManager:
             self._run_catchup_work(mgr, archive, target, clock, lookahead)
         return mgr
 
+    # -- one range of a parallel catchup ------------------------------------
+    def catchup_range(self, archive: FileHistoryArchive,
+                      seed_checkpoint: Optional[int], to_ledger: int,
+                      clock=None, lookahead: int = 2):
+        """Replay one contiguous checkpoint range: assume the hash-verified
+        bucket snapshot at `seed_checkpoint` (None = replay from genesis),
+        then replay through `to_ledger` with full verification.  Returns
+        (manager, seed_header_hash) — the seed hash is the stitch evidence
+        a parallel orchestrator checks against the previous range's final
+        ledger hash (catchup.parallel.verify_stitches)."""
+        if seed_checkpoint is None:
+            return (self.catchup_complete(archive, to_ledger=to_ledger,
+                                          clock=clock, lookahead=lookahead),
+                    None)
+        mgr = self.catchup_minimal(archive, checkpoint=seed_checkpoint)
+        seed_hash = mgr.lcl_hash
+        if mgr.last_closed_ledger_seq < to_ledger:
+            self._run_catchup_work(mgr, archive, to_ledger, clock, lookahead)
+        return mgr, seed_hash
+
     # -- minimal (assume state from buckets, no replay) ---------------------
     def catchup_minimal(self, archive: FileHistoryArchive,
                         checkpoint: Optional[int] = None) -> LedgerManager:

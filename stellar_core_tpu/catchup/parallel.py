@@ -1,0 +1,535 @@
+"""Range-parallel catchup: N concurrent checkpoint ranges stitched by
+assume-state.
+
+The survey's history design (PAPER.md §2 rows 17-19: one HAS per
+checkpoint, per-bucket hashes, `catchup_minimal` assume-state) makes every
+checkpoint range independently seedable: worker k assumes the hash-verified
+bucket snapshot at checkpoint k·R into its own BucketListDB dir, replays
+its R checkpoints with full signature/tx-set/bucket verification, and
+reports its final ledger header.  The stitch is PROVEN, not assumed —
+range k's final ledger hash must equal range k+1's seed header hash (the
+same 32 bytes the worker verified the assumed bucket list against), so the
+concatenation of verified ranges is exactly the single-stream replay:
+
+    range 0: genesis ──replay──▶ H(c_1)   ═╗ equal, or fail-stop
+    range 1: assume c_1 [hash H(c_1)] ─────╝ ──replay──▶ H(c_2) ═╗
+    range 2: assume c_2 [hash H(c_2)] ──────────────────────────╝ ─▶ ...
+
+Workers are real subprocesses (`python -m stellar_core_tpu catchup-range`)
+driven by util/process.ProcessManager — ranges get genuine CPU parallelism
+past the GIL, and each worker's own PreverifyPipeline keeps the accel path
+live per range.  Results travel through JSON files; a failed or corrupt
+range retries with the Work framework's standard truncated-exponential
+backoff (the same machinery the single-stream per-checkpoint download
+uses), and any stitch mismatch fail-stops the whole catchup with a crash
+bundle naming the offending boundary — the node's authoritative ledger dir
+is only ever written AFTER every boundary has verified.
+
+Reference sequencing: src/catchup/CatchupWork.cpp runs ApplyBucketsWork
+once, then ApplyCheckpointWork strictly sequentially; this module runs N
+CatchupWork-shaped pipelines whose ApplyBuckets seeds are interior
+checkpoints, then proves the seams.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import shutil
+import sys
+import time as _time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..history.archive import (CHECKPOINT_FREQUENCY, checkpoint_containing,
+                               make_archive)
+from ..util import eventlog
+from ..util import logging as slog
+from ..util.clock import ClockMode, VirtualClock
+from ..util.metrics import registry as _registry
+from ..util.process import ProcessManager
+from ..work.work import RETRY_A_FEW, BasicWork, State
+from .catchup import CatchupError
+
+log = slog.get("History")
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RangeSpec:
+    """One contiguous checkpoint range of a parallel catchup plan.
+
+    ``seed_checkpoint`` is the published boundary whose bucket snapshot the
+    worker assumes before replaying (None = range 0, which replays from
+    genesis); ``replay_to`` is the last ledger the range applies (a
+    checkpoint boundary for every range but possibly the last)."""
+    index: int
+    seed_checkpoint: Optional[int]
+    replay_to: int
+
+    @property
+    def replay_from(self) -> int:
+        return 2 if self.seed_checkpoint is None else self.seed_checkpoint + 1
+
+    @property
+    def n_ledgers(self) -> int:
+        return self.replay_to - self.replay_from + 1
+
+
+def plan_parallel_ranges(target: int, workers: int) -> List[RangeSpec]:
+    """Split the checkpoints covering (genesis, target] into up to
+    `workers` contiguous ranges.  Every interior seam sits on a published
+    checkpoint boundary so range k+1 can seed itself from the archive's
+    per-checkpoint HAS; ranges are balanced to within one checkpoint."""
+    if target < 2:
+        raise CatchupError(f"nothing to replay to ledger {target}")
+    if workers < 1:
+        raise CatchupError(f"workers must be >= 1, got {workers}")
+    last_cp = checkpoint_containing(target)
+    boundaries = list(range(CHECKPOINT_FREQUENCY - 1, last_cp + 1,
+                            CHECKPOINT_FREQUENCY))
+    n = max(1, min(workers, len(boundaries)))
+    base, rem = divmod(len(boundaries), n)
+    specs: List[RangeSpec] = []
+    seed: Optional[int] = None
+    start = 0
+    for k in range(n):
+        size = base + (1 if k < rem else 0)
+        end_cp = boundaries[start + size - 1]
+        replay_to = target if k == n - 1 else min(end_cp, target)
+        specs.append(RangeSpec(index=k, seed_checkpoint=seed,
+                               replay_to=replay_to))
+        seed = end_cp
+        start += size
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# the worker body (runs inside `python -m stellar_core_tpu catchup-range`)
+# ---------------------------------------------------------------------------
+
+def run_range(archive, spec: RangeSpec, network_id: bytes, passphrase: str,
+              *, accel: bool = False, accel_chunk: int = 8192,
+              native: Optional[bool] = None,
+              invariant_manager=None,
+              bucket_dir: Optional[str] = None,
+              entry_cache_size: Optional[int] = None,
+              resident_levels: Optional[int] = None,
+              persist_dir: Optional[str] = None,
+              clock=None, lookahead: int = 2) -> dict:
+    """Seed + replay one range and return its stitch record.  This is the
+    in-process body of the `catchup-range` worker subcommand; tests drive
+    it directly too.
+
+    With `bucket_dir`, the range's assumed/replayed state lives in its own
+    BucketListDB store there (throwaway for interior ranges).  With
+    `persist_dir`, the final state is durably persisted (Database +
+    BucketDir) so the orchestrator can adopt the last range's ledger."""
+    from ..catchup.catchup import CatchupManager
+
+    store = None
+    if bucket_dir is not None:
+        from ..bucket.manager import BucketListStore
+        store = BucketListStore(bucket_dir)
+    cm = CatchupManager(network_id, passphrase, accel=accel,
+                        accel_chunk=accel_chunk, native=native,
+                        invariant_manager=invariant_manager,
+                        bucket_store=store,
+                        entry_cache_size=entry_cache_size,
+                        resident_levels=resident_levels)
+    t0 = _time.perf_counter()
+    mgr, seed_hash = cm.catchup_range(archive, spec.seed_checkpoint,
+                                      spec.replay_to, clock=clock,
+                                      lookahead=lookahead)
+    wall = _time.perf_counter() - t0
+    if persist_dir is not None:
+        from ..bucket.manager import BucketDir
+        from ..database import Database
+        os.makedirs(persist_dir, exist_ok=True)
+        db = Database(os.path.join(persist_dir, "state.db"))
+        mgr.enable_persistence(db, BucketDir(
+            os.path.join(persist_dir, "buckets")))
+        db.close()
+    n = spec.n_ledgers
+    return {
+        "index": spec.index,
+        "seed_checkpoint": spec.seed_checkpoint,
+        "seed_header_hash": seed_hash.hex() if seed_hash is not None else None,
+        "replay_to": spec.replay_to,
+        "final_ledger_seq": mgr.last_closed_ledger_seq,
+        "final_hash": mgr.lcl_hash.hex(),
+        "ledgers_replayed": n,
+        "wall_s": round(wall, 3),
+        "ledgers_per_s": round(n / wall, 1) if wall > 0 else 0.0,
+        "sig_offload_hit_rate": round(cm.offload_hit_rate(), 3),
+        "persisted": persist_dir is not None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# stitch proof
+# ---------------------------------------------------------------------------
+
+def verify_stitches(results: List[dict],
+                    crash_dir: Optional[str] = None) -> int:
+    """Prove the seams: range k's final ledger (seq, hash) must equal range
+    k+1's seed (checkpoint, header hash).  Each verified boundary counts on
+    catchup.parallel.stitch-verified; any mismatch writes a crash bundle
+    naming the boundary and raises CatchupError.  Returns the number of
+    boundaries verified."""
+    counter = _registry().counter("catchup.parallel.stitch-verified")
+    verified = 0
+    for a, b in zip(results, results[1:]):
+        boundary = b["seed_checkpoint"]
+        detail = None
+        if a["final_ledger_seq"] != boundary:
+            detail = (f"range {a['index']} ended at ledger "
+                      f"{a['final_ledger_seq']}, range {b['index']} seeded "
+                      f"at checkpoint {boundary}")
+        elif a["final_hash"] != b["seed_header_hash"]:
+            detail = (f"range {a['index']} final hash {a['final_hash']} != "
+                      f"range {b['index']} seed header hash "
+                      f"{b['seed_header_hash']}")
+        if detail is not None:
+            eventlog.record("History", "ERROR", "parallel catchup stitch "
+                            "FAILED", boundary=boundary, detail=detail)
+            eventlog.write_crash_bundle(
+                f"parallel catchup stitch mismatch at checkpoint boundary "
+                f"{boundary}: {detail}", crash_dir=crash_dir)
+            raise CatchupError(
+                f"stitch mismatch at checkpoint boundary {boundary}: "
+                f"{detail}")
+        counter.inc()
+        verified += 1
+        eventlog.record("History", "INFO", "stitch verified",
+                        boundary=boundary,
+                        hash=a["final_hash"][:16])
+    return verified
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+class RangeWork(BasicWork):
+    """One range's subprocess, as a Work: on_run launches the worker via
+    ProcessManager and WAITs; a non-zero exit or an unreadable result file
+    is a FAILURE, which BasicWork retries with the single-stream catchup's
+    truncated-exponential backoff (archive corruption is transient on real
+    mirrors)."""
+
+    def __init__(self, clock: VirtualClock, pm: ProcessManager,
+                 cmdline: str, result_path: str, spec: RangeSpec,
+                 log_path: Optional[str] = None,
+                 workdir: Optional[str] = None,
+                 max_retries: int = RETRY_A_FEW):
+        super().__init__(clock, f"catchup-range-{spec.index}",
+                         max_retries=max_retries)
+        self.pm = pm
+        self.cmdline = cmdline
+        self.result_path = result_path
+        self.log_path = log_path
+        self.workdir = workdir
+        self.spec = spec
+        self.result: Optional[dict] = None
+        self.error_detail: Optional[str] = None
+        self._ev = None
+        self._code: Optional[int] = None
+
+    def on_reset(self) -> None:
+        if self._ev is not None and self._ev.running:
+            self.pm.cancel(self._ev)
+        self._ev = None
+        self._code = None
+
+    def _on_exit(self, code: int) -> None:
+        self._code = code
+        self.wake_up()
+
+    def on_run(self) -> State:
+        if self._ev is None:
+            if self.workdir is not None and self.retries > 0:
+                # a crashed attempt can leave TORN range-private state
+                # (half-written state.db, a bucketlistdb mid-adopt);
+                # feeding it back in would turn a one-shot transient fault
+                # into max_retries hard failures — every retry starts from
+                # the pristine dir a fresh worker would get
+                shutil.rmtree(self.workdir, ignore_errors=True)
+                os.makedirs(self.workdir, exist_ok=True)
+            try:
+                os.unlink(self.result_path)   # stale result from a retry
+            except FileNotFoundError:
+                pass
+            eventlog.record("History", "INFO", "range worker started",
+                            range=self.spec.index,
+                            replay_to=self.spec.replay_to,
+                            attempt=self.retries + 1)
+            self._ev = self.pm.run_command(self.cmdline, self._on_exit,
+                                           output_path=self.log_path)
+            return State.WAITING
+        if self._code is None:
+            return State.WAITING
+        if self._code == 0:
+            try:
+                with open(self.result_path) as f:
+                    result = json.load(f)
+            except (OSError, ValueError) as e:
+                self.error_detail = f"result file unreadable: {e}"
+                log.warning("%s: %s", self.name, self.error_detail)
+                _registry().counter("catchup.parallel.range-retry").inc()
+                return State.FAILURE
+            if "error" in result:
+                self.error_detail = result["error"]
+                log.warning("%s: worker error: %s", self.name,
+                            self.error_detail)
+                _registry().counter("catchup.parallel.range-retry").inc()
+                return State.FAILURE
+            self.result = result
+            _registry().histogram("catchup.parallel.range-rate").update(
+                result.get("ledgers_per_s", 0.0))
+            eventlog.record("History", "INFO", "range worker finished",
+                            range=self.spec.index,
+                            final=result["final_ledger_seq"],
+                            rate=result.get("ledgers_per_s", 0.0))
+            return State.SUCCESS
+        self.error_detail = f"worker exited {self._code}"
+        self.error_detail += self._tail_of_log()
+        log.warning("%s: %s", self.name, self.error_detail)
+        _registry().counter("catchup.parallel.range-retry").inc()
+        return State.FAILURE
+
+    def _tail_of_log(self) -> str:
+        if self.log_path is None:
+            return ""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 800))
+                tail = f.read().decode(errors="replace").strip()
+            return f"; log tail: {tail[-400:]}" if tail else ""
+        except OSError:
+            return ""
+
+
+class ParallelCatchup:
+    """Plan, fan out, stitch, adopt.
+
+    ``run()`` splits the archive's chain into up to `workers` contiguous
+    checkpoint ranges, replays them as bounded-concurrency subprocess
+    workers (each seeding itself via assume-state into a throwaway
+    BucketListDB dir under `workdir`), proves every boundary, and returns
+    the report.  The LAST range persists its state; ``load_manager()``
+    rebuilds the node's LedgerManager from it, and ``adopt_into()`` moves
+    it to the node's authoritative paths — both only reachable after the
+    stitch proof, so a poisoned range can never touch the real ledger."""
+
+    def __init__(self, archive_spec: str, passphrase: str, *,
+                 workers: int = 4, workdir: Optional[str] = None,
+                 accel: bool = False, accel_chunk: int = 8192,
+                 native: Optional[bool] = None,
+                 invariant_checks: Optional[List[str]] = None,
+                 in_memory: bool = False,
+                 entry_cache_size: Optional[int] = None,
+                 resident_levels: Optional[int] = None,
+                 max_retries: int = RETRY_A_FEW,
+                 keep_range_dirs: bool = False,
+                 crash_dir: Optional[str] = None,
+                 clock: Optional[VirtualClock] = None,
+                 python: str = sys.executable):
+        from ..crypto.sha import sha256
+        self.archive_spec = archive_spec
+        self.passphrase = passphrase
+        self.network_id = sha256(passphrase.encode())
+        self.workers = max(1, workers)
+        self._own_workdir = workdir is None
+        if workdir is None:
+            import tempfile
+            workdir = tempfile.mkdtemp(prefix="catchup-par-")
+        self.workdir = workdir
+        self.accel = accel
+        self.accel_chunk = accel_chunk
+        self.native = native
+        # INVARIANT_CHECKS patterns travel to every worker — a parallel
+        # catchup must honor exactly what the single-stream path would;
+        # same for the node's storage knobs (IN_MEMORY_LEDGER + the
+        # BucketListDB cache/residency bounds, which matter MOST when N
+        # workers share the box's memory)
+        self.invariant_checks = list(invariant_checks or [])
+        self.in_memory = in_memory
+        self.entry_cache_size = entry_cache_size
+        self.resident_levels = resident_levels
+        self.max_retries = max_retries
+        self.keep_range_dirs = keep_range_dirs
+        self.crash_dir = crash_dir
+        self.clock = clock or VirtualClock(ClockMode.REAL_TIME)
+        self.python = python
+        self.report: Optional[dict] = None
+        self._final_dir: Optional[str] = None
+
+    # -- worker command ----------------------------------------------------
+    def _range_dir(self, index: int) -> str:
+        return os.path.join(self.workdir, f"range-{index:02d}")
+
+    def _worker_cmdline(self, spec: RangeSpec) -> str:
+        d = self._range_dir(spec.index)
+        args = [self.python, "-m", "stellar_core_tpu", "catchup-range",
+                "--archive", self.archive_spec,
+                "--passphrase", self.passphrase,
+                "--to", str(spec.replay_to),
+                "--seed-checkpoint",
+                ("genesis" if spec.seed_checkpoint is None
+                 else str(spec.seed_checkpoint)),
+                "--workdir", d,
+                "--result", os.path.join(d, "result.json")]
+        args += ["--index", str(spec.index)]
+        if spec.index == len(self._specs) - 1:
+            args.append("--persist")
+        if self.accel:
+            args += ["--accel", "tpu", "--accel-chunk",
+                     str(self.accel_chunk)]
+        if self.native is not None:
+            args += ["--native", "on" if self.native else "off"]
+        for pattern in self.invariant_checks:
+            args += ["--invariant", pattern]
+        if self.in_memory:
+            args.append("--in-memory")
+        if self.entry_cache_size is not None:
+            args += ["--entry-cache-size", str(self.entry_cache_size)]
+        if self.resident_levels is not None:
+            args += ["--resident-levels", str(self.resident_levels)]
+        return " ".join(shlex.quote(a) for a in args)
+
+    # -- driving -----------------------------------------------------------
+    def run(self, target: Optional[int] = None) -> dict:
+        archive = make_archive(self.archive_spec)
+        has = archive.get_state()
+        if has is None:
+            raise CatchupError("archive has no HAS")
+        if target is None:
+            target = has.current_ledger
+        self._specs = plan_parallel_ranges(target, self.workers)
+        if len(self._specs) == 1:
+            log.info("parallel catchup degenerates to a single range "
+                     "(target %d)", target)
+        pm = ProcessManager(self.clock, max_concurrent=self.workers)
+        works: List[RangeWork] = []
+        for spec in self._specs:
+            d = self._range_dir(spec.index)
+            os.makedirs(d, exist_ok=True)
+            works.append(RangeWork(
+                self.clock, pm, self._worker_cmdline(spec),
+                os.path.join(d, "result.json"), spec,
+                log_path=os.path.join(d, "worker.log"),
+                workdir=d,
+                max_retries=self.max_retries))
+        inflight = _registry().gauge("catchup.parallel.ranges-inflight")
+        inflight.set_source(lambda: sum(1 for w in works if not w.done))
+        eventlog.record("History", "INFO", "parallel catchup started",
+                        target=target, ranges=len(self._specs),
+                        workers=self.workers)
+        t0 = _time.perf_counter()
+        for w in works:
+            w.start()
+        try:
+            while not all(w.done for w in works):
+                if self.clock.crank() == 0:
+                    # REAL_TIME + subprocesses still running: yield the
+                    # host instead of spinning the poll pump
+                    _time.sleep(0.02)
+        finally:
+            pm.shutdown()
+            # drop the closure over `works` (the registry is
+            # process-global; a stale source would pin every RangeWork)
+            inflight.set_source(lambda: 0)
+        wall = _time.perf_counter() - t0
+        failed = [w for w in works if not w.succeeded]
+        if failed:
+            w = failed[0]
+            detail = (f"range {w.spec.index} "
+                      f"(replay to {w.spec.replay_to}) failed after "
+                      f"{w.retries} retries: {w.error_detail or '?'}")
+            eventlog.record("History", "ERROR",
+                            "parallel catchup range FAILED",
+                            range=w.spec.index, detail=w.error_detail or "?")
+            eventlog.write_crash_bundle(
+                f"parallel catchup range failure: {detail}",
+                crash_dir=self.crash_dir)
+            raise CatchupError(detail)
+        results = [w.result for w in works]
+        stitches = verify_stitches(results, crash_dir=self.crash_dir)
+        final = results[-1]
+        if final["final_ledger_seq"] != target:
+            raise CatchupError(
+                f"parallel catchup ended at {final['final_ledger_seq']}, "
+                f"target {target}")
+        self._final_dir = self._range_dir(self._specs[-1].index)
+        self._gc_range_dirs()
+        total = sum(r["ledgers_replayed"] for r in results)
+        self.report = {
+            "target": target,
+            "workers": self.workers,
+            "ranges": results,
+            "stitches_verified": stitches,
+            "final_ledger_seq": final["final_ledger_seq"],
+            "final_hash": final["final_hash"],
+            "ledgers_replayed": total,
+            "wall_s": round(wall, 3),
+            "ledgers_per_s": round(total / wall, 1) if wall > 0 else 0.0,
+        }
+        eventlog.record("History", "INFO", "parallel catchup finished",
+                        target=target, stitches=stitches,
+                        wall_s=round(wall, 1))
+        log.info("parallel catchup: %d ledgers over %d ranges in %.1fs "
+                 "(%.0f ledgers/s), %d stitches verified", total,
+                 len(results), wall, self.report["ledgers_per_s"], stitches)
+        return self.report
+
+    def _gc_range_dirs(self) -> None:
+        """Interior ranges' state was only ever evidence for the stitch
+        proof; reclaim the disk (the final range's dir holds the adopted
+        ledger and survives)."""
+        if self.keep_range_dirs:
+            return
+        for spec in self._specs[:-1]:
+            shutil.rmtree(self._range_dir(spec.index), ignore_errors=True)
+
+    # -- adoption ----------------------------------------------------------
+    def load_manager(self, bucket_store=None,
+                     entry_cache_size: Optional[int] = None,
+                     resident_levels: Optional[int] = None):
+        """Rebuild a LedgerManager from the last range's persisted state
+        (only reachable after run() proved every stitch)."""
+        if self.report is None or self._final_dir is None:
+            raise CatchupError("parallel catchup has not completed")
+        from ..bucket.manager import BucketDir
+        from ..database import Database
+        from ..ledger.manager import LedgerManager
+        db = Database(os.path.join(self._final_dir, "state.db"))
+        bdir = BucketDir(os.path.join(self._final_dir, "buckets"))
+        return LedgerManager.load_last_known_ledger(
+            self.network_id, db, bdir, bucket_store=bucket_store,
+            entry_cache_size=entry_cache_size,
+            resident_levels=resident_levels)
+
+    def adopt_into(self, database_path: str, bucket_dir_path: str) -> None:
+        """Move the verified final range's durable state to the node's
+        authoritative paths.  Never called on a failed run — run() raised
+        before _final_dir was set, so tampered archives leave the real
+        ledger untouched."""
+        if self.report is None or self._final_dir is None:
+            raise CatchupError("parallel catchup has not completed")
+        os.makedirs(os.path.dirname(database_path) or ".", exist_ok=True)
+        shutil.move(os.path.join(self._final_dir, "state.db"), database_path)
+        if os.path.isdir(bucket_dir_path):
+            shutil.rmtree(bucket_dir_path)
+        shutil.move(os.path.join(self._final_dir, "buckets"),
+                    bucket_dir_path)
+
+    def cleanup(self) -> None:
+        """Drop the whole workdir (owned temp dirs only, unless forced)."""
+        if self._own_workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)

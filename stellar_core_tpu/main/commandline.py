@@ -78,19 +78,53 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _resolve_catchup_target(args):
+    """Shared --at/--to resolution for the single-stream and parallel
+    catchup routes (one copy, or the two would drift).  Returns
+    (error_message, target); exactly one is None."""
+    target = None
+    if args.at and args.at != "current":
+        try:
+            target = int(args.at)
+        except ValueError:
+            return (f"--at must be a ledger number or 'current', "
+                    f"got {args.at!r}"), None
+    if target is not None and args.to is not None and target != args.to:
+        return "--at and --to conflict; give one", None
+    return None, (target if target is not None else args.to)
+
+
 def cmd_catchup(args) -> int:
-    """Catch up from a history archive (reference: `stellar-core catchup`)."""
+    """Catch up from a history archive (reference: `stellar-core catchup`);
+    `--parallel N` splits the replay into N concurrent checkpoint ranges
+    stitched by assume-state (catchup/parallel.py)."""
     cfg = _load_config(args)
     from ..history.archive import make_archive
 
     if args.archive:
+        archive_spec = args.archive
         archive = make_archive(args.archive)
     elif cfg.HISTORY:
         spec = cfg.HISTORY[0]
+        archive_spec = spec.get_path
         archive = make_archive(spec.get_path, spec.put_path, spec.mkdir_cmd)
     else:
         print("no archive configured or given", file=sys.stderr)
         return 1
+    workers = args.parallel if args.parallel else cfg.CATCHUP_PARALLEL_WORKERS
+    if args.mode == "minimal" or args.count is not None:
+        # ranges seed themselves via assume-state already; a minimal or
+        # recent-N plan has at most one replay segment to parallelize.
+        # Only an EXPLICIT --parallel is an error — config-driven workers
+        # (CATCHUP_PARALLEL_WORKERS in node.cfg) must not break commands
+        # that were valid before the key was added; they fall back to the
+        # single stream.
+        if args.parallel > 1:
+            print("--parallel applies to complete catchup only (omit "
+                  "--mode/--count)", file=sys.stderr)
+            return 1
+    elif workers > 1:
+        return _cmd_catchup_parallel(args, cfg, archive_spec, workers)
     from ..catchup.catchup import CatchupManager
     from ..invariant.invariants import InvariantManager
     inv = (InvariantManager.from_patterns(cfg.INVARIANT_CHECKS)
@@ -113,18 +147,10 @@ def cmd_catchup(args) -> int:
                         bucket_store=store,
                         entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE,
                         resident_levels=cfg.BUCKET_RESIDENT_LEVELS)
-    at = None
-    if args.at and args.at != "current":
-        try:
-            at = int(args.at)
-        except ValueError:
-            print(f"--at must be a ledger number or 'current', "
-                  f"got {args.at!r}", file=sys.stderr)
-            return 1
-    if at is not None and args.to is not None and at != args.to:
-        print("--at and --to conflict; give one", file=sys.stderr)
+    err, at = _resolve_catchup_target(args)
+    if err:
+        print(err, file=sys.stderr)
         return 1
-    at = at if at is not None else args.to
     if args.mode == "minimal":
         if args.count is not None:
             # --count asks for CATCHUP_RECENT (bucket-apply + replay of the
@@ -152,6 +178,99 @@ def cmd_catchup(args) -> int:
         lm.enable_persistence(db, bdir)
         db.close()
         print(f"state persisted to {cfg.DATABASE}")
+    return 0
+
+
+def _cmd_catchup_parallel(args, cfg, archive_spec: str, workers: int) -> int:
+    """Range-parallel complete catchup: subprocess workers replay N
+    contiguous checkpoint ranges, every boundary's stitch is proven, and
+    the last range's verified state is adopted as the node's ledger."""
+    import os
+    from ..catchup.catchup import CatchupError
+    from ..catchup.parallel import ParallelCatchup
+
+    err, target = _resolve_catchup_target(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    pc = ParallelCatchup(archive_spec, cfg.NETWORK_PASSPHRASE,
+                         workers=workers,
+                         accel=cfg.ACCEL == "tpu",
+                         accel_chunk=cfg.ACCEL_CHUNK_SIZE,
+                         invariant_checks=cfg.INVARIANT_CHECKS,
+                         in_memory=cfg.IN_MEMORY_LEDGER,
+                         entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE,
+                         resident_levels=cfg.BUCKET_RESIDENT_LEVELS)
+    try:
+        report = pc.run(target=target)
+    except CatchupError as e:
+        print(f"parallel catchup FAILED: {e}", file=sys.stderr)
+        pc.cleanup()
+        return 1
+    print(f"caught up to ledger {report['final_ledger_seq']} "
+          f"hash {report['final_hash']} "
+          f"({len(report['ranges'])} ranges, "
+          f"{report['stitches_verified']} stitches verified, "
+          f"{report['ledgers_per_s']} ledgers/s)")
+    if cfg.DATABASE:
+        bdir = cfg.BUCKET_DIR_PATH or os.path.join(
+            os.path.dirname(cfg.DATABASE) or ".", "buckets")
+        pc.adopt_into(cfg.DATABASE, bdir)
+        print(f"state persisted to {cfg.DATABASE}")
+    pc.cleanup()
+    return 0
+
+
+def cmd_catchup_range(args) -> int:
+    """One range worker of a parallel catchup (spawned by
+    catchup/parallel.py; useful standalone for debugging a range).  Writes
+    a JSON stitch record to --result — on failure the record carries an
+    "error" key and the exit code is non-zero, so the orchestrator can
+    retry with backoff."""
+    import os
+    from ..catchup.catchup import CatchupError
+    from ..catchup.parallel import RangeSpec, run_range
+    from ..crypto.sha import sha256
+    from ..history.archive import make_archive
+
+    archive = make_archive(args.archive)
+    seed = (None if args.seed_checkpoint in ("", "genesis")
+            else int(args.seed_checkpoint))
+    spec = RangeSpec(index=args.index, seed_checkpoint=seed,
+                     replay_to=args.to)
+    os.makedirs(args.workdir, exist_ok=True)
+    native = {"auto": None, "on": True, "off": False}[args.native]
+    inv = None
+    if args.invariant:
+        from ..invariant.invariants import InvariantManager
+        inv = InvariantManager.from_patterns(args.invariant)
+
+    def write(doc: dict) -> None:
+        tmp = args.result + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.result)
+
+    try:
+        result = run_range(
+            archive, spec, sha256(args.passphrase.encode()),
+            args.passphrase,
+            accel=args.accel == "tpu", accel_chunk=args.accel_chunk,
+            native=native, invariant_manager=inv,
+            bucket_dir=(None if args.in_memory
+                        else os.path.join(args.workdir, "bucketlistdb")),
+            entry_cache_size=args.entry_cache_size or None,
+            resident_levels=(args.resident_levels
+                             if args.resident_levels >= 0 else None),
+            persist_dir=args.workdir if args.persist else None)
+    except (CatchupError, RuntimeError, ValueError, OSError) as e:
+        write({"index": spec.index, "error": str(e)})
+        print(f"range {spec.index} FAILED: {e}", file=sys.stderr)
+        return 1
+    write(result)
+    print(f"range {spec.index}: replayed {result['ledgers_replayed']} "
+          f"ledgers to {result['final_ledger_seq']} "
+          f"({result['ledgers_per_s']} ledgers/s)")
     return 0
 
 
@@ -583,7 +702,45 @@ def main(argv=None) -> int:
                         "cover the rest (CATCHUP_RECENT)")
     s.add_argument("--mode", choices=["complete", "minimal"],
                    default="complete")
+    s.add_argument("--parallel", type=int, default=0, metavar="N",
+                   help="replay as N concurrent checkpoint ranges stitched "
+                        "by assume-state (0 = config "
+                        "CATCHUP_PARALLEL_WORKERS)")
     s.set_defaults(fn=cmd_catchup)
+
+    s = sub.add_parser("catchup-range",
+                       help="one range worker of a parallel catchup "
+                            "(writes a JSON stitch record)")
+    s.add_argument("--archive", required=True)
+    s.add_argument("--passphrase", required=True)
+    s.add_argument("--to", type=int, required=True,
+                   help="last ledger of the range")
+    s.add_argument("--seed-checkpoint", default="genesis",
+                   help="checkpoint boundary to assume-state from, or "
+                        "'genesis'")
+    s.add_argument("--workdir", required=True,
+                   help="range-private dir (BucketListDB store + persisted "
+                        "state)")
+    s.add_argument("--result", required=True,
+                   help="path for the JSON stitch record")
+    s.add_argument("--index", type=int, default=0)
+    s.add_argument("--persist", action="store_true",
+                   help="durably persist the final state into --workdir")
+    s.add_argument("--accel", choices=["tpu", "none"], default="none")
+    s.add_argument("--accel-chunk", type=int, default=8192)
+    s.add_argument("--native", choices=["auto", "on", "off"],
+                   default="auto")
+    s.add_argument("--invariant", action="append", default=[],
+                   help="INVARIANT_CHECKS pattern (repeatable); forces "
+                        "the Python apply path like the single stream")
+    s.add_argument("--in-memory", action="store_true",
+                   help="IN_MEMORY_LEDGER mode (no range-private "
+                        "BucketListDB store)")
+    s.add_argument("--entry-cache-size", type=int, default=0,
+                   help="BUCKETLISTDB_ENTRY_CACHE_SIZE (0 = default)")
+    s.add_argument("--resident-levels", type=int, default=-1,
+                   help="BUCKET_RESIDENT_LEVELS (-1 = default)")
+    s.set_defaults(fn=cmd_catchup_range)
 
     s = sub.add_parser("publish", help="publish queued checkpoints")
     s.add_argument("--conf", required=True)

@@ -71,6 +71,12 @@ class Config:
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
+    # Range-parallel catchup (catchup/parallel.py): `catchup` splits a
+    # complete replay into this many concurrent checkpoint ranges, each a
+    # subprocess worker seeding itself via assume-state; every boundary's
+    # stitch (final hash == next seed header hash) is proven before the
+    # node adopts the last range's state.  1 = classic single stream.
+    CATCHUP_PARALLEL_WORKERS: int = 1
     # Batched admission (herder/admission.py): /tx + overlay TRANSACTION
     # intake accumulates into accel-sized verification batches with
     # back-pressure wired to overlay flow control and surge pricing.
@@ -134,7 +140,8 @@ class Config:
             "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
-            "ACCEL_CHUNK_SIZE", "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
+            "ACCEL_CHUNK_SIZE", "CATCHUP_PARALLEL_WORKERS",
+            "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
             "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
             "ADMISSION_MAX_BACKLOG",
         }

@@ -149,6 +149,22 @@ class LoadGenerator:
         while not is_checkpoint_boundary(self.mgr.last_closed_ledger_seq):
             self.close_empty_ledger()
 
+    def run_checkpoints(self, n: int, txs_per_ledger: int = 0) -> None:
+        """Advance the chain through `n` MORE published checkpoint
+        boundaries — payment traffic when txs_per_ledger > 0 (needs
+        accounts), empty closes otherwise.  The cheap way to grow the
+        multi-checkpoint archives range-parallel catchup and its bench
+        replay (each range needs whole checkpoints to own)."""
+        from ..history.archive import is_checkpoint_boundary
+        done = 0
+        while done < n:
+            if txs_per_ledger > 0 and len(self.accounts) >= 2:
+                self.payment_ledgers(1, txs_per_ledger)
+            else:
+                self.close_empty_ledger()
+            if is_checkpoint_boundary(self.mgr.last_closed_ledger_seq):
+                done += 1
+
     # ------------------------------------------------------------------
     # seed-derived account pools (millions of accounts, O(1) RAM)
     # ------------------------------------------------------------------

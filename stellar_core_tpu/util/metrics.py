@@ -80,6 +80,11 @@ CANONICAL_METRICS = frozenset({
     "catchup.preverify.sigs-total",
     "catchup.preverify.sigs-shipped",
     "catchup.preverify.fallback",
+    # range-parallel catchup (catchup/parallel.py)
+    "catchup.parallel.ranges-inflight",
+    "catchup.parallel.range-retry",
+    "catchup.parallel.range-rate",
+    "catchup.parallel.stitch-verified",
     # bucket
     "bucket.merge.time",
     "bucket.merge.stream",

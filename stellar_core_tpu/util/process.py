@@ -29,12 +29,20 @@ class ProcessExitEvent:
     """Handle for one running (or queued) command."""
 
     def __init__(self, cmdline: str,
-                 on_exit: Callable[[int], None]):
+                 on_exit: Callable[[int], None],
+                 output_path: Optional[str] = None):
         self.cmdline = cmdline
         self.on_exit = on_exit
+        self.output_path = output_path   # combined stdout+stderr capture
         self.proc: Optional[subprocess.Popen] = None
         self.exit_code: Optional[int] = None
         self.cancelled = False
+        self._out_fh = None
+
+    def _close_output(self) -> None:
+        if self._out_fh is not None:
+            self._out_fh.close()
+            self._out_fh = None
 
     @property
     def running(self) -> bool:
@@ -56,10 +64,13 @@ class ProcessManager:
         clock.add_io_pump(self._pump)
 
     def run_command(self, cmdline: str,
-                    on_exit: Callable[[int], None]) -> ProcessExitEvent:
+                    on_exit: Callable[[int], None],
+                    output_path: Optional[str] = None) -> ProcessExitEvent:
         """Queue a shell-less command; on_exit(code) fires on the clock loop
-        (reference: ProcessManagerImpl::runProcess)."""
-        ev = ProcessExitEvent(cmdline, on_exit)
+        (reference: ProcessManagerImpl::runProcess).  With `output_path`
+        the child's stdout+stderr append to that file (the parallel-catchup
+        range workers' post-mortem trail) instead of being discarded."""
+        ev = ProcessExitEvent(cmdline, on_exit, output_path=output_path)
         self._pending.append(ev)
         self._maybe_start()
         return ev
@@ -78,12 +89,18 @@ class ProcessManager:
                and len(self._running) < self.max_concurrent):
             ev = self._pending.popleft()
             try:
+                out = subprocess.DEVNULL
+                if ev.output_path is not None:
+                    ev._out_fh = open(ev.output_path, "ab")
+                    out = ev._out_fh
                 ev.proc = subprocess.Popen(
                     shlex.split(ev.cmdline),
-                    stdout=subprocess.DEVNULL,
-                    stderr=subprocess.DEVNULL)
+                    stdout=out,
+                    stderr=subprocess.STDOUT if ev.output_path is not None
+                    else subprocess.DEVNULL)
             except OSError as e:
                 log.warning("spawn failed: %s (%s)", ev.cmdline, e)
+                ev._close_output()
                 ev.exit_code = 127
                 self.clock.post_action(lambda ev=ev: ev.on_exit(127),
                                        name="process-exit")
@@ -97,6 +114,7 @@ class ProcessManager:
             if code is None:
                 continue
             ev.exit_code = code
+            ev._close_output()
             self._running.remove(ev)
             progressed += 1
             if not ev.cancelled:
@@ -118,6 +136,7 @@ class ProcessManager:
                 ev.proc.kill()
                 ev.proc.wait()
                 ev.exit_code = ev.proc.returncode
+            ev._close_output()
         self._running.clear()
 
     @property

@@ -88,8 +88,9 @@ def test_low_deadline_exits_zero_with_json_line(tmp_path):
     assert doc["metric"] == "ed25519_batch_verify_throughput"
     extra = doc["extra"]
     assert extra["bench_budget_s"] == 1.0
-    # every device-side section degraded to an explicit skip marker
-    for section in ("sigs", "replay", "quorum"):
+    # every device-side section degraded to an explicit skip marker, and
+    # the CPU-side catchup_parallel section skipped under budget too
+    for section in ("sigs", "replay", "quorum", "catchup_parallel"):
         assert str(extra.get(section, "")).startswith("SKIPPED"), \
             (section, extra.get(section))
 
@@ -143,3 +144,86 @@ def test_replay_rounds_preempted_by_deadline(bench):
     assert len(phases["cpu_rates"]) == 1
     # warm pass + one (cpu, accel) round = 3 catchup_complete calls
     assert calls["n"] == 3
+
+
+def test_quorum_cell_subprocess_roundtrip(bench):
+    """One matrix cell runs in its own process and reports its wall-clock
+    + verdict as a JSON line (the per-core pre-emption seam BENCH_r05's
+    in-process rows were missing)."""
+    cell = bench._run_quorum_cell("tier1", "contraction", timeout_s=120)
+    assert cell.get("intersects") is True
+    assert isinstance(cell["s"], float)
+
+
+def test_quorum_cell_preempted_by_hard_timeout(bench):
+    """An overrunning cell is KILLED, not waited out: even the cheapest
+    row cannot finish inside a 0.05s bound, so the runner must report
+    pre-emption instead of hanging or raising."""
+    cell = bench._run_quorum_cell("tier1", "contraction", timeout_s=0.05)
+    assert "preempted" in cell
+
+
+def test_bench_quorum_skips_everything_when_global_deadline_spent(bench):
+    """With the remaining global budget below the reporting reserve, every
+    cell emits SKIPPED(budget) without spawning a single subprocess — the
+    quorum analog of the replay rounds' pre-emption."""
+    spawned = []
+    bench._run_quorum_cell = lambda *a, **kw: spawned.append(a) or {}
+    matrix = bench.bench_quorum(time_left_fn=lambda: 31.0, budget_s=700.0)
+    assert not spawned
+    rows = [v for k, v in matrix.items()
+            if k.endswith("_s") and not k.startswith("quorum_matrix")]
+    assert rows and all(str(v).startswith("SKIPPED") for v in rows)
+
+
+def test_bench_quorum_records_preempted_cells_and_continues(bench):
+    """A cell that blows past its estimate is pre-empted mid-run and
+    recorded as a SKIPPED row; the section keeps going and returns its
+    matrix instead of dying with the driver's rc=124."""
+    bench._run_quorum_cell = lambda row, engine, timeout_s: \
+        {"preempted": 9.9}
+    matrix = bench.bench_quorum(time_left_fn=lambda: 10_000.0,
+                                budget_s=700.0)
+    assert matrix["tier1_contraction_s"] == \
+        "SKIPPED(budget, pre-empted after 9.9s)"
+    assert matrix["asym7_tpu_s"] == "SKIPPED(budget, pre-empted after 9.9s)"
+    assert "quorum_matrix_spent_s" in matrix
+
+
+def test_bench_quorum_cell_failure_is_a_row_not_a_crash(bench):
+    """A cell subprocess that dies (tunnel crash inside the child) becomes
+    a FAILED row; the matrix and the final JSON line still happen."""
+    bench._run_quorum_cell = lambda row, engine, timeout_s: \
+        {"failed": 1, "detail": "boom"}
+    matrix = bench.bench_quorum(time_left_fn=lambda: 10_000.0,
+                                budget_s=700.0)
+    assert matrix["rings16_py_s"] == "FAILED(rc=1)"
+
+
+def test_merge_last_good_preserves_measured_rows(bench):
+    """A SKIPPED/FAILED row must not cache OVER a previously measured
+    number — run A's asym7 measurement survives run B's pre-emption and
+    is what a later degraded run stale-fills."""
+    bench._cache_put("quorum", {"asym7_tpu_s": 255.0, "rings16_py_s": 0.2})
+    merged = bench._merge_last_good("quorum", {
+        "asym7_tpu_s": "SKIPPED(budget, pre-empted after 240s)",
+        "rings16_py_s": 0.21,
+        "asym6_c_s": "FAILED(rc=1)",
+        "asym6_py_s": "SKIPPED(~180s, over per-row budget)",
+    })
+    assert merged["asym7_tpu_s"] == 255.0        # marker did not clobber
+    assert merged["rings16_py_s"] == 0.21        # fresh number wins
+    assert merged["asym6_c_s"] == "FAILED(rc=1)"  # nothing cached to keep
+    assert merged["asym6_py_s"].startswith("SKIPPED")
+    # provenance: the restored row carries the timestamp of the run that
+    # MEASURED it (the section measured_at gets re-stamped on _cache_put)
+    with open(bench.CACHE_PATH) as f:
+        first_ts = json.load(f)["quorum"]["measured_at"]
+    assert merged["restored_rows"] == {"asym7_tpu_s": first_ts}
+    # ...and it chains: a third run restoring the same row keeps the
+    # ORIGINAL timestamp, not the second run's
+    bench._cache_put("quorum", merged)
+    merged2 = bench._merge_last_good("quorum", {
+        "asym7_tpu_s": "SKIPPED(budget)", "rings16_py_s": 0.22})
+    assert merged2["asym7_tpu_s"] == 255.0
+    assert merged2["restored_rows"] == {"asym7_tpu_s": first_ts}

@@ -1,0 +1,434 @@
+"""Range-parallel catchup (ISSUE 10 tentpole): N concurrent checkpoint
+ranges, each seeded by `catchup_minimal` assume-state at an interior
+boundary and replayed with full verification, stitched by proving range
+k's final ledger hash equals range k+1's seed header hash.
+
+Covers: the plan (contiguous, boundary-seeded, balanced), the in-process
+range body, real-subprocess orchestration (hash identity with the
+single-stream replay + worker logs + metrics), the per-range
+retry-with-backoff, and the fail-stop discipline — a tampered interior
+range (corrupted bucket in the assumed HAS, or a forged stitch record)
+must kill the whole catchup with a crash bundle naming the boundary and
+leave the node's authoritative ledger dir untouched.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from stellar_core_tpu.catchup.catchup import CatchupError, CatchupManager
+from stellar_core_tpu.catchup.parallel import (ParallelCatchup, RangeSpec,
+                                               RangeWork,
+                                               plan_parallel_ranges,
+                                               run_range, verify_stitches)
+from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
+                                              FileHistoryArchive,
+                                              bucket_path, category_path)
+from stellar_core_tpu.history.manager import HistoryManager
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.simulation.loadgen import LoadGenerator
+from stellar_core_tpu.testutils import network_id
+from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+from stellar_core_tpu.util.metrics import registry
+from stellar_core_tpu.util.process import ProcessManager
+
+PASSPHRASE = "parallel catchup test network"
+NID = network_id(PASSPHRASE)
+
+
+@pytest.fixture(scope="module")
+def published(tmp_path_factory):
+    """A 4-checkpoint archive with payment traffic in every checkpoint."""
+    archive_dir = tmp_path_factory.mktemp("par-archive")
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(str(archive_dir))
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=11)
+    gen.create_accounts(12, per_ledger=6)
+    gen.run_checkpoints(4, txs_per_ledger=2)
+    assert len(history.published_checkpoints) >= 4
+    return str(archive_dir), archive, mgr, history
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def test_plan_single_worker_is_one_genesis_range():
+    specs = plan_parallel_ranges(255, 1)
+    assert specs == [RangeSpec(index=0, seed_checkpoint=None, replay_to=255)]
+
+
+def test_plan_ranges_contiguous_and_boundary_seeded():
+    specs = plan_parallel_ranges(1000, 4)
+    assert len(specs) == 4
+    assert specs[0].seed_checkpoint is None
+    for a, b in zip(specs, specs[1:]):
+        # every seam sits on the previous range's final checkpoint ledger
+        assert b.seed_checkpoint == a.replay_to
+        assert (b.seed_checkpoint + 1) % CHECKPOINT_FREQUENCY == 0
+    assert specs[-1].replay_to == 1000
+    # balanced to within one checkpoint
+    sizes = [(s.replay_to - (s.seed_checkpoint or 0))
+             // CHECKPOINT_FREQUENCY for s in specs[:-1]]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_plan_more_workers_than_checkpoints_caps_ranges():
+    # 130 → checkpoints 63, 127, 191: at most 3 ranges regardless of workers
+    specs = plan_parallel_ranges(130, 16)
+    assert len(specs) == 3
+    assert [s.replay_to for s in specs] == [63, 127, 130]
+
+
+def test_plan_tiny_target_degenerates():
+    specs = plan_parallel_ranges(40, 8)
+    assert specs == [RangeSpec(index=0, seed_checkpoint=None, replay_to=40)]
+    with pytest.raises(CatchupError):
+        plan_parallel_ranges(1, 2)
+    with pytest.raises(CatchupError):
+        plan_parallel_ranges(100, 0)
+
+
+def test_plan_covers_every_ledger_once():
+    specs = plan_parallel_ranges(700, 5)
+    covered = []
+    for s in specs:
+        covered.extend(range(s.replay_from, s.replay_to + 1))
+    assert covered == list(range(2, 701))
+
+
+# ---------------------------------------------------------------------------
+# the range body (in-process)
+# ---------------------------------------------------------------------------
+
+def test_run_range_interior_seed_hash_matches_archive(published, tmp_path):
+    """Worker k's seed hash is the assumed checkpoint's header hash, and
+    its replay reproduces the archive's own per-ledger hashes."""
+    archive_dir, archive, mgr, history = published
+    cps = history.published_checkpoints
+    seed_cp, end_cp = cps[1], cps[2]
+    spec = RangeSpec(index=1, seed_checkpoint=seed_cp, replay_to=end_cp)
+    result = run_range(archive, spec, NID, PASSPHRASE,
+                       bucket_dir=str(tmp_path / "bldb"))
+    from stellar_core_tpu.catchup.catchup import _LHHE
+    seed_tail = _LHHE.unpack(archive.get_xdr_file(
+        category_path("ledger", seed_cp))[-1])
+    end_tail = _LHHE.unpack(archive.get_xdr_file(
+        category_path("ledger", end_cp))[-1])
+    assert result["seed_header_hash"] == seed_tail.hash.hex()
+    assert result["final_hash"] == end_tail.hash.hex()
+    assert result["final_ledger_seq"] == end_cp
+    assert result["ledgers_replayed"] == end_cp - seed_cp
+
+
+def test_catchup_range_genesis_equals_complete(published):
+    archive_dir, archive, mgr, history = published
+    cm = CatchupManager(NID, PASSPHRASE)
+    replayed, seed_hash = cm.catchup_range(
+        archive, None, history.published_checkpoints[0])
+    assert seed_hash is None
+    assert replayed.last_closed_ledger_seq == \
+        history.published_checkpoints[0]
+
+
+# ---------------------------------------------------------------------------
+# stitch proof
+# ---------------------------------------------------------------------------
+
+def _fake_results(n=3):
+    out = []
+    prev_hash = None
+    for k in range(n):
+        out.append({
+            "index": k,
+            "seed_checkpoint": None if k == 0 else 63 + 64 * (k - 1),
+            "seed_header_hash": prev_hash,
+            "replay_to": 63 + 64 * k,
+            "final_ledger_seq": 63 + 64 * k,
+            "final_hash": f"{k:064x}",
+            "ledgers_replayed": 64,
+        })
+        prev_hash = f"{k:064x}"
+    return out
+
+
+def test_verify_stitches_counts_boundaries(tmp_path):
+    before = registry().counter("catchup.parallel.stitch-verified").value
+    assert verify_stitches(_fake_results(3)) == 2
+    after = registry().counter("catchup.parallel.stitch-verified").value
+    assert after - before == 2
+
+
+def test_verify_stitches_hash_mismatch_failstops_with_bundle(tmp_path):
+    results = _fake_results(3)
+    results[2]["seed_header_hash"] = "f" * 64   # forged seed header
+    crash_dir = tmp_path / "crash"
+    with pytest.raises(CatchupError, match="boundary 127"):
+        verify_stitches(results, crash_dir=str(crash_dir))
+    bundles = list(crash_dir.glob("flight-*.json"))
+    assert bundles, "stitch mismatch must write a crash bundle"
+    doc = json.loads(bundles[0].read_text())
+    assert "127" in doc["reason"] and "stitch" in doc["reason"]
+
+
+def test_verify_stitches_seq_gap_failstops(tmp_path):
+    results = _fake_results(3)
+    results[1]["final_ledger_seq"] = 130        # not the next range's seed
+    with pytest.raises(CatchupError, match="seeded"):
+        verify_stitches(results)
+
+
+# ---------------------------------------------------------------------------
+# orchestration over real subprocess workers
+# ---------------------------------------------------------------------------
+
+def test_parallel_equals_single_stream(published, tmp_path):
+    """THE acceptance invariant: N-range parallel catchup produces the
+    bit-identical final ledger hash of the single-stream replay, with
+    every boundary's stitch asserted, interior dirs GC'd and the last
+    range's state adoptable."""
+    archive_dir, archive, mgr, history = published
+    single = CatchupManager(NID, PASSPHRASE).catchup_complete(archive)
+
+    stitch_before = registry().counter(
+        "catchup.parallel.stitch-verified").value
+    pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=3,
+                         workdir=str(tmp_path / "work"))
+    report = pc.run()
+    assert report["final_hash"] == single.lcl_hash.hex() == mgr.lcl_hash.hex()
+    assert report["stitches_verified"] == len(report["ranges"]) - 1 >= 1
+    assert registry().counter("catchup.parallel.stitch-verified").value \
+        - stitch_before == report["stitches_verified"]
+    # per-range stitch records chain seed->final
+    for a, b in zip(report["ranges"], report["ranges"][1:]):
+        assert a["final_hash"] == b["seed_header_hash"]
+    # interior throwaway dirs GC'd; the final (adopted) range dir survives
+    dirs = sorted(os.listdir(tmp_path / "work"))
+    assert dirs == [f"range-{len(report['ranges']) - 1:02d}"]
+    # worker log captured through the ProcessManager output redirection
+    log_path = (tmp_path / "work" / dirs[0] / "worker.log")
+    assert log_path.exists() and log_path.stat().st_size > 0
+    # adoption: the loaded manager IS the replayed ledger
+    m2 = pc.load_manager()
+    assert m2.lcl_hash == single.lcl_hash
+    assert m2.root.entry_count() == single.root.entry_count()
+
+
+def test_parallel_single_worker_degenerate(published, tmp_path):
+    archive_dir, archive, mgr, history = published
+    pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=1,
+                         workdir=str(tmp_path / "w1"))
+    report = pc.run()
+    assert report["final_hash"] == mgr.lcl_hash.hex()
+    assert report["stitches_verified"] == 0
+
+
+def test_worker_cli_writes_result(published, tmp_path):
+    archive_dir, archive, mgr, history = published
+    cps = history.published_checkpoints
+    result_path = tmp_path / "result.json"
+    r = __import__("subprocess").run(
+        [sys.executable, "-m", "stellar_core_tpu", "catchup-range",
+         "--archive", archive_dir, "--passphrase", PASSPHRASE,
+         "--to", str(cps[1]), "--seed-checkpoint", str(cps[0]),
+         "--workdir", str(tmp_path / "wd"), "--result", str(result_path),
+         "--index", "1"],
+        capture_output=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-1500:]
+    doc = json.loads(result_path.read_text())
+    assert doc["final_ledger_seq"] == cps[1]
+    assert doc["seed_checkpoint"] == cps[0]
+    assert len(doc["final_hash"]) == 64
+
+
+def test_worker_cli_failure_writes_error_record(tmp_path):
+    """A worker pointed at a dead archive exits non-zero AND leaves a JSON
+    error record — the orchestrator's retry loop reads it for diagnosis."""
+    result_path = tmp_path / "result.json"
+    r = __import__("subprocess").run(
+        [sys.executable, "-m", "stellar_core_tpu", "catchup-range",
+         "--archive", str(tmp_path / "no-such-archive"),
+         "--passphrase", PASSPHRASE, "--to", "127",
+         "--seed-checkpoint", "63",
+         "--workdir", str(tmp_path / "wd"), "--result", str(result_path)],
+        capture_output=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 1
+    assert "error" in json.loads(result_path.read_text())
+
+
+def test_range_work_retries_with_backoff(tmp_path):
+    """A transiently failing worker retries through the Work framework's
+    truncated-exponential backoff (the single-stream download's machinery)
+    and succeeds once the fault clears."""
+    marker = tmp_path / "attempted-once"
+    result_path = tmp_path / "result.json"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "marker, result = sys.argv[1], sys.argv[2]\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close()\n"
+        "    sys.exit(7)   # first attempt: transient archive corruption\n"
+        "json.dump({'index': 0, 'seed_checkpoint': None,\n"
+        "           'seed_header_hash': None, 'replay_to': 63,\n"
+        "           'final_ledger_seq': 63, 'final_hash': 'aa' * 32,\n"
+        "           'ledgers_replayed': 62, 'ledgers_per_s': 100.0},\n"
+        "          open(result, 'w'))\n")
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    pm = ProcessManager(clock, max_concurrent=2)
+    retry_before = registry().counter("catchup.parallel.range-retry").value
+    # torn state from the "crashed" first attempt: the retry must start
+    # from a pristine range dir (result_path lives OUTSIDE it here, so
+    # the wipe provably targets the workdir, not just result.json)
+    workdir = tmp_path / "range-00"
+    workdir.mkdir()
+    (workdir / "state.db").write_bytes(b"torn half-written db")
+    w = RangeWork(clock, pm,
+                  f"{sys.executable} {script} {marker} {result_path}",
+                  str(result_path),
+                  RangeSpec(index=0, seed_checkpoint=None, replay_to=63),
+                  log_path=str(tmp_path / "w.log"),
+                  workdir=str(workdir), max_retries=3)
+    w.start()
+    deadline = time.monotonic() + 60
+    while not w.done and time.monotonic() < deadline:
+        if clock.crank() == 0:
+            time.sleep(0.01)
+    pm.shutdown()
+    assert w.succeeded
+    assert w.retries == 1
+    assert w.result["final_hash"] == "aa" * 32
+    assert registry().counter("catchup.parallel.range-retry").value \
+        - retry_before == 1
+    # the torn first-attempt state was wiped before the retry ran
+    assert not (workdir / "state.db").exists()
+
+
+# ---------------------------------------------------------------------------
+# fail-stop: tampered interior range (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+def _copy_archive(src: str, dst: str) -> None:
+    import shutil
+    shutil.copytree(src, dst)
+
+
+def test_tampered_interior_bucket_failstops_whole_catchup(published,
+                                                          tmp_path):
+    """Corrupt one bucket referenced by an interior seed checkpoint's HAS:
+    that range's assume-state must fail (hash verification), retries must
+    exhaust, the WHOLE parallel catchup must fail-stop with a crash
+    bundle, and the node's authoritative ledger dir must stay untouched."""
+    archive_dir, archive, mgr, history = published
+    evil_dir = str(tmp_path / "evil-archive")
+    _copy_archive(archive_dir, evil_dir)
+    # the interior boundary range 1 seeds from
+    seed_cp = plan_parallel_ranges(
+        mgr.last_closed_ledger_seq, 3)[1].seed_checkpoint
+    evil = FileHistoryArchive(evil_dir)
+    has = evil.get_state(seed_cp)
+    victim = next(h for h in has.bucket_hashes() if h != "0" * 64)
+    victim_path = os.path.join(evil_dir, bucket_path(victim))
+    with open(victim_path, "wb") as f:
+        f.write(b"not a gzip bucket at all")
+
+    # a pre-existing authoritative ledger dir that must survive the abort
+    auth_db = tmp_path / "node" / "state.db"
+    auth_db.parent.mkdir()
+    auth_db.write_bytes(b"previous ledger state")
+
+    crash_dir = tmp_path / "crash"
+    pc = ParallelCatchup(evil_dir, PASSPHRASE, workers=3,
+                         workdir=str(tmp_path / "work"),
+                         max_retries=1, crash_dir=str(crash_dir))
+    with pytest.raises(CatchupError, match="range 1"):
+        pc.run()
+    bundles = list(crash_dir.glob("flight-*.json"))
+    assert bundles, "range failure must write a crash bundle"
+    assert "range" in json.loads(bundles[0].read_text())["reason"]
+    # adoption is unreachable after a fail-stop...
+    with pytest.raises(CatchupError):
+        pc.load_manager()
+    with pytest.raises(CatchupError):
+        pc.adopt_into(str(auth_db), str(tmp_path / "node" / "buckets"))
+    # ...and the authoritative dir is bit-identical untouched
+    assert auth_db.read_bytes() == b"previous ledger state"
+
+
+def test_tampered_headers_break_range_not_others(published, tmp_path):
+    """A corrupted ledger-header file inside one range's checkpoints kills
+    the catchup (after retries) without poisoning other ranges' results."""
+    archive_dir, archive, mgr, history = published
+    evil_dir = str(tmp_path / "evil2")
+    _copy_archive(archive_dir, evil_dir)
+    specs = plan_parallel_ranges(mgr.last_closed_ledger_seq, 3)
+    # corrupt the LAST range's first checkpoint ledger file
+    cp = specs[2].seed_checkpoint + CHECKPOINT_FREQUENCY
+    path = os.path.join(evil_dir, category_path("ledger", cp))
+    with open(path, "wb") as f:
+        f.write(b"\x1f\x8b garbage")
+    pc = ParallelCatchup(evil_dir, PASSPHRASE, workers=3,
+                         workdir=str(tmp_path / "work2"), max_retries=1)
+    with pytest.raises(CatchupError, match="range 2"):
+        pc.run()
+
+
+def test_invariant_checks_reach_every_worker(published, tmp_path):
+    """Configured INVARIANT_CHECKS must not be silently dropped by the
+    parallel path: patterns travel to each worker's command line, and the
+    worker builds a real InvariantManager (forcing the Python apply path,
+    exactly like the single stream)."""
+    archive_dir, archive, mgr, history = published
+    pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=3,
+                         workdir=str(tmp_path / "w"),
+                         invariant_checks=["ConservationOfLumens"])
+    pc._specs = plan_parallel_ranges(mgr.last_closed_ledger_seq, 3)
+    for spec in pc._specs:
+        assert "--invariant ConservationOfLumens" in \
+            pc._worker_cmdline(spec)
+    # and the range body honors it end to end (in-process, one range)
+    from stellar_core_tpu.invariant.invariants import InvariantManager
+    inv = InvariantManager.from_patterns(["ConservationOfLumens"])
+    spec = pc._specs[1]
+    result = run_range(archive, spec, NID, PASSPHRASE,
+                       invariant_manager=inv,
+                       bucket_dir=str(tmp_path / "bldb"))
+    assert result["final_ledger_seq"] == spec.replay_to
+
+
+def test_config_workers_do_not_break_minimal_mode(published, tmp_path):
+    """CATCHUP_PARALLEL_WORKERS in node.cfg must not reject --mode
+    minimal / --count commands that were valid before the key existed —
+    only an EXPLICIT --parallel > 1 conflicts with them."""
+    archive_dir, archive, mgr, history = published
+    conf = tmp_path / "node.cfg"
+    conf.write_text(f'NETWORK_PASSPHRASE = "{PASSPHRASE}"\n'
+                    'CATCHUP_PARALLEL_WORKERS = 4\n')
+    from stellar_core_tpu.main.commandline import main as cli_main
+    assert cli_main(["catchup", "--conf", str(conf),
+                     "--archive", archive_dir, "--mode", "minimal"]) == 0
+    assert cli_main(["catchup", "--conf", str(conf),
+                     "--archive", archive_dir, "--mode", "minimal",
+                     "--parallel", "2"]) == 1
+
+
+def test_storage_knobs_reach_worker_cmdline(tmp_path):
+    """IN_MEMORY_LEDGER / BUCKETLISTDB_ENTRY_CACHE_SIZE /
+    BUCKET_RESIDENT_LEVELS travel to each worker — the node's memory
+    bounds matter most when N workers share the box."""
+    pc = ParallelCatchup(str(tmp_path / "a"), PASSPHRASE, workers=2,
+                         workdir=str(tmp_path / "w"),
+                         in_memory=True, entry_cache_size=123,
+                         resident_levels=3)
+    pc._specs = plan_parallel_ranges(255, 2)
+    cmd = pc._worker_cmdline(pc._specs[1])
+    assert "--in-memory" in cmd
+    assert "--entry-cache-size 123" in cmd
+    assert "--resident-levels 3" in cmd
