@@ -1,5 +1,5 @@
 .PHONY: native test lint race metrics obs bucketdb bucketdb-slow chaos \
-	chaos-soak loadgen loadgen-slow catchup-par clean
+	chaos-soak loadgen loadgen-slow catchup-par fleet fleet-soak clean
 
 native:
 	python setup.py build_ext --inplace
@@ -88,6 +88,19 @@ loadgen-slow:
 catchup-par:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_catchup_parallel.py \
 		-q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# fleet harness suite (ISSUE 11): provisioning/schedule/SLO units plus
+# the 5-node real-process TCP soak — kill + `catchup --parallel` rejoin,
+# overlay partition + heal, rolling config change, zero hash divergence,
+# SLOs asserted.  `fleet-soak` adds the -m slow long campaign (overload
+# burst + extended partition).
+fleet:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+fleet-soak:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must
 # match layer.subsystem.event and appear in the documented canonical list

@@ -26,9 +26,9 @@ from ..bucket.bucket_list import NUM_LEVELS
 from ..crypto import keys
 from ..crypto.sha import sha256
 from ..history.archive import (CATEGORY_LEDGER, CATEGORY_RESULTS,
-                               CATEGORY_TRANSACTIONS, CHECKPOINT_FREQUENCY,
-                               FileHistoryArchive, category_path,
-                               checkpoint_containing,
+                               CATEGORY_TRANSACTIONS, FileHistoryArchive,
+                               category_path, checkpoint_containing,
+                               checkpoint_frequency,
                                first_ledger_in_checkpoint)
 from ..ledger.manager import LedgerManager
 from ..transactions.frame import TransactionFrame
@@ -618,12 +618,11 @@ def plan_catchup_range(target: int, count: Optional[int]) -> CatchupRange:
     >= `count` ledgers to replay before `target` (reference:
     CatchupRange's 'replayed range covers count, buckets cover the rest').
     count=None (CATCHUP_COMPLETE) replays everything from genesis."""
-    from ..history.archive import CHECKPOINT_FREQUENCY
-    first_boundary = CHECKPOINT_FREQUENCY - 1   # 63
+    freq = checkpoint_frequency()
+    first_boundary = freq - 1   # 63 at the default cadence
     if count is None or target - count < first_boundary:
         return CatchupRange(apply_buckets_at=None, replay_to=target)
-    boundary = ((target - count + 1) // CHECKPOINT_FREQUENCY
-                ) * CHECKPOINT_FREQUENCY - 1
+    boundary = ((target - count + 1) // freq) * freq - 1
     if boundary < first_boundary:
         return CatchupRange(apply_buckets_at=None, replay_to=target)
     return CatchupRange(apply_buckets_at=boundary, replay_to=target)

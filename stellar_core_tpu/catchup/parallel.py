@@ -42,7 +42,7 @@ import time as _time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from ..history.archive import (CHECKPOINT_FREQUENCY, checkpoint_containing,
+from ..history.archive import (checkpoint_containing, checkpoint_frequency,
                                make_archive)
 from ..util import eventlog
 from ..util import logging as slog
@@ -89,9 +89,9 @@ def plan_parallel_ranges(target: int, workers: int) -> List[RangeSpec]:
         raise CatchupError(f"nothing to replay to ledger {target}")
     if workers < 1:
         raise CatchupError(f"workers must be >= 1, got {workers}")
+    freq = checkpoint_frequency()
     last_cp = checkpoint_containing(target)
-    boundaries = list(range(CHECKPOINT_FREQUENCY - 1, last_cp + 1,
-                            CHECKPOINT_FREQUENCY))
+    boundaries = list(range(freq - 1, last_cp + 1, freq))
     n = max(1, min(workers, len(boundaries)))
     base, rem = divmod(len(boundaries), n)
     specs: List[RangeSpec] = []
@@ -401,6 +401,10 @@ class ParallelCatchup:
             args += ["--entry-cache-size", str(self.entry_cache_size)]
         if self.resident_levels is not None:
             args += ["--resident-levels", str(self.resident_levels)]
+        if checkpoint_frequency() != 64:
+            # non-default cadence (accelerated test fleets) must reach the
+            # worker process or its range plan/seam math disagrees with ours
+            args += ["--checkpoint-frequency", str(checkpoint_frequency())]
         return " ".join(shlex.quote(a) for a in args)
 
     # -- driving -----------------------------------------------------------

@@ -27,6 +27,28 @@ from ..bucket.bucket import Bucket
 CHECKPOINT_FREQUENCY = 64
 HAS_CURRENT_VERSION = 1
 
+
+def checkpoint_frequency() -> int:
+    """The process-wide checkpoint cadence.  64 on real networks; test
+    fleets shrink it (reference: HistoryManager::getCheckpointFrequency
+    returns 8 under ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING) so archives
+    publish — and rejoining nodes can catch up — within seconds.  Callers
+    that do checkpoint arithmetic must read it through this accessor (or
+    the helpers below), never bind the constant at import time."""
+    return CHECKPOINT_FREQUENCY
+
+
+def set_checkpoint_frequency(n: int) -> None:
+    """Set the process-wide checkpoint cadence.  Every node of a network
+    and every catchup worker replaying its archives must agree on this
+    number — it is part of the archive format, which is why it travels in
+    node configs (Config.CHECKPOINT_FREQUENCY) and in the catchup-range
+    worker command line rather than being flipped ad hoc."""
+    global CHECKPOINT_FREQUENCY
+    if n < 2:
+        raise ValueError(f"checkpoint frequency must be >= 2, got {n}")
+    CHECKPOINT_FREQUENCY = n
+
 CATEGORY_LEDGER = "ledger"
 CATEGORY_TRANSACTIONS = "transactions"
 CATEGORY_RESULTS = "results"
@@ -364,13 +386,45 @@ class FileHistoryArchive(HistoryArchiveBase):
     def _full(self, rel: str) -> str:
         return os.path.join(self.root, rel)
 
+    # a .tmp.<pid> this old is litter from a publisher that died
+    # mid-write (fleet kills do this by design).  The window is an hour:
+    # generous enough that even a pathologically descheduled live writer
+    # has long since replaced its tmp, and the retry below makes an
+    # over-eager reap a rewrite, never a crash.
+    STALE_TMP_S = 3600.0
+
     def put_bytes(self, rel: str, data: bytes) -> None:
         path = self._full(rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        # per-process tmp name: two node processes publishing the same
+        # object (a shared fleet archive) must not interleave writes into
+        # one tmp file — each writes its own and the os.replace is atomic,
+        # so readers only ever see a complete object
+        tmp = f"{path}.tmp.{os.getpid()}"
+        for attempt in range(2):
+            with open(tmp, "wb") as f:
+                f.write(data)
+            try:
+                os.replace(tmp, path)
+                break
+            except FileNotFoundError:
+                # another publisher's reaper mistook our tmp for litter
+                # (clock skew / extreme descheduling): rewrite once
+                if attempt:
+                    raise
+        # self-heal: a publisher SIGKILLed between open and replace left
+        # its tmp behind; reap aged ones so a long-lived shared archive
+        # doesn't accumulate torn litter across soaks
+        import glob
+        from ..util.clock import wall_now
+        for stale in glob.glob(path + ".tmp.*"):
+            if stale == tmp:
+                continue
+            try:
+                if wall_now() - os.path.getmtime(stale) > self.STALE_TMP_S:
+                    os.unlink(stale)
+            except OSError:
+                pass
 
     def get_bytes(self, rel: str) -> Optional[bytes]:
         try:

@@ -14,9 +14,8 @@ from .. import xdr as X
 from ..ledger.manager import ClosedLedgerArtifacts, LedgerManager
 from ..util import logging as slog
 from .archive import (CATEGORY_LEDGER, CATEGORY_RESULTS, CATEGORY_TRANSACTIONS,
-                      CHECKPOINT_FREQUENCY, FileHistoryArchive,
-                      HistoryArchiveState, category_path,
-                      is_checkpoint_boundary)
+                      FileHistoryArchive, HistoryArchiveState, category_path,
+                      checkpoint_frequency, is_checkpoint_boundary)
 
 log = slog.get("History")
 
@@ -57,7 +56,7 @@ class HistoryManager:
     def _artifacts_from_db(self, checkpoint_seq: int):
         """Rebuild the checkpoint's streams from durable state (survives a
         crash that wiped the in-memory pending list)."""
-        lo = max(2, checkpoint_seq - CHECKPOINT_FREQUENCY + 1)
+        lo = max(2, checkpoint_seq - checkpoint_frequency() + 1)
         headers, txs, results = [], [], []
         for seq in range(lo, checkpoint_seq + 1):
             got = self.db.load_header_by_seq(seq)
@@ -114,7 +113,7 @@ class HistoryManager:
             self.db.dequeue_publish(checkpoint_seq)
             # retain two checkpoint windows of artifacts + headers (the
             # reference's maintenance keeps a sliding window too)
-            keep_from = checkpoint_seq - 2 * CHECKPOINT_FREQUENCY
+            keep_from = checkpoint_seq - 2 * checkpoint_frequency()
             self.db.prune_tx_history(keep_from)
             self.db.delete_old_headers(keep_from)
             self.db.commit()

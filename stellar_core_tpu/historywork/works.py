@@ -31,8 +31,8 @@ from ..catchup.catchup import (CatchupError, PreverifyPipeline,
                                verify_ledger_chain)
 from ..crypto.sha import sha256
 from ..history.archive import (CATEGORY_LEDGER, CATEGORY_TRANSACTIONS,
-                               CHECKPOINT_FREQUENCY, FileHistoryArchive,
-                               category_path, checkpoint_containing)
+                               FileHistoryArchive, category_path,
+                               checkpoint_containing, checkpoint_frequency)
 from ..transactions.frame import TransactionFrame
 import time
 
@@ -454,10 +454,10 @@ class CatchupWork(Work):
             if dl is None or not dl.done or dl.failed:
                 break
             ready.append(c)
-            c += CHECKPOINT_FREQUENCY
+            c += checkpoint_frequency()
         if not ready:
             return
-        urgent = ready[0] <= self._apply_checkpoint + CHECKPOINT_FREQUENCY
+        urgent = ready[0] <= self._apply_checkpoint + checkpoint_frequency()
         if not urgent and len(ready) < self.coalesce:
             return
         # collect() blocks on a whole group's batch, so the group about to
@@ -482,7 +482,7 @@ class CatchupWork(Work):
                 self.pipeline.dispatch(
                     {cp: self._downloads[cp].all_frames() for cp in g},
                     ledger_state=self.mgr.root)
-        self._next_dispatch = ready[-1] + CHECKPOINT_FREQUENCY
+        self._next_dispatch = ready[-1] + checkpoint_frequency()
 
     def on_run(self) -> State:
         if self.mgr.last_closed_ledger_seq >= self.target:
@@ -492,7 +492,7 @@ class CatchupWork(Work):
         cp = self._apply_checkpoint
         last_cp = checkpoint_containing(self.target)
         for k in range(self.lookahead):
-            c = cp + k * CHECKPOINT_FREQUENCY
+            c = cp + k * checkpoint_frequency()
             if c > last_cp:
                 break
             if c not in self._downloads:
@@ -537,7 +537,7 @@ class CatchupWork(Work):
             self._prev_tail = dl.headers[-1]
         del self._downloads[cp]
         self._apply = None
-        self._apply_checkpoint = cp + CHECKPOINT_FREQUENCY
+        self._apply_checkpoint = cp + checkpoint_frequency()
         if self.mgr.last_closed_ledger_seq >= self.target:
             self._close_pipeline()
             return State.SUCCESS

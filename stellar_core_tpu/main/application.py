@@ -73,6 +73,7 @@ class Application:
                  clock: Optional[VirtualClock] = None,
                  listen: bool = True):
         self.config = config
+        config.apply_process_globals()
         self.clock = clock or VirtualClock(ClockMode.REAL_TIME)
         self.network_id = config.network_id()
         self.node_secret = config.node_secret()

@@ -4,8 +4,8 @@ Subcommands: run / new-db / new-hist / catchup / publish /
 check-quorum-intersection / self-check / verify-checkpoints /
 report-last-history-checkpoint / offline-info / print-xdr / dump-xdr /
 dump-ledger / encode-asset / sign-transaction / convert-id / http-command /
-health / fuzz / gen-fuzz / apply-load / test / sec-to-pub / gen-seed /
-version.
+health / fleet / fuzz / gen-fuzz / apply-load / test / sec-to-pub /
+gen-seed / version.
 """
 
 from __future__ import annotations
@@ -18,7 +18,9 @@ from .config import Config
 
 
 def _load_config(args) -> Config:
-    return Config.from_toml(args.conf)
+    cfg = Config.from_toml(args.conf)
+    cfg.apply_process_globals()
+    return cfg
 
 
 def cmd_version(args) -> int:
@@ -231,8 +233,13 @@ def cmd_catchup_range(args) -> int:
     from ..catchup.catchup import CatchupError
     from ..catchup.parallel import RangeSpec, run_range
     from ..crypto.sha import sha256
-    from ..history.archive import make_archive
+    from ..history.archive import make_archive, set_checkpoint_frequency
 
+    if args.checkpoint_frequency:
+        # the orchestrator's cadence is part of the archive format; a
+        # worker planning seams at the default 64 against an accelerated
+        # fleet's archive would mis-stitch every boundary
+        set_checkpoint_frequency(args.checkpoint_frequency)
     archive = make_archive(args.archive)
     seed = (None if args.seed_checkpoint in ("", "genesis")
             else int(args.seed_checkpoint))
@@ -597,21 +604,34 @@ def cmd_http_command(args) -> int:
 def cmd_health(args) -> int:
     """Probe a running node's /health; exit 0 when ok, 1 when degraded
     or unreachable — the CLI form of the load-balancer probe (wire it
-    into systemd watchdogs / container healthchecks)."""
+    into systemd watchdogs / container healthchecks).
+
+    ``--retries N --interval S`` turns the one-shot probe into a
+    poll-to-readiness loop: up to N re-probes, S seconds apart, exiting 0
+    the first time the node answers healthy.  This is how the fleet
+    harness (and an operator's deploy script) waits for a booting or
+    rejoining node instead of hand-rolling sleep loops."""
+    import time as _t
     import urllib.error
     import urllib.request
     cfg = _load_config(args)
     url = f"http://127.0.0.1:{cfg.HTTP_PORT}/health"
-    try:
-        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
-            body = resp.read().decode()
-            code = resp.status
-    except urllib.error.HTTPError as e:
-        body = e.read().decode()
-        code = e.code
-    except (urllib.error.URLError, OSError) as e:
-        print(json.dumps({"status": "unreachable", "detail": str(e)}))
-        return 1
+    body, code = "", 0
+    for attempt in range(args.retries + 1):
+        if attempt:
+            _t.sleep(args.interval)
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                body = resp.read().decode()
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            code = e.code
+        except (urllib.error.URLError, OSError) as e:
+            body = json.dumps({"status": "unreachable", "detail": str(e)})
+            code = 0
+        if code == 200:
+            break
     print(body)
     return 0 if code == 200 else 1
 
@@ -666,6 +686,39 @@ def cmd_apply_load(args) -> int:
     report = al.run(n_ledgers=args.ledgers, txs_per_ledger=args.txs)
     print(json.dumps(report, indent=1))
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """Multi-process TCP network soak (simulation/fleet.py): provision N
+    real nodes, drive surge-priced traffic through real /tx, execute the
+    production-event schedule (kill + `catchup --parallel` rejoin,
+    partition + heal, rolling config change) and assert the SLOs.
+    Prints the fleet report as one JSON document; exit 0 only when every
+    SLO held.  ``--schedule`` takes a JSON event file (see README §Fleet
+    soak for the format); without it the standard acceptance script
+    runs."""
+    import tempfile
+    from ..simulation.fleet import (FleetSLOs, run_fleet_soak,
+                                    standard_schedule)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet-")
+    schedule = None
+    if args.schedule:
+        with open(args.schedule) as f:
+            schedule = json.load(f)
+        if args.traffic != 25.0:
+            # an explicit schedule owns its own `traffic` events
+            print("note: --traffic is ignored with --schedule (the "
+                  "file's traffic events govern the offered rate)",
+                  file=sys.stderr)
+    slos = FleetSLOs()
+    if args.max_retracking_s is not None:
+        slos.max_retracking_s = args.max_retracking_s
+    report = run_fleet_soak(
+        workdir, n_nodes=args.nodes, schedule=schedule,
+        traffic_rate=args.traffic, n_accounts=args.accounts, slos=slos,
+        timeout_s=args.timeout)
+    print(json.dumps(report, indent=1))
+    return 0 if report["passed"] else 2
 
 
 def cmd_test(args) -> int:
@@ -740,6 +793,9 @@ def main(argv=None) -> int:
                    help="BUCKETLISTDB_ENTRY_CACHE_SIZE (0 = default)")
     s.add_argument("--resident-levels", type=int, default=-1,
                    help="BUCKET_RESIDENT_LEVELS (-1 = default)")
+    s.add_argument("--checkpoint-frequency", type=int, default=0,
+                   help="checkpoint cadence of the archive's network "
+                        "(0 = the default 64)")
     s.set_defaults(fn=cmd_catchup_range)
 
     s = sub.add_parser("publish", help="publish queued checkpoints")
@@ -824,6 +880,11 @@ def main(argv=None) -> int:
                        help="probe a running node's /health (exit 0=ok)")
     s.add_argument("--conf", required=True)
     s.add_argument("--timeout", type=float, default=5.0)
+    s.add_argument("--retries", type=int, default=0,
+                   help="re-probe up to N times until healthy (poll a "
+                        "booting node to readiness)")
+    s.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between probes with --retries")
     s.set_defaults(fn=cmd_health)
 
     s = sub.add_parser("fuzz", help="run a deterministic fuzz campaign")
@@ -845,6 +906,27 @@ def main(argv=None) -> int:
     s.add_argument("--ledgers", type=int, default=20)
     s.add_argument("--txs", type=int, default=200)
     s.set_defaults(fn=cmd_apply_load)
+
+    s = sub.add_parser("fleet",
+                       help="multi-process TCP network soak with SLO "
+                            "assertions")
+    s.add_argument("--nodes", type=int, default=5)
+    s.add_argument("--workdir", default="",
+                   help="artifact dir (default: fresh temp dir; holds "
+                        "per-node logs/configs + fleet-report.json)")
+    s.add_argument("--schedule", default="",
+                   help="JSON event-schedule file (default: the standard "
+                        "kill/rejoin + partition/heal + rolling-config "
+                        "script)")
+    s.add_argument("--traffic", type=float, default=25.0,
+                   help="offered tx/s across the fleet")
+    s.add_argument("--accounts", type=int, default=60,
+                   help="seed-derived traffic accounts")
+    s.add_argument("--timeout", type=float, default=600.0,
+                   help="hard wall-clock bound for the schedule")
+    s.add_argument("--max-retracking-s", type=float, default=None,
+                   help="SLO: kill -> tracking-again budget")
+    s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser("test", help="run the test suite (pytest)")
     s.add_argument("pytest_args", nargs="*")

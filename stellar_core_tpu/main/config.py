@@ -68,6 +68,12 @@ class Config:
 
     ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
     METADATA_OUTPUT_STREAM: str = ""         # path for LedgerCloseMeta frames
+    # Checkpoint cadence (reference: getCheckpointFrequency — 64 on real
+    # networks, 8 under ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING so test
+    # fleets publish archives within seconds).  0 = derive from the
+    # accelerate flag; any explicit value is part of the archive format
+    # and must match across the whole network.
+    CHECKPOINT_FREQUENCY: int = 0
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
@@ -102,6 +108,23 @@ class Config:
         # deterministic-from-passphrase dev key, like the reference's
         # standalone default
         return SecretKey(sha256(b"node seed " + self.network_id()))
+
+    def checkpoint_frequency(self) -> int:
+        """Effective checkpoint cadence (reference:
+        HistoryManager::getCheckpointFrequency): an explicit
+        CHECKPOINT_FREQUENCY wins, else 8 under the accelerate-time flag,
+        else the real-network 64."""
+        if self.CHECKPOINT_FREQUENCY:
+            return self.CHECKPOINT_FREQUENCY
+        return 8 if self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING else 64
+
+    def apply_process_globals(self) -> None:
+        """Install the config's process-wide knobs (today: the checkpoint
+        cadence).  Called by the CLI config loader and Application so every
+        code path that does checkpoint arithmetic — publishing, catchup,
+        maintenance — agrees with the network this config describes."""
+        from ..history.archive import set_checkpoint_frequency
+        set_checkpoint_frequency(self.checkpoint_frequency())
 
     def quorum_set(self) -> X.SCPQuorumSet:
         from ..crypto.keys import PublicKey
@@ -141,6 +164,7 @@ class Config:
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
             "ACCEL_CHUNK_SIZE", "CATCHUP_PARALLEL_WORKERS",
+            "CHECKPOINT_FREQUENCY",
             "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
             "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
             "ADMISSION_MAX_BACKLOG",

@@ -9,7 +9,7 @@ yet published must be retained.
 
 from __future__ import annotations
 
-from ..history.archive import CHECKPOINT_FREQUENCY
+from ..history.archive import checkpoint_frequency
 from ..util import logging as slog
 
 log = slog.get("Main")
@@ -51,7 +51,7 @@ class Maintainer:
         queued = [seq for seq, _ in app.database.publish_queue()]
         floor = min(queued) if queued else lcl
         keep_from = max(2, min(floor, lcl)
-                        - RETAIN_CHECKPOINTS * CHECKPOINT_FREQUENCY)
+                        - RETAIN_CHECKPOINTS * checkpoint_frequency())
         app.database.prune_scp(keep_from)
         app.database.prune_tx_history(keep_from)
         app.database.delete_old_headers(keep_from)

@@ -57,12 +57,12 @@ def self_check(lm, database=None, bucket_dir=None,
 
     # 5. archives are reachable and their HAS parses; before the first
     # checkpoint publish an empty archive is the expected state
-    from ..history.archive import CHECKPOINT_FREQUENCY
+    from ..history.archive import checkpoint_frequency
     for i, archive in enumerate(archives):
         try:
             has = archive.get_state()
             if has is None:
-                not_yet = lm.lcl_header.ledgerSeq < CHECKPOINT_FREQUENCY
+                not_yet = lm.lcl_header.ledgerSeq < checkpoint_frequency()
                 check(f"archive-{i}", not_yet,
                       "no HAS published yet" if not_yet
                       else "HAS missing after first checkpoint")

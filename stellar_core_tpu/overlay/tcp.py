@@ -121,10 +121,17 @@ class TCPTransport:
     def connect(self, host: str, port: int) -> TCPPeer:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setblocking(False)
+        sync_error: Optional[OSError] = None
         try:
             sock.connect((host, port))
         except BlockingIOError:
             pass
+        except OSError as e:
+            # immediate connect failure (unroutable address, exhausted
+            # ports): register the peer then drop it through the normal
+            # path so PeerManager backoff records the failure instead of
+            # the dial crashing the crank loop
+            sync_error = e
         peer = TCPPeer(self.overlay, we_called_remote=True, sock=sock,
                        transport=self)
         peer.dial_addr = (host, port)   # feeds PeerManager backoff on drop
@@ -133,6 +140,8 @@ class TCPTransport:
                                | selectors.EVENT_WRITE)
         peer._registered = True
         self.overlay._register_peer(peer)
+        if sync_error is not None:
+            peer.drop(f"connect failed: {sync_error}")
         return peer
 
     def _accept(self) -> None:

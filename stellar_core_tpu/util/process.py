@@ -13,16 +13,24 @@ completion callbacks fire inside crank like every other event.
 from __future__ import annotations
 
 import shlex
+import signal
 import subprocess
+import time as _time
 from typing import Callable, Deque, List, Optional
 from collections import deque
 
 from . import logging as slog
-from .clock import VirtualClock
+from .clock import VirtualClock, monotonic_now
 
 log = slog.get("Process")
 
 MAX_CONCURRENT_SUBPROCESSES = 8
+
+# Default SIGTERM -> SIGKILL escalation window (reference:
+# ProcessManagerImpl::shutdown kills outright; real node fleets need the
+# children — themselves full nodes flushing sqlite/bucket state — a grace
+# period to exit cleanly before the hard kill guarantees no orphans).
+DEFAULT_GRACE_S = 5.0
 
 
 class ProcessExitEvent:
@@ -38,6 +46,7 @@ class ProcessExitEvent:
         self.exit_code: Optional[int] = None
         self.cancelled = False
         self._out_fh = None
+        self._kill_timer = None   # armed by ProcessManager.stop escalation
 
     def _close_output(self) -> None:
         if self._out_fh is not None:
@@ -84,6 +93,43 @@ class ProcessManager:
         if ev.proc is not None and ev.exit_code is None:
             ev.proc.kill()
 
+    def stop(self, ev: ProcessExitEvent,
+             grace_s: float = DEFAULT_GRACE_S) -> None:
+        """Graceful stop with escalation: SIGTERM now; if the child is
+        still alive after `grace_s` a clock timer SIGKILLs it.  Unlike
+        cancel(), on_exit still fires (callers observe the exit code) —
+        this is how a fleet harness rolls a node without orphaning it.
+        grace_s=0 escalates immediately."""
+        if ev in self._pending:
+            # never started: report the stop as an exit so callers
+            # waiting on on_exit (the documented contract) still wake
+            self._pending.remove(ev)
+            ev.exit_code = -1
+            self.clock.post_action(lambda ev=ev: ev.on_exit(-1),
+                                   name="process-exit")
+            return
+        if ev.proc is None or ev.exit_code is not None:
+            return
+        if grace_s <= 0:
+            ev.proc.kill()
+            return
+        try:
+            ev.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return   # already gone; the pump reaps it
+        from .clock import VirtualTimer
+        timer = VirtualTimer(self.clock)
+        ev._kill_timer = timer   # pin: a collected timer never fires
+
+        def escalate() -> None:
+            if ev.proc is not None and ev.exit_code is None \
+                    and ev.proc.poll() is None:
+                log.warning("process ignored SIGTERM for %.1fs; killing: %s",
+                            grace_s, ev.cmdline)
+                ev.proc.kill()
+
+        timer.expires_from_now(grace_s, escalate)
+
     def _maybe_start(self) -> None:
         while (not self._shutdown and self._pending
                and len(self._running) < self.max_concurrent):
@@ -115,6 +161,9 @@ class ProcessManager:
                 continue
             ev.exit_code = code
             ev._close_output()
+            if ev._kill_timer is not None:
+                ev._kill_timer.cancel()
+                ev._kill_timer = None
             self._running.remove(ev)
             progressed += 1
             if not ev.cancelled:
@@ -124,16 +173,34 @@ class ProcessManager:
             self._maybe_start()
         return progressed
 
-    def shutdown(self) -> None:
-        """Kill everything (reference: ProcessManagerImpl::shutdown)."""
+    def shutdown(self, grace_s: float = 0.0) -> None:
+        """Stop everything (reference: ProcessManagerImpl::shutdown).
+        grace_s=0 keeps the historical hard-kill semantics; with a grace
+        period every running child first gets SIGTERM, the whole set is
+        polled for up to `grace_s`, and only the survivors are SIGKILLed —
+        fleet teardown never leaks orphan nodes either way, but graceful
+        children (flushing databases, closing sockets) get to exit 0."""
         self._shutdown = True
         self.clock.remove_io_pump(self._pump)
         for ev in self._pending:
             ev.exit_code = -1
         self._pending.clear()
+        alive = [ev for ev in self._running
+                 if ev.proc is not None and ev.exit_code is None]
+        if grace_s > 0 and alive:
+            for ev in alive:
+                try:
+                    ev.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            deadline = monotonic_now() + grace_s
+            while monotonic_now() < deadline \
+                    and any(ev.proc.poll() is None for ev in alive):
+                _time.sleep(0.02)
         for ev in self._running:
             if ev.proc is not None and ev.exit_code is None:
-                ev.proc.kill()
+                if ev.proc.poll() is None:
+                    ev.proc.kill()
                 ev.proc.wait()
                 ev.exit_code = ev.proc.returncode
             ev._close_output()

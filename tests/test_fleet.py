@@ -1,0 +1,267 @@
+"""Fleet harness tests: provisioning/schedule/SLO units plus the
+acceptance-bar e2e — a 5-node fleet of real `stellar-core-tpu run`
+processes over real TCP sustaining loadgen traffic through a kill +
+`catchup --parallel` rejoin, an overlay partition + heal, and a rolling
+config change, with zero hash divergence and every SLO green.
+
+Reference test model: the deployment shape of PAPER.md (Herder tracking a
+live network while HistoryManager publishes checkpoints other nodes catch
+up from), exercised as real processes — ROADMAP item 5.
+"""
+
+import json
+import os
+
+import pytest
+
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.simulation.fleet import (Fleet, FleetSLOs,
+                                               parse_schedule,
+                                               run_fleet_soak,
+                                               standard_schedule)
+
+
+# ---------------------------------------------------------------------------
+# units: provisioning
+# ---------------------------------------------------------------------------
+
+class TestProvisioning:
+    def test_configs_parse_and_agree_on_the_network(self, tmp_path):
+        fleet = Fleet(str(tmp_path), n_nodes=4)
+        fleet.provision()
+        cfgs = [Config.from_toml(n.conf_path) for n in fleet.nodes]
+        # every node agrees on passphrase, quorum and checkpoint cadence
+        assert len({c.NETWORK_PASSPHRASE for c in cfgs}) == 1
+        assert all(c.checkpoint_frequency() == 8 for c in cfgs)
+        assert all(c.QUORUM_SET_THRESHOLD == 3 for c in cfgs)  # majority of 4
+        assert all(len(c.QUORUM_SET_VALIDATORS) == 4 for c in cfgs)
+        # distinct identities and ports; full-mesh known peers
+        seeds = {c.NODE_SEED for c in cfgs}
+        assert len(seeds) == 4
+        ports = {c.PEER_PORT for c in cfgs} | {c.HTTP_PORT for c in cfgs}
+        assert len(ports) == 8
+        for i, c in enumerate(cfgs):
+            assert len(c.KNOWN_PEERS) == 3
+            assert c.DATABASE.endswith(f"node-{i}/node.db")
+            # shared archive: every node reads AND publishes (writes are
+            # atomic + pid-unique, objects content-identical)
+            assert c.HISTORY[0].get_path == fleet.archive_dir
+            assert c.HISTORY[0].put_path == fleet.archive_dir
+        # genesis boot bootstraps SCP; a provisioned node starts FORCE_SCP
+        assert all(c.FORCE_SCP for c in cfgs)
+
+    def test_quorum_is_majority_and_intersecting(self, tmp_path):
+        fleet = Fleet(str(tmp_path), n_nodes=5)
+        assert fleet.threshold == 3           # any two quorums intersect
+        fleet2 = Fleet(str(tmp_path / "b"), n_nodes=5, threshold=4)
+        assert fleet2.threshold == 4
+
+
+# ---------------------------------------------------------------------------
+# units: schedule
+# ---------------------------------------------------------------------------
+
+class TestSchedule:
+    def test_parse_rejects_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown fleet event"):
+            parse_schedule([{"kind": "explode"}])
+
+    def test_parse_rejects_missing_required_params(self):
+        """Schedules are user input (`fleet --schedule`): malformed
+        entries must fail at parse time with the entry index, not as a
+        KeyError mid-soak after the fleet booted."""
+        for bad in ({"kind": "wait-ledger"},
+                    {"kind": "rolling-config"},
+                    {"kind": "partition"},
+                    {"kind": "kill"},
+                    {}):
+            with pytest.raises(ValueError,
+                               match="schedule entry 1"):
+                parse_schedule([{"kind": "heal"}, bad])
+
+    def test_parse_rejects_out_of_range_node_indices(self):
+        """With the fleet size known, node indices are validated at
+        parse time — `fleet --nodes 2` with the standard (kill node 2)
+        script must fail before anything boots."""
+        with pytest.raises(ValueError, match="out of range"):
+            parse_schedule([{"kind": "kill", "node": 2}], n_nodes=2)
+        with pytest.raises(ValueError, match="out of range"):
+            parse_schedule([{"kind": "partition",
+                             "groups": [[0], [1, 5]]}], n_nodes=3)
+        with pytest.raises(ValueError, match="out of range"):
+            parse_schedule([{"kind": "rolling-config", "overrides": {},
+                             "nodes": [0, -1]}], n_nodes=3)
+        # in range passes; without n_nodes no index check applies
+        assert parse_schedule([{"kind": "kill", "node": 2}], n_nodes=3)
+        assert parse_schedule([{"kind": "kill", "node": 9}])
+
+    def test_standard_schedule_keeps_quorum_for_even_fleets(self):
+        """The partition's majority side must meet the n//2+1 threshold
+        for EVERY fleet size, or the whole network stalls mid-script."""
+        for n in (3, 4, 5, 6, 7):
+            sched = standard_schedule(n_nodes=n)
+            part = [e for e in sched if e["kind"] == "partition"][0]
+            majority, minority = part["groups"]
+            assert len(majority) >= n // 2 + 1, (n, part["groups"])
+            assert 0 in majority
+            assert sorted(majority + minority) == list(range(n))
+
+    def test_standard_schedule_covers_the_three_production_events(self):
+        sched = standard_schedule(n_nodes=5)
+        kinds = [e["kind"] for e in sched]
+        assert "kill" in kinds and "rejoin" in kinds
+        assert "partition" in kinds and "heal" in kinds
+        assert "rolling-config" in kinds
+        # the rejoin follows its kill and targets the same node
+        kill = sched[kinds.index("kill")]
+        rejoin = sched[kinds.index("rejoin")]
+        assert kinds.index("rejoin") > kinds.index("kill")
+        assert rejoin["node"] == kill["node"]
+        # the partition keeps a closing quorum on the writer's side
+        part = sched[kinds.index("partition")]
+        majority, minority = part["groups"]
+        assert 0 in majority
+        assert len(majority) >= 3     # >= threshold: ledgers keep closing
+        assert kill["node"] in majority
+        # every event round-trips the parser
+        assert len(parse_schedule(sched)) == len(sched)
+
+    def test_events_roundtrip_describe(self):
+        events = parse_schedule(standard_schedule(n_nodes=5))
+        for e in events:
+            d = e.describe()
+            assert d["kind"] in ("wait-ledger", "wait-s", "traffic", "kill",
+                                 "rejoin", "partition", "heal",
+                                 "rolling-config")
+
+
+# ---------------------------------------------------------------------------
+# units: SLO evaluation (no processes)
+# ---------------------------------------------------------------------------
+
+class TestSLOEvaluation:
+    def _quiet_fleet(self, tmp_path, slos=None):
+        fleet = Fleet(str(tmp_path), n_nodes=3, slos=slos)
+        fleet.provision()
+        return fleet
+
+    def test_divergence_detected_and_reported(self, tmp_path):
+        fleet = self._quiet_fleet(tmp_path)
+        fleet.hash_by_seq = {
+            5: {0: "aa" * 32, 1: "aa" * 32, 2: "aa" * 32},
+            6: {0: "aa" * 32, 1: "bb" * 32},          # fork!
+        }
+        report = fleet.finalize()
+        assert not report["passed"]
+        assert any("HASH DIVERGENCE at ledger 6" in v
+                   for v in report["violations"])
+        assert report["divergence_seqs_compared"] == 2
+
+    def test_identical_hashes_pass_and_write_report(self, tmp_path):
+        fleet = self._quiet_fleet(tmp_path)
+        fleet.hash_by_seq = {5: {0: "aa" * 32, 1: "aa" * 32}}
+        report = fleet.finalize()
+        assert report["passed"] and report["violations"] == []
+        on_disk = json.load(open(report["report_path"]))
+        assert on_disk["passed"] is True
+        assert on_disk["nodes"] == 3
+        # the artifact is replayable: it carries the schedule input and
+        # per-node config/log paths
+        assert "schedule" in on_disk
+        assert all("conf" in n and "log" in n
+                   for n in on_disk["node_artifacts"])
+
+    def test_retracking_budget_enforced(self, tmp_path):
+        fleet = self._quiet_fleet(
+            tmp_path, slos=FleetSLOs(max_retracking_s=10.0))
+        fleet.metrics["retracking_s"] = 55.5
+        report = fleet.finalize()
+        assert any("time-to-retracking 55.5s" in v
+                   for v in report["violations"])
+
+    def test_shed_rate_budget_enforced(self, tmp_path):
+        fleet = self._quiet_fleet(
+            tmp_path, slos=FleetSLOs(max_shed_rate=0.10))
+        fleet.client.offered = 100
+        fleet.client.statuses = {"PENDING": 60, "TRY-AGAIN-LATER": 40}
+        report = fleet.finalize()
+        assert any("shed rate" in v for v in report["violations"])
+        assert report["traffic"]["shed_rate"] == 0.4
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar (real processes, real TCP, real archive)
+# ---------------------------------------------------------------------------
+
+class TestFleetEndToEnd:
+    def test_five_nodes_kill_rejoin_partition_roll_no_divergence(
+            self, tmp_path):
+        """ISSUE 11 acceptance: a 5-node fleet over real TCP sustains
+        loadgen traffic through a kill + `catchup --parallel` rejoin, an
+        overlay partition + heal, and a rolling config change with zero
+        hash divergence and all SLO assertions green."""
+        report = run_fleet_soak(
+            str(tmp_path), n_nodes=5, traffic_rate=25.0, n_accounts=60,
+            slos=FleetSLOs(max_p99_close_s=2.0, max_shed_rate=0.35,
+                           max_retracking_s=90.0, max_roll_node_s=60.0),
+            timeout_s=420.0)
+        assert report["passed"], report["violations"]
+        # all three production events actually happened
+        assert report["metrics"].get("retracking_s") is not None
+        assert len(report["metrics"].get("roll_node_s", {})) == 5
+        # traffic flowed and was not all shed
+        assert report["traffic"]["statuses"].get("PENDING", 0) > 50
+        assert report["traffic"]["shed_rate"] <= 0.35
+        # divergence proof compared real multi-node samples
+        assert report["divergence_seqs_compared"] >= 5
+        # the rejoin really was a parallel catchup against the live
+        # archive: the worker's log shows the range/stitch machinery
+        node2 = os.path.join(str(tmp_path), "node-2")
+        catchup_log = open(os.path.join(node2, "catchup.log")).read()
+        assert "ranges" in catchup_log and "stitches verified" in \
+            catchup_log, catchup_log[-500:]
+        # the archive kept publishing throughout (live HistoryManager)
+        assert report["archive_tip"] is not None
+        assert report["archive_tip"] >= 15
+        # flight records exist for every node
+        for n in range(5):
+            assert os.path.exists(
+                os.path.join(str(tmp_path), f"node-{n}", "node.log"))
+
+
+@pytest.mark.slow
+class TestFleetSoak:
+    def test_larger_soak_with_overload_burst(self, tmp_path):
+        """The long campaign: sustained traffic at capacity, a 3x
+        overload burst (shedding must engage and stay bounded), a longer
+        partition that forces SCP-state recovery, and a full rolling
+        config change — SLOs asserted over ~2 minutes of fleet time."""
+        schedule = [
+            {"kind": "traffic", "rate_per_s": 30.0},
+            {"kind": "wait-ledger", "seq": 10},
+            {"kind": "kill", "node": 2},
+            {"kind": "rejoin", "node": 2, "parallel": 2},
+            {"kind": "wait-ledger", "seq": 20},
+            # overload burst: ~3x the per-close apply capacity
+            {"kind": "traffic", "rate_per_s": 90.0},
+            {"kind": "wait-s", "s": 8.0},
+            {"kind": "traffic", "rate_per_s": 30.0},
+            {"kind": "partition", "groups": [[0, 1, 2], [3, 4]]},
+            {"kind": "wait-s", "s": 10.0},
+            {"kind": "heal", "timeout_s": 90.0},
+            {"kind": "rolling-config",
+             "overrides": {"ADMISSION_BATCH_SIZE": 128,
+                           "LOG_LEVEL": "WARNING"}},
+            {"kind": "wait-ledger", "seq": 45},
+        ]
+        report = run_fleet_soak(
+            str(tmp_path), n_nodes=5, schedule=schedule, n_accounts=120,
+            slos=FleetSLOs(max_p99_close_s=2.0, max_shed_rate=0.5,
+                           max_retracking_s=120.0, max_roll_node_s=90.0,
+                           min_sustained_tps=5.0),
+            timeout_s=600.0)
+        assert report["passed"], report["violations"]
+        assert report["max_ledger"] >= 45
+        # overload engaged the shedding machinery at least once
+        assert report["traffic"]["statuses"].get("TRY-AGAIN-LATER", 0) > 0
+        assert report["divergence_seqs_compared"] >= 20

@@ -422,6 +422,58 @@ class TestNodeAdminSurface:
         app.stop()
 
 
+class TestHealthProbePolling:
+    """`health --retries/--interval`: poll a booting node to readiness
+    instead of hand-rolling sleep loops (fleet harness + operator probe)."""
+
+    def test_unreachable_without_retries_exits_1(self, tmp_path, capsys):
+        from stellar_core_tpu.main.commandline import main
+        port = _free_ports(1)[0]
+        conf = tmp_path / "n.cfg"
+        conf.write_text(f"HTTP_PORT = {port}\n")
+        assert main(["health", "--conf", str(conf), "--timeout", "0.5"]) == 1
+        assert "unreachable" in capsys.readouterr().out
+
+    def test_retries_poll_until_the_endpoint_comes_up(self, tmp_path,
+                                                      capsys):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        from stellar_core_tpu.main.commandline import main
+
+        port = _free_ports(1)[0]
+        conf = tmp_path / "n.cfg"
+        conf.write_text(f"HTTP_PORT = {port}\n")
+
+        class OkHandler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"status": "ok"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = HTTPServer(("127.0.0.1", port), OkHandler)
+
+        def come_up_late():
+            time.sleep(0.8)   # a few probe attempts fail first
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+
+        threading.Thread(target=come_up_late, daemon=True).start()
+        try:
+            rc = main(["health", "--conf", str(conf),
+                       "--retries", "20", "--interval", "0.2",
+                       "--timeout", "0.5"])
+            assert rc == 0
+            assert json.loads(capsys.readouterr().out)["status"] == "ok"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
 class TestInPlaceArchiveCatchup:
     def test_out_of_sync_node_catches_up_from_archive(self, tmp_path):
         """A live node whose gap exceeds peers' SCP memory replays from

@@ -22,6 +22,19 @@ from stellar_core_tpu.util.clock import ClockMode, VirtualClock
 
 NID = network_id("overlay test net")
 
+_LARGE_ENV = None
+
+
+def _large_envelope():
+    """A ~7KB signed 100-op envelope (cached — the tests only need bulk
+    bytes that decode as a real TransactionEnvelope)."""
+    global _LARGE_ENV
+    if _LARGE_ENV is None:
+        from stellar_core_tpu.testutils import build_tx, native_payment_op
+        ops = [native_payment_op(X.AccountID.ed25519(b"\x44" * 32), 5)] * 100
+        _LARGE_ENV = build_tx(NID, SecretKey(b"\x93" * 32), 1, ops).envelope
+    return _LARGE_ENV
+
 
 # ---------------------------------------------------------------------------
 # framing
@@ -329,6 +342,172 @@ class TestOverTCP:
         finally:
             for t in transports:
                 t.close()
+
+
+class TestTCPTransportEdgeCases:
+    """The three failure shapes LoopbackPeer structurally cannot exercise
+    (ISSUE 11 satellite): partial-frame reassembly across READ_CHUNK
+    boundaries, a half-open peer (remote closes with writes still
+    buffered), and the MAX_WRITE_BUFFER overflow drop path."""
+
+    def _tcp_pair(self, clock_a=None, clock_b=None):
+        """Two nodes with real sockets; separate clocks let a test crank
+        one side only (a peer that stops reading)."""
+        clock_a = clock_a or VirtualClock(ClockMode.REAL_TIME)
+        clock_b = clock_b or clock_a
+        sk_a, sk_b = SecretKey(b"\x91" * 32), SecretKey(b"\x92" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+
+        def mk(clock, sk, seed):
+            lm = LedgerManager(NID)
+            lm.start_new_ledger()
+            h = Herder(clock, lm, sk, q)
+            o = OverlayManager(clock, h, NID, sk, auth_seed=seed)
+            return h, o
+
+        ha, oa = mk(clock_a, sk_a, b"A" * 32)
+        hb, ob = mk(clock_b, sk_b, b"B" * 32)
+        ta = TCPTransport(oa, listen_port=None)
+        tb = TCPTransport(ob, listen_port=0)
+        pa = ta.connect("127.0.0.1", ob.listening_port)
+
+        import time as _t
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            progressed = clock_a.crank()
+            if clock_b is not clock_a:
+                progressed += clock_b.crank()
+            if pa.is_authenticated() and ob.num_authenticated() == 1:
+                break
+            if not progressed:
+                _t.sleep(0.001)
+        assert pa.is_authenticated()
+        pb = next(iter(tb.peers.values()))
+        assert pb.is_authenticated()
+        return (clock_a, clock_b), (ta, tb), (pa, pb), (oa, ob)
+
+    def test_partial_frame_reassembly_across_read_chunk(self):
+        """One authenticated frame larger than READ_CHUNK arrives in
+        multiple recv() slices; the decoder must reassemble it into
+        exactly one intact message."""
+        from stellar_core_tpu.overlay import tcp as tcp_mod
+        (ca, cb), (ta, tb), (pa, pb), (oa, ob) = self._tcp_pair()
+        try:
+            # ~50 txs x 100 ops ≈ 287KB > READ_CHUNK (256KB), single frame
+            txset = X.TransactionSet(previousLedgerHash=b"\x00" * 32,
+                                     txs=[_large_envelope()] * 50)
+            msg = X.StellarMessage.txSet(txset)
+            assert len(msg.to_xdr()) > tcp_mod.READ_CHUNK
+            got = []
+            orig = ob._message_received
+            ob._message_received = \
+                lambda peer, m: (got.append(m), orig(peer, m))
+            pa.send_message(msg)
+            ok = ca.crank_until(
+                lambda: any(m.switch == X.MessageType.TX_SET for m in got),
+                timeout=10)
+            assert ok, "large frame never reassembled"
+            big = [m for m in got if m.switch == X.MessageType.TX_SET][0]
+            assert len(big.value.txs) == 50
+            assert big.value.to_xdr() == txset.to_xdr()
+            assert pb.is_authenticated()   # stream intact, MAC chain alive
+        finally:
+            ta.close()
+            tb.close()
+
+    def test_half_open_peer_with_buffered_writes_drops_cleanly(self):
+        """Remote dies (socket closed, never read) while our side still
+        has frames buffered: the next flush must surface the socket error
+        as a clean drop, never an unhandled exception."""
+        (ca, cb), (ta, tb), (pa, pb), (oa, ob) = self._tcp_pair(
+            clock_a=VirtualClock(ClockMode.REAL_TIME),
+            clock_b=VirtualClock(ClockMode.REAL_TIME))
+        try:
+            # shrink A's kernel send buffer so writes actually buffer
+            import socket as pysock
+            pa.sock.setsockopt(pysock.SOL_SOCKET, pysock.SO_SNDBUF, 8192)
+            big = X.StellarMessage.txSet(X.TransactionSet(
+                previousLedgerHash=b"\x01" * 32,
+                txs=[_large_envelope()] * 8))
+            # B stops pumping (its clock is never cranked again): B's
+            # receive buffer fills, then A's kernel send buffer, then
+            # A's user-space write buffer
+            for _ in range(60):
+                pa.send_message(big)
+                if pa._write_buf:
+                    break
+            assert pa._write_buf, "writes never buffered"
+            # remote closes with data in flight -> RST on next send
+            pb.sock.close()
+            for _ in range(400):
+                ca.crank()
+                if pa.state == Peer.CLOSING:
+                    break
+            assert pa.state == Peer.CLOSING
+            assert pa.drop_reason is not None
+            assert ("error" in pa.drop_reason
+                    or "closed" in pa.drop_reason), pa.drop_reason
+            # the transport forgot the peer and survives further pumps
+            assert pa.sock is None
+            ca.crank()
+        finally:
+            ta.close()
+            tb.close()
+
+    def test_max_write_buffer_overflow_drops_peer(self, monkeypatch):
+        """A peer that stops reading while we keep sending must be
+        dropped at the MAX_WRITE_BUFFER bound — bounded memory per
+        connection, not an OOM (reference: TCPPeer write-queue limits)."""
+        from stellar_core_tpu.overlay import tcp as tcp_mod
+        clock_a = VirtualClock(ClockMode.REAL_TIME)
+        clock_b = VirtualClock(ClockMode.REAL_TIME)   # never cranked after auth
+        (ca, cb), (ta, tb), (pa, pb), (oa, ob) = self._tcp_pair(
+            clock_a=clock_a, clock_b=clock_b)
+        try:
+            monkeypatch.setattr(tcp_mod, "MAX_WRITE_BUFFER", 128 * 1024)
+            import socket as pysock
+            pa.sock.setsockopt(pysock.SOL_SOCKET, pysock.SO_SNDBUF, 8192)
+            payload = X.StellarMessage.txSet(X.TransactionSet(
+                previousLedgerHash=b"\x02" * 32,
+                txs=[_large_envelope()] * 2))
+            blob_len = len(payload.to_xdr())
+            # B never cranks -> never reads -> kernel buffers fill ->
+            # A's user-space buffer grows to the (patched) cap
+            sent = 0
+            while pa.state != Peer.CLOSING and sent < 2000:
+                pa.send_message(payload)
+                sent += 1
+            assert pa.state == Peer.CLOSING, \
+                f"no overflow after {sent} sends of {blob_len}B"
+            assert pa.drop_reason == "write buffer overflow"
+            # bounded: the buffer never grew far past the cap
+            assert len(pa._write_buf) <= 128 * 1024 + 2 * (blob_len + 64)
+        finally:
+            ta.close()
+            tb.close()
+
+    def test_synchronous_connect_failure_is_a_clean_drop(self):
+        """A dial that fails synchronously (unroutable address) must
+        record a normal drop, not crash the crank loop."""
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        sk = SecretKey(b"\x94" * 32)
+        q = qset_of([sk.public_key.ed25519], 1)
+        lm = LedgerManager(NID)
+        lm.start_new_ledger()
+        h = Herder(clock, lm, sk, q)
+        o = OverlayManager(clock, h, NID, sk, auth_seed=b"Z" * 32)
+        t = TCPTransport(o, listen_port=None)
+        try:
+            # unparseable address: resolution fails synchronously
+            peer = t.connect("256.256.256.256", 1)
+            for _ in range(100):
+                clock.crank()
+                if peer.state == Peer.CLOSING:
+                    break
+            assert peer.state == Peer.CLOSING
+            assert "connect failed" in (peer.drop_reason or "")
+        finally:
+            t.close()
 
 
 class TestPeerDiscovery:

@@ -54,6 +54,75 @@ class TestProcessManager:
         pm.shutdown()
         assert ev.done and ev.exit_code != 0
 
+    # a child that exits 0 on SIGTERM (the well-behaved fleet node)
+    _POLITE = ('python3 -c "import signal,sys,time; '
+               "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0)); "
+               '[time.sleep(0.05) for _ in range(600)]"')
+    # a child that ignores SIGTERM outright (the wedged node the
+    # escalation exists for)
+    _STUBBORN = ('python3 -c "import signal,time; '
+                 "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                 '[time.sleep(0.05) for _ in range(600)]"')
+
+    def test_stop_graceful_child_exits_zero(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        results = []
+        ev = pm.run_command(self._POLITE, results.append)
+        assert clock.crank_until(lambda: ev.running, timeout=10)
+        import time
+        time.sleep(0.3)   # let the child install its handler
+        pm.stop(ev, grace_s=8.0)
+        assert clock.crank_until(lambda: results != [], timeout=10)
+        # SIGTERM honored inside the grace window: clean exit, no SIGKILL
+        assert results == [0]
+        pm.shutdown()
+
+    def test_stop_escalates_sigkill_on_signal_ignoring_child(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        results = []
+        ev = pm.run_command(self._STUBBORN, results.append)
+        assert clock.crank_until(lambda: ev.running, timeout=10)
+        import time
+        time.sleep(0.3)   # let the child ignore SIGTERM first
+        pm.stop(ev, grace_s=0.5)
+        assert clock.crank_until(lambda: results != [], timeout=15)
+        # the grace period expired and the escalation SIGKILLed it
+        assert results == [-9]
+        pm.shutdown()
+
+    def test_stop_of_pending_command_still_fires_on_exit(self):
+        """stop()'s contract: unlike cancel(), on_exit fires — including
+        for a command still queued behind the concurrency bound."""
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock, max_concurrent=1)
+        results = []
+        blocker = pm.run_command("sleep 30", lambda code: None)
+        queued = pm.run_command("true", results.append)
+        assert queued.proc is None          # still pending
+        pm.stop(queued, grace_s=1.0)
+        assert clock.crank_until(lambda: results == [-1], timeout=5)
+        assert queued.done
+        pm.shutdown()
+
+    def test_shutdown_with_grace_terms_then_kills(self):
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        pm = ProcessManager(clock)
+        polite = pm.run_command(self._POLITE, lambda code: None)
+        stubborn = pm.run_command(self._STUBBORN, lambda code: None)
+        assert clock.crank_until(
+            lambda: polite.running and stubborn.running, timeout=10)
+        import time
+        time.sleep(0.3)
+        pm.shutdown(grace_s=1.0)
+        assert polite.done and stubborn.done
+        assert polite.exit_code == 0        # honored SIGTERM
+        assert stubborn.exit_code == -9     # needed the escalation
+        # no orphans either way
+        assert polite.proc.poll() is not None
+        assert stubborn.proc.poll() is not None
+
 
 class TestPerf:
     def test_scoped_timer_feeds_metrics_registry(self):
