@@ -1,5 +1,6 @@
 .PHONY: native test lint race metrics obs bucketdb bucketdb-slow chaos \
-	chaos-soak loadgen loadgen-slow catchup-par fleet fleet-soak clean
+	chaos-byz chaos-soak loadgen loadgen-slow catchup-par fleet \
+	fleet-soak clean
 
 native:
 	python setup.py build_ext --inplace
@@ -20,7 +21,9 @@ test: lint
 # race-sanitizer soak (ISSUE 9): the threaded test subset — admission
 # (incl. the loopback-flood hysteresis soak and the http-style marshalled
 # submission test), the thread-safety suite itself, and the chaos
-# scenario tier — with STPU_RACE_TRACE=1 so every @race_checked class is
+# scenario tier (INCLUDING the ISSUE 12 byzantine tier: equivocation
+# campaigns + the in-sim archive-recovery handoff run with the sanitizer
+# armed) — with STPU_RACE_TRACE=1 so every @race_checked class is
 # instrumented and every make_lock lock feeds the per-field locksets.
 # An unguarded cross-thread write fail-stops with DataRaceError + crash
 # bundle.  Overhead: ~1.1µs per tracked access (PROFILE.md round 8).
@@ -62,6 +65,17 @@ obs:
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
 		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# byzantine chaos tier (ISSUE 12): equivocation / conflicting-nomination
+# / stale-replay campaigns from SIGNING validators, the generated
+# intersection-violation fork-detection proof, and the in-sim
+# out-of-sync -> archive -> re-tracking handoff (single-stream AND
+# range-parallel catchup).  The same tests run sanitizer-armed in
+# `make race`.
+chaos-byz:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+		-k 'Byzantine or ArchiveRecovery' -q -m 'not slow' \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 chaos-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \

@@ -376,6 +376,16 @@ class ParallelCatchup:
 
     def _worker_cmdline(self, spec: RangeSpec) -> str:
         d = self._range_dir(spec.index)
+        # workers must import the SAME package the parent runs, even when
+        # the parent got it via sys.path manipulation (an embedding
+        # consumer) rather than cwd or an inherited PYTHONPATH — without
+        # this every range worker dies on ImportError and the retry
+        # backoff turns a config quirk into minutes of spin
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        inherited = os.environ.get("PYTHONPATH")
+        pythonpath = pkg_parent if not inherited \
+            else pkg_parent + os.pathsep + inherited
         args = [self.python, "-m", "stellar_core_tpu", "catchup-range",
                 "--archive", self.archive_spec,
                 "--passphrase", self.passphrase,
@@ -405,6 +415,9 @@ class ParallelCatchup:
             # non-default cadence (accelerated test fleets) must reach the
             # worker process or its range plan/seam math disagrees with ours
             args += ["--checkpoint-frequency", str(checkpoint_frequency())]
+        # ProcessManager runs shell-less (shlex.split + Popen), so the
+        # assignment travels through `env`
+        args = ["env", f"PYTHONPATH={pythonpath}"] + args
         return " ".join(shlex.quote(a) for a in args)
 
     # -- driving -----------------------------------------------------------

@@ -77,6 +77,11 @@ class Herder(SCPDriver):
         self.scp = SCP(self, self.node_id, is_validator, qset)
         self.pending = PendingEnvelopes()
         self.tx_queue = TransactionQueue(ledger_manager)
+        # node -> last announced qset hash: quorum-tracker maintenance
+        # runs only when a node actually CHANGES its quorum set, not once
+        # per envelope (the expand walk re-encoded the qset per envelope
+        # — measurably hot at 150+ simulated nodes)
+        self._node_qset_hash: Dict[bytes, bytes] = {}
         # batched admission (herder/admission.py); None = legacy inline
         # single-sig intake.  Installed via enable_admission().
         self.admission = None
@@ -105,13 +110,22 @@ class Herder(SCPDriver):
         # slot -> perf_counter at nomination trigger (scp.slot.externalize
         # timer: nomination start -> value applied)
         self._nominate_started: Dict[int, float] = {}
-        # recovery bookkeeping: how often this node fell out of sync and
-        # how many ledgers it applied from the buffered-externalize queue
-        # while catching back up — the chaos runner asserts a stalled
-        # validator actually exercised these paths after rejoin instead of
+        # recovery bookkeeping: how often this node fell out of sync, how
+        # many ledgers it applied from the buffered-externalize queue
+        # while catching back up, and how often it had to resync from a
+        # history archive — the chaos runner asserts a stalled validator
+        # actually exercised these paths after rejoin instead of
         # inferring recovery from the LCL alone
         self.recovery_stats: Dict[str, int] = {"out_of_sync": 0,
-                                               "buffered_applied": 0}
+                                               "buffered_applied": 0,
+                                               "archive_catchups": 0}
+        # fires every time the buffered-externalize queue dead-ends (the
+        # next needed slot is older than any peer remembers) — the
+        # archive-catchup handoff listens here.  Distinct from
+        # out_of_sync_handler, which fires only on the TRACKING->SYNCING
+        # edge: a node that is already syncing but discovers its gap
+        # exceeds the fleet's slot memory must still reach the archive.
+        self.sync_gap_hook: Callable[[], None] = lambda: None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -161,8 +175,18 @@ class Herder(SCPDriver):
         lcl = self.tracking_consensus_ledger_index()
         if slot <= lcl - MAX_SLOTS_TO_REMEMBER or \
                 slot > lcl + LEDGER_VALIDITY_BRACKET:
+            # The silent dead-end of every stuck-node incident: a node
+            # whose gap exceeds the slot-memory window throws its peers'
+            # (stale-looking) envelopes away and stops externalizing with
+            # no externally visible cause.  Meter + flight-record the
+            # discard so /dumpflight answers "why did this node stop".
+            self._note_envelope_discarded(
+                slot, lcl,
+                "below-memory-window" if slot <= lcl - MAX_SLOTS_TO_REMEMBER
+                else "beyond-validity-bracket")
             return ENVELOPE_STATUS_DISCARDED
         if not self.verify_envelope(env):
+            self._note_envelope_discarded(slot, lcl, "bad-signature")
             return ENVELOPE_STATUS_DISCARDED
         _registry().meter("scp.envelope.receive").mark()
         phase = self._PHASE_METERS.get(int(st.pledges.type))
@@ -172,6 +196,12 @@ class Herder(SCPDriver):
         if status == ENVELOPE_STATUS_READY:
             self._process_scp_queue()
         return status
+
+    def _note_envelope_discarded(self, slot: int, lcl: int,
+                                 reason: str) -> None:
+        _registry().meter("herder.scp.envelope-discarded").mark()
+        eventlog.record("SCP", "WARNING", "scp envelope discarded",
+                        slot=slot, lcl=lcl, reason=reason)
 
     def recv_tx_set(self, txset_hash: bytes, txset) -> bool:
         """Reference: HerderImpl::recvTxSet.  The hash gate runs FIRST so
@@ -243,9 +273,14 @@ class Herder(SCPDriver):
 
     def _track_qset(self, st) -> None:
         from .pending_envelopes import statement_qset_hash
-        q = self.pending.get_qset(statement_qset_hash(st))
+        qh = statement_qset_hash(st)
+        nid = st.nodeID.value
+        if self._node_qset_hash.get(nid) == qh:
+            return   # same announced qset: the quorum map is unchanged
+        q = self.pending.get_qset(qh)
         if q is not None:
-            if not self.quorum_tracker.expand(st.nodeID.value, q):
+            self._node_qset_hash[nid] = qh
+            if not self.quorum_tracker.expand(nid, q):
                 self.quorum_tracker.rebuild(self._qset_of_node)
 
     def _qset_of_node(self, node_id: bytes):
@@ -511,6 +546,7 @@ class Herder(SCPDriver):
         if self._buffered and min(self._buffered) > \
                 self.tracking_consensus_ledger_index() + 1:
             self._lost_sync()
+            self.sync_gap_hook()
 
     def _arm_tracking_heartbeat(self) -> None:
         """Reference: HerderImpl::trackingHeartBeat — while this node

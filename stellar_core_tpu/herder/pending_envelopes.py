@@ -29,17 +29,10 @@ QSET_CACHE_SIZE = 10000
 TXSET_CACHE_SIZE = 10000
 
 
-def statement_qset_hash(st) -> bytes:
-    from ..xdr import scp as SX
-    pl = st.pledges
-    t = pl.type
-    if t == SX.SCPStatementType.SCP_ST_NOMINATE:
-        return pl.nominate.quorumSetHash
-    if t == SX.SCPStatementType.SCP_ST_PREPARE:
-        return pl.prepare.quorumSetHash
-    if t == SX.SCPStatementType.SCP_ST_CONFIRM:
-        return pl.confirm.quorumSetHash
-    return pl.externalize.commitQuorumSetHash
+# one source of truth for the pledge-type -> quorumSetHash mapping
+# (scp/quorum.py); re-exported here because every herder-layer consumer
+# historically imports it from this module
+from ..scp.quorum import statement_qset_hash  # noqa: E402,F401
 
 
 def statement_values(st) -> List[bytes]:

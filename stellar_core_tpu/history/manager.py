@@ -41,6 +41,9 @@ class HistoryManager:
         self.db = database
         self._pending: List[ClosedLedgerArtifacts] = []
         self.published_checkpoints: List[int] = []
+        # first ledger this manager has CONTIGUOUS artifacts from; see
+        # resume_from
+        self._publish_floor = 0
 
     def ledger_closed(self, arts: ClosedLedgerArtifacts) -> None:
         """Call after every close (reference: CheckpointBuilder::appendLedger
@@ -120,15 +123,32 @@ class HistoryManager:
         log.info("published checkpoint %d (%d headers, %d tx entries)",
                  checkpoint_seq, len(headers), len(txs))
 
+    def resume_from(self, seq: int) -> None:
+        """A node that adopted state from catchup (archive rejoin) has no
+        artifacts for the ledgers it skipped — publishing the checkpoint
+        window that straddles the adoption would write a stream with
+        holes and poison every node that later catches up from this
+        archive.  Drop the stale pending list and skip any boundary whose
+        window starts before `seq`; healthy peers publish the identical
+        bytes for it."""
+        self._pending.clear()
+        self._publish_floor = seq
+
     def maybe_queue_and_publish(self, seq: int) -> None:
         """Durable two-step publish: enqueue the boundary, then publish and
         dequeue — a crash between the two republishes at startup
         (reference: queueCurrentHistory + publishQueuedHistory)."""
+        boundary = is_checkpoint_boundary(seq)
+        if boundary and \
+                max(2, seq - checkpoint_frequency() + 1) < self._publish_floor:
+            # incomplete window after a catchup adoption (see resume_from)
+            self._pending.clear()
+            boundary = False
         if self.db is None:
-            if is_checkpoint_boundary(seq):
+            if boundary:
                 self.publish_checkpoint(seq)
             return
-        if is_checkpoint_boundary(seq):
+        if boundary:
             self.db.queue_publish(seq, "")
             self.db.commit()
         self.publish_queued_history()

@@ -165,8 +165,18 @@ def evaluate_health(app) -> dict:
     if backlog > HEALTH_BUCKET_GC_BACKLOG:
         reasons.append(f"bucket GC backlog {backlog} files")
 
+    # archive recovery in flight: a distinct degraded status ("the node
+    # is resyncing from a history archive and will be back") vs plain
+    # out-of-sync ("the node is stuck and needs attention").  Both answer
+    # non-"ok", so probes and load balancers route around it either way.
+    catchup_msg = app.status.get_status("history-catchup")
+    if catchup_msg is not None:
+        reasons.append(f"catching up from archive: {catchup_msg}")
+
+    status = "ok" if not reasons \
+        else ("catching-up" if catchup_msg is not None else "degraded")
     return {
-        "status": "ok" if not reasons else "degraded",
+        "status": status,
         "reasons": reasons,
         "checks": {
             "ledger_age_s": round(age, 1),
@@ -176,6 +186,7 @@ def evaluate_health(app) -> dict:
             "admission_backlog": adm_depth,
             "authenticated_peers": peers,
             "bucket_gc_backlog": backlog,
+            "catching_up": catchup_msg is not None,
         },
         "statuses": app.status.statuses(),
     }

@@ -62,6 +62,7 @@ class OverlayManager:
         herder.lost_sync_hook = self.survey.record_lost_sync
         self.stats = {"flooded": 0, "deduped": 0, "dropped_peers": 0,
               "txsets_served": 0, "qsets_served": 0}
+        self._recv_meters: Dict[object, object] = {}
         # weak_gauge: must not pin a torn-down node's peer graph in the
         # process-global registry (dead source -> null gauge)
         _registry().weak_gauge("overlay.peer.authenticated", self,
@@ -188,9 +189,10 @@ class OverlayManager:
     # -- outbound flooding --------------------------------------------------
     def broadcast_scp_envelope(self, env) -> None:
         msg = X.StellarMessage.envelope(env)
-        h = sha256(msg.to_xdr())
+        body = msg.to_xdr()
+        h = sha256(body)
         self.floodgate.add_record(h, env.statement.slotIndex)
-        self._broadcast(msg, h)
+        self._broadcast(msg, h, body)
 
     def flood_transaction(self, frame) -> None:
         """Pull-mode: advertise the hash; peers demand what they miss."""
@@ -201,14 +203,19 @@ class OverlayManager:
             if peer not in self.floodgate.peers_told(h):
                 self.adverts.queue_advert(peer, h)
 
-    def _broadcast(self, msg: X.StellarMessage, msg_hash: bytes) -> None:
+    def _broadcast(self, msg: X.StellarMessage, msg_hash: bytes,
+                   body: Optional[bytes] = None) -> None:
+        # `body` = the message's XDR encoding when the caller already has
+        # it: a fleet-wide flood re-encoding the identical payload once
+        # per peer was measurably hot at 150+ simulated nodes
         told = self.floodgate.peers_told(msg_hash)
+        flood_meter = _registry().meter("overlay.message.flood")
         for peer in self._auth_peer_list():
             if peer not in told:
-                peer.send_message(msg)
+                peer.send_message(msg, body=body)
                 self.floodgate.note_told(msg_hash, peer)
                 self.stats["flooded"] += 1
-                _registry().meter("overlay.message.flood").mark()
+                flood_meter.mark()
 
     def _send_advert(self, peer: Peer, hashes: List[bytes]) -> None:
         peer.send_message(X.StellarMessage.floodAdvert(
@@ -259,16 +266,25 @@ class OverlayManager:
     def ledger_version(self) -> int:
         return self.herder.lm.lcl_header.ledgerVersion
 
-    def _message_received(self, peer: Peer, msg: X.StellarMessage) -> None:
+    def _message_received(self, peer: Peer, msg: X.StellarMessage,
+                          body: Optional[bytes] = None) -> None:
+        # `body` = the message's own XDR bytes as received (sliced from
+        # the authenticated frame) — the SCP hot path hashes and
+        # re-floods them without a re-encode
         t = msg.switch
         MT = X.MessageType
         # per-message-type intake meter (reference: the per-type
-        # "overlay.recv.*" medida timers in Peer::recvMessage)
-        _registry().meter(_RECV_METER[t]).mark()
+        # "overlay.recv.*" medida timers in Peer::recvMessage); meter
+        # objects cached per manager — a registry lookup per message is
+        # real money at simulated-fleet message rates
+        meter = self._recv_meters.get(t)
+        if meter is None:
+            meter = self._recv_meters[t] = _registry().meter(_RECV_METER[t])
+        meter.mark()
         if t in (MT.SEND_MORE, MT.SEND_MORE_EXTENDED):
             return  # handled in Peer flow control
         if t == MT.SCP_MESSAGE:
-            self._recv_scp(peer, msg)
+            self._recv_scp(peer, msg, body)
         elif t == MT.TRANSACTION:
             self._recv_transaction(peer, msg)
         elif t == MT.FLOOD_ADVERT:
@@ -331,16 +347,19 @@ class OverlayManager:
         if handler(peer, msg.value):
             self._broadcast(msg, h)
 
-    def _recv_scp(self, peer: Peer, msg: X.StellarMessage) -> None:
+    def _recv_scp(self, peer: Peer, msg: X.StellarMessage,
+                  body: Optional[bytes] = None) -> None:
         env = msg.value
-        h = sha256(msg.to_xdr())
+        if body is None:
+            body = msg.to_xdr()
+        h = sha256(body)
         if not self.floodgate.add_record(h, env.statement.slotIndex, peer):
             self.stats["deduped"] += 1
             _registry().meter("overlay.flood.duplicate").mark()
             return
         status = self.herder.recv_scp_envelope(env)
         if status != "discarded":
-            self._broadcast(msg, h)
+            self._broadcast(msg, h, body)
 
     def _recv_transaction(self, peer: Peer, msg: X.StellarMessage) -> None:
         try:

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..xdr import scp as SX
+from . import quorum as Q
 from .driver import BALLOT_PROTOCOL_TIMER, ValidationLevel
 
 StType = SX.SCPStatementType
@@ -58,64 +59,84 @@ class BallotProtocol:
         self.heard_from_quorum = False
         self._advancing = 0
         self.timer_armed_counter = -1
+        # incremental per-slot quorum state (reference: Slot's cached
+        # mHeardFromQuorum edge): per-node counters + compiled qsets +
+        # epoch-keyed verdict memo, maintained in process_envelope
+        self.index = Q.StatementIndex()
+        # node -> compiled statement summary (see _summarize), kept in
+        # lockstep with latest_envelopes
+        self._summaries: Dict[bytes, tuple] = {}
 
     # ------------------------------------------------------------------
-    # statement predicates
+    # statement summaries + predicates
+    #
+    # Every federated-voting predicate runs per NODE per quorum question —
+    # the inner loop of the whole protocol.  Evaluating them against raw
+    # XDR statements pays the lazy-decode descriptor machinery on every
+    # field access (measured: ~25% of a 51-node campaign inside codec
+    # __get__/arm), so each statement is compiled ONCE at intake into a
+    # plain tuple and the predicates read tuples:
+    #
+    #   PREPARE:     (0, ballot, prepared|None, preparedPrime|None, nC, nH)
+    #   CONFIRM:     (1, ballot, nPrepared, nCommit, nH)
+    #   EXTERNALIZE: (2, commit, nH)
+    #
+    # where ballot/commit are (counter, value) tuples.  Same move as
+    # compile_qset for quorum slices (scp/quorum.py).
     # ------------------------------------------------------------------
     @staticmethod
-    def _counter_of(st) -> int:
-        pl = st.pledges
-        if pl.type == StType.SCP_ST_PREPARE:
-            return pl.prepare.ballot.counter
-        if pl.type == StType.SCP_ST_CONFIRM:
-            return pl.confirm.ballot.counter
-        return INT32_MAX
-
-    @staticmethod
-    def _votes_prepare(cand: Ballot, st) -> bool:
-        pl = st.pledges
-        if pl.type == StType.SCP_ST_PREPARE:
-            return less_and_compatible(cand, _b(pl.prepare.ballot))
-        if pl.type == StType.SCP_ST_CONFIRM:
-            return compatible(cand, _b(pl.confirm.ballot))
-        return compatible(cand, _b(pl.externalize.commit))
-
-    @staticmethod
-    def _accepts_prepared(cand: Ballot, st) -> bool:
-        pl = st.pledges
-        if pl.type == StType.SCP_ST_PREPARE:
-            p = pl.prepare.prepared
-            pp = pl.prepare.preparedPrime
-            return ((p is not None and less_and_compatible(cand, _b(p))) or
-                    (pp is not None and less_and_compatible(cand, _b(pp))))
-        if pl.type == StType.SCP_ST_CONFIRM:
-            prepared = (pl.confirm.nPrepared, pl.confirm.ballot.value)
-            return less_and_compatible(cand, prepared)
-        return compatible(cand, _b(pl.externalize.commit))
-
-    @staticmethod
-    def _votes_commit(value: bytes, n: int, st) -> bool:
+    def _summarize(st) -> tuple:
         pl = st.pledges
         if pl.type == StType.SCP_ST_PREPARE:
             pr = pl.prepare
-            return (pr.nC != 0 and pr.ballot.value == value
-                    and pr.nC <= n <= pr.nH)
+            return (0, _b(pr.ballot),
+                    _b(pr.prepared) if pr.prepared is not None else None,
+                    _b(pr.preparedPrime) if pr.preparedPrime is not None
+                    else None, pr.nC, pr.nH)
         if pl.type == StType.SCP_ST_CONFIRM:
-            return (pl.confirm.ballot.value == value
-                    and pl.confirm.nCommit <= n)
+            co = pl.confirm
+            return (1, _b(co.ballot), co.nPrepared, co.nCommit, co.nH)
         ex = pl.externalize
-        return ex.commit.value == value and ex.commit.counter <= n
+        return (2, _b(ex.commit), ex.nH)
 
     @staticmethod
-    def _accepts_commit(value: bytes, n: int, st) -> bool:
-        pl = st.pledges
-        if pl.type == StType.SCP_ST_PREPARE:
+    def _counter_of(ss: tuple) -> int:
+        return ss[1][0] if ss[0] != 2 else INT32_MAX
+
+    @staticmethod
+    def _votes_prepare(cand: Ballot, ss: tuple) -> bool:
+        if ss[0] == 0:
+            return less_and_compatible(cand, ss[1])
+        return compatible(cand, ss[1])   # CONFIRM ballot / EXTERNALIZE commit
+
+    @staticmethod
+    def _accepts_prepared(cand: Ballot, ss: tuple) -> bool:
+        k = ss[0]
+        if k == 0:
+            p, pp = ss[2], ss[3]
+            return ((p is not None and less_and_compatible(cand, p)) or
+                    (pp is not None and less_and_compatible(cand, pp)))
+        if k == 1:
+            return less_and_compatible(cand, (ss[2], ss[1][1]))
+        return compatible(cand, ss[1])
+
+    @staticmethod
+    def _votes_commit(value: bytes, n: int, ss: tuple) -> bool:
+        k = ss[0]
+        if k == 0:
+            return ss[4] != 0 and ss[1][1] == value and ss[4] <= n <= ss[5]
+        if k == 1:
+            return ss[1][1] == value and ss[3] <= n
+        return ss[1][1] == value and ss[1][0] <= n
+
+    @staticmethod
+    def _accepts_commit(value: bytes, n: int, ss: tuple) -> bool:
+        k = ss[0]
+        if k == 0:
             return False
-        if pl.type == StType.SCP_ST_CONFIRM:
-            return (pl.confirm.ballot.value == value
-                    and pl.confirm.nCommit <= n <= pl.confirm.nH)
-        ex = pl.externalize
-        return ex.commit.value == value and ex.commit.counter <= n
+        if k == 1:
+            return ss[1][1] == value and ss[3] <= n <= ss[4]
+        return ss[1][1] == value and ss[1][0] <= n
 
     @staticmethod
     def _prepare_candidates(hint) -> List[Ballot]:
@@ -183,8 +204,10 @@ class BallotProtocol:
     # ------------------------------------------------------------------
     # state mutation helpers
     # ------------------------------------------------------------------
-    def _stmt_map(self):
-        return {n: e.statement for n, e in self.latest_envelopes.items()}
+    def _stmt_map(self) -> Dict[bytes, tuple]:
+        """node -> compiled statement summary (the map every federated
+        predicate runs over); maintained incrementally, never rebuilt."""
+        return self._summaries
 
     def _bump_to_ballot(self, ballot: Ballot, require_ge: bool) -> None:
         got_bumped = self.b is None or self.b[0] != ballot[0]
@@ -238,7 +261,8 @@ class BallotProtocol:
             if ln.federated_accept(
                     lambda st, c=cand: self._votes_prepare(c, st),
                     lambda st, c=cand: self._accepts_prepared(c, st),
-                    stmt_map, qset_of):
+                    stmt_map, qset_of,
+                    index=self.index, key=("acc-prep", cand)):
                 return self._set_accept_prepared(cand)
         return False
 
@@ -270,7 +294,8 @@ class BallotProtocol:
                 break
             if ln.federated_ratify(
                     lambda st, c=cand: self._accepts_prepared(c, st),
-                    stmt_map, qset_of):
+                    stmt_map, qset_of,
+                    index=self.index, key=("rat-prep", cand)):
                 new_h = cand
                 break
         if new_h is None:
@@ -288,7 +313,8 @@ class BallotProtocol:
                     continue
                 if ln.federated_ratify(
                         lambda st, c=cand: self._accepts_prepared(c, st),
-                        stmt_map, qset_of):
+                        stmt_map, qset_of,
+                        index=self.index, key=("rat-prep", cand)):
                     new_c = cand
                     break
         self.z = new_h[1]
@@ -304,19 +330,17 @@ class BallotProtocol:
 
     def _commit_boundaries(self, value: bytes) -> List[int]:
         out: Set[int] = set()
-        for st in self._stmt_map().values():
-            pl = st.pledges
-            if pl.type == StType.SCP_ST_PREPARE:
-                pr = pl.prepare
-                if pr.ballot.value == value and pr.nC != 0:
-                    out.update((pr.nC, pr.nH))
-            elif pl.type == StType.SCP_ST_CONFIRM:
-                if pl.confirm.ballot.value == value:
-                    out.update((pl.confirm.nCommit, pl.confirm.nH))
+        for ss in self._summaries.values():
+            k = ss[0]
+            if k == 0:
+                if ss[1][1] == value and ss[4] != 0:
+                    out.update((ss[4], ss[5]))
+            elif k == 1:
+                if ss[1][1] == value:
+                    out.update((ss[3], ss[4]))
             else:
-                if pl.externalize.commit.value == value:
-                    out.update((pl.externalize.commit.counter,
-                                pl.externalize.nH))
+                if ss[1][1] == value:
+                    out.update((ss[1][0], ss[2]))
         return sorted(out, reverse=True)
 
     @staticmethod
@@ -358,7 +382,8 @@ class BallotProtocol:
                 and self._votes_commit(value, hi, st),
                 lambda st: self._accepts_commit(value, lo, st)
                 and self._accepts_commit(value, hi, st),
-                stmt_map, qset_of)
+                stmt_map, qset_of,
+                index=self.index, key=("acc-commit", value, lo, hi))
 
         lo, hi = self._find_extended_interval(self._commit_boundaries(value),
                                               pred)
@@ -411,7 +436,8 @@ class BallotProtocol:
             return ln.federated_ratify(
                 lambda st: self._votes_commit(value, lo, st)
                 and self._votes_commit(value, hi, st),
-                stmt_map, qset_of)
+                stmt_map, qset_of,
+                index=self.index, key=("rat-commit", value, lo, hi))
 
         lo, hi = self._find_extended_interval(self._commit_boundaries(value),
                                               pred)
@@ -435,8 +461,7 @@ class BallotProtocol:
             return False
         ln = self.slot.local_node
         target = self.b[0] if self.b is not None else 0
-        counters = {n: self._counter_of(st)
-                    for n, st in self._stmt_map().items()}
+        counters = self.index.node_counter   # read-only view, no rebuild
         ahead = sorted({c for c in counters.values() if c > target})
         # v-blocking-ness is monotone in the node set, so only the smallest
         # ahead counter (largest node set) can qualify
@@ -452,11 +477,9 @@ class BallotProtocol:
     def _check_heard_from_quorum(self) -> None:
         if self.b is None:
             return
-        from . import quorum as Q
-        ln, stmt_map = self.slot.local_node, self._stmt_map()
-        heard = Q.is_quorum(
-            ln.qset, stmt_map, self.slot.qset_of_statement,
-            lambda st: self._counter_of(st) >= self.b[0])
+        ln = self.slot.local_node
+        heard = Q.heard_from_quorum(ln.qset, ln.qset_hash, self.index,
+                                    self.b[0])
         if heard:
             was = self.heard_from_quorum
             self.heard_from_quorum = True
@@ -537,6 +560,11 @@ class BallotProtocol:
         if old is not None and not self._is_newer(st, old.statement):
             return False
         self.latest_envelopes[nid] = env
+        ss = self._summarize(st)
+        self._summaries[nid] = ss
+        self.index.note_statement(nid, self._counter_of(ss),
+                                  self.slot.qset_of_statement(st),
+                                  Q.statement_qset_hash(st))
         self._advance_slot(st, from_self=self_env)
         return True
 

@@ -46,20 +46,80 @@ class LocalNode:
     def federated_accept(self, voted: Callable[[object], bool],
                          accepted: Callable[[object], bool],
                          stmt_map: Dict[bytes, object],
-                         qset_of: Callable[[object], Optional[object]]) -> bool:
+                         qset_of: Callable[[object], Optional[object]],
+                         index=None, key=None, latch: bool = False) -> bool:
         """vote→accept: a v-blocking set accepted it, or a quorum voted-or-
-        accepted it."""
+        accepted it.
+
+        `index`/`key`/`latch`: per-slot incremental quorum state (see
+        quorum.StatementIndex) — the whole verdict is memoized under the
+        statement-map epoch, and `latch=True` (monotone predicates only:
+        nomination votes) pins a True verdict for the slot."""
+        k = None
+        if index is not None and key is not None:
+            k = ("fa", key, self.qset_hash)
+            got = index.lookup(k)
+            if got is not None:
+                return got
         accepted_nodes = {n for n, st in stmt_map.items() if accepted(st)}
         if Q.is_v_blocking(self.qset, accepted_nodes):
-            return True
-        return Q.is_quorum(self.qset, stmt_map, qset_of,
-                           lambda st: voted(st) or accepted(st))
+            res = True
+        else:
+            res = Q.is_quorum(self.qset, stmt_map, qset_of,
+                              lambda st: voted(st) or accepted(st),
+                              index=index)
+        if k is not None:
+            index.store(k, res, latch)
+        return res
 
     def federated_ratify(self, voted: Callable[[object], bool],
                          stmt_map: Dict[bytes, object],
-                         qset_of: Callable[[object], Optional[object]]) -> bool:
+                         qset_of: Callable[[object], Optional[object]],
+                         index=None, key=None, latch: bool = False) -> bool:
         """accept→confirm: a quorum accepted it."""
-        return Q.is_quorum(self.qset, stmt_map, qset_of, voted)
+        k = None
+        if index is not None and key is not None:
+            k = ("fr", key, self.qset_hash)
+            got = index.lookup(k)
+            if got is not None:
+                return got
+        res = Q.is_quorum(self.qset, stmt_map, qset_of, voted, index=index)
+        if k is not None:
+            index.store(k, res, latch)
+        return res
 
     def is_v_blocking(self, nodes: Set[bytes]) -> bool:
         return Q.is_v_blocking(self.qset, nodes)
+
+    # --- set-based fast paths ---------------------------------------------
+    # Callers that maintain per-value voter registries incrementally
+    # (nomination: vote sets only grow, so each envelope contributes its
+    # DELTA) pass materialized node sets instead of predicates — the
+    # per-call O(n) statement sweep was the last n^2 term per envelope
+    # at 300 simulated nodes.  Verdicts are memoized/latched through the
+    # same StatementIndex discipline as the predicate forms.
+    def federated_accept_sets(self, voted_nodes: Set[bytes],
+                              accepted_nodes: Set[bytes],
+                              index, key, latch: bool = False) -> bool:
+        k = ("fa", key, self.qset_hash)
+        got = index.lookup(k)
+        if got is not None:
+            return got
+        if Q.is_v_blocking(self.qset, accepted_nodes):
+            res = True
+        else:
+            res = Q.quorum_contains(self.qset,
+                                    voted_nodes | accepted_nodes,
+                                    index.node_cq)
+        index.store(k, res, latch)
+        return res
+
+    def federated_ratify_sets(self, accepted_nodes: Set[bytes],
+                              index, key, latch: bool = False) -> bool:
+        k = ("fr", key, self.qset_hash)
+        got = index.lookup(k)
+        if got is not None:
+            return got
+        res = Q.quorum_contains(self.qset, accepted_nodes, index.node_cq)
+        index.store(k, res, latch)
+        return res

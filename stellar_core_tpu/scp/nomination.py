@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set
 
 from ..xdr import scp as SX
+from . import quorum as Q
 from .driver import NOMINATION_TIMER, ValidationLevel
 
 StType = SX.SCPStatementType
@@ -23,15 +24,38 @@ class NominationProtocol:
         self.accepted: Set[bytes] = set()
         self.candidates: Set[bytes] = set()
         self.latest_nominations: Dict[bytes, object] = {}  # node -> envelope
+        # node -> (votes frozenset, accepted frozenset), in lockstep with
+        # latest_nominations
+        self._summaries: Dict[bytes, tuple] = {}
+        # per-value voter registries, updated with each statement's DELTA
+        # (sound because _is_newer guarantees vote sets only grow): the
+        # federated accept/ratify calls below take these materialized
+        # sets instead of sweeping every statement per value per envelope
+        self._voted_nom: Dict[bytes, set] = {}      # value -> voters
+        self._accepted_nom: Dict[bytes, set] = {}   # value -> accepters
+        # incremental per-slot quorum state over the nomination statement
+        # map; nomination vote sets only ever grow (_is_newer), so
+        # accept/ratify verdicts LATCH per value (quorum.StatementIndex)
+        self.index = Q.StatementIndex()
         self.last_envelope = None            # last nomination we emitted
         self.round_leaders: Set[bytes] = set()
         self.nomination_started = False
         self.latest_composite: Optional[bytes] = None
         self.previous_value = b""
+        # leader-candidate set cache: normalize_qset + qset_nodes build
+        # fresh XDR trees per round otherwise (keyed by local qset hash
+        # so a mid-slot qset change recomputes)
+        self._cand_qset_hash: Optional[bytes] = None
+        self._leader_candidates: Set[bytes] = set()
 
     # --- statement access -------------------------------------------------
-    def _stmt_map(self) -> Dict[bytes, object]:
-        return {n: env.statement for n, env in self.latest_nominations.items()}
+    def _stmt_map(self) -> Dict[bytes, tuple]:
+        """node -> (votes frozenset, accepted frozenset) summary — the
+        map the federated predicates run over.  Compiled once per
+        statement at intake (set membership instead of XDR list scans —
+        same move as ballot.py's statement summaries) and maintained
+        incrementally."""
+        return self._summaries
 
     @staticmethod
     def _nom(st):
@@ -65,10 +89,12 @@ class NominationProtocol:
         return 0
 
     def update_round_leaders(self) -> None:
-        from . import quorum as Q
         ln = self.slot.local_node
-        qset = Q.normalize_qset(ln.qset, remove=ln.node_id)
-        candidates = {ln.node_id} | Q.qset_nodes(qset)
+        if self._cand_qset_hash != ln.qset_hash:
+            qset = Q.normalize_qset(ln.qset, remove=ln.node_id)
+            self._leader_candidates = {ln.node_id} | Q.qset_nodes(qset)
+            self._cand_qset_hash = ln.qset_hash
+        candidates = self._leader_candidates
         top_priority, leaders = 0, set()
         for n in candidates:
             p = self._node_priority(n)
@@ -195,23 +221,34 @@ class NominationProtocol:
         if old is not None and not self._is_newer(st, old.statement):
             return False
         self.latest_nominations[nid] = env
+        nom_st = self._nom(st)
+        old_summary = self._summaries.get(nid)
+        votes_f = frozenset(nom_st.votes)
+        accepted_f = frozenset(nom_st.accepted)
+        self._summaries[nid] = (votes_f, accepted_f)
+        for v in (votes_f if old_summary is None
+                  else votes_f - old_summary[0]):
+            self._voted_nom.setdefault(v, set()).add(nid)
+        for v in (accepted_f if old_summary is None
+                  else accepted_f - old_summary[1]):
+            self._accepted_nom.setdefault(v, set()).add(nid)
+        self.index.note_statement(nid, 0, self.slot.qset_of_statement(st),
+                                  Q.statement_qset_hash(st))
         if not self.nomination_started:
             return True
 
-        stmt_map = self._stmt_map()
-        qset_of = self.slot.qset_of_statement
         ln = self.slot.local_node
         nom = self._nom(st)
         modified = new_candidates = False
+        empty: set = set()
 
         for v in list(nom.votes) + list(nom.accepted):
             if v in self.accepted:
                 continue
-            if ln.federated_accept(
-                    lambda s, v=v: v in self._nom(s).votes
-                    or v in self._nom(s).accepted,
-                    lambda s, v=v: v in self._nom(s).accepted,
-                    stmt_map, qset_of):
+            if ln.federated_accept_sets(
+                    self._voted_nom.get(v, empty),
+                    self._accepted_nom.get(v, empty),
+                    index=self.index, key=("nom-acc", v), latch=True):
                 vv = self._validate(v)
                 if vv is None:
                     continue
@@ -219,9 +256,9 @@ class NominationProtocol:
                 self.votes.add(v)
                 modified = True
         for v in self.accepted - self.candidates:
-            if ln.federated_ratify(
-                    lambda s, v=v: v in self._nom(s).accepted,
-                    stmt_map, qset_of):
+            if ln.federated_ratify_sets(
+                    self._accepted_nom.get(v, empty),
+                    index=self.index, key=("nom-rat", v), latch=True):
                 self.candidates.add(v)
                 new_candidates = True
 

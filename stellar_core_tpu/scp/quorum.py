@@ -26,6 +26,20 @@ def qset_hash(qset) -> bytes:
     return sha256(qset.to_xdr())
 
 
+def statement_qset_hash(st) -> bytes:
+    """The quorum-set hash a statement pledges under (every pledge type
+    carries one)."""
+    pl = st.pledges
+    t = pl.type
+    if t == SX.SCPStatementType.SCP_ST_NOMINATE:
+        return pl.nominate.quorumSetHash
+    if t == SX.SCPStatementType.SCP_ST_PREPARE:
+        return pl.prepare.quorumSetHash
+    if t == SX.SCPStatementType.SCP_ST_CONFIRM:
+        return pl.confirm.quorumSetHash
+    return pl.externalize.commitQuorumSetHash
+
+
 def for_all_nodes(qset, fn: Callable[[NodeIDb], None]) -> None:
     for v in qset.validators:
         fn(v.value)
@@ -128,9 +142,142 @@ def _compiled_slice_ok(cq: tuple, nodes: Set[NodeIDb]) -> bool:
     return False
 
 
+class StatementIndex:
+    """Incremental per-slot quorum state (reference: ``Slot``'s cached
+    ``mHeardFromQuorum`` edge + ``BallotProtocol::checkHeardFromQuorum``).
+
+    The owning protocol (ballot or nomination) calls ``note_statement``
+    every time a node's latest statement is replaced, which keeps three
+    incrementally-maintained views the quorum walks would otherwise
+    re-derive from XDR on EVERY envelope (the ~n^2 cost that kept the
+    300-node soak at offline scale):
+
+    - ``node_counter`` — each node's ballot counter (INT32_MAX for
+      EXTERNALIZE, 0 for nominations), replacing a per-envelope
+      ``{n: _counter_of(st)}`` rebuild;
+    - ``node_cq`` — each node's COMPILED quorum set, replacing the
+      per-``is_quorum``-call ``qset_of(stmt)`` + compile lookup per node;
+    - a verdict memo keyed by the statement-map **epoch** (bumped on
+      every mutation), so repeated quorum questions against an unchanged
+      map answer from cache.
+
+    Monotone verdicts (heard-from-quorum at a fixed counter, nomination
+    accept/ratify of a fixed value) may additionally be **latched**: once
+    True they stay True, because statements only ever get *newer* —
+    counters are non-decreasing and nomination vote sets only grow, so a
+    satisfied quorum predicate cannot be un-satisfied.  The two events
+    that CAN invalidate a latch are handled explicitly: a node changing
+    its announced quorum set mid-slot, and a ballot-counter regression
+    (possible across a PREPARE→CONFIRM phase edge, and cheap insurance
+    against Byzantine statement orderings) — both bump ``qset_epoch``
+    and drop every latch, falling back to a full recompute.
+    """
+
+    __slots__ = ("epoch", "qset_epoch", "node_counter", "node_cq",
+                 "node_qhash", "_memo", "_latched")
+
+    # stale-epoch memo entries never hit; cap the dict so a pathological
+    # slot (many candidate ballots) cannot grow it without bound
+    MEMO_MAX = 8192
+
+    def __init__(self):
+        self.epoch = 0
+        self.qset_epoch = 0
+        self.node_counter: Dict[NodeIDb, int] = {}
+        self.node_cq: Dict[NodeIDb, Optional[tuple]] = {}
+        self.node_qhash: Dict[NodeIDb, bytes] = {}
+        self._memo: Dict[tuple, tuple] = {}    # key -> (epoch, verdict)
+        self._latched: set = set()
+
+    def note_statement(self, node_id: NodeIDb, counter: int,
+                       qset, qhash: bytes) -> None:
+        """Record that `node_id`'s latest statement is now (counter,
+        qset).  `qset` may be None when the referenced set is not yet
+        fetched — the quorum walks then skip the node, exactly as the
+        uncached path did."""
+        self.epoch += 1
+        if len(self._memo) > self.MEMO_MAX:
+            self._memo.clear()
+        pc = self.node_counter.get(node_id)
+        oh = self.node_qhash.get(node_id)
+        if (pc is not None and counter < pc) or \
+                (oh is not None and oh != qhash):
+            self.qset_epoch += 1
+            self._latched.clear()
+        self.node_counter[node_id] = counter
+        self.node_cq[node_id] = None if qset is None \
+            else compile_qset_cached(qset)
+        self.node_qhash[node_id] = qhash
+
+    def lookup(self, key: tuple) -> Optional[bool]:
+        if key in self._latched:
+            return True
+        got = self._memo.get(key)
+        if got is not None and got[0] == self.epoch:
+            return got[1]
+        return None
+
+    def store(self, key: tuple, verdict: bool, latch: bool = False) -> None:
+        if latch and verdict:
+            self._latched.add(key)
+        else:
+            self._memo[key] = (self.epoch, verdict)
+
+
+def quorum_survivors(nodes: Set[NodeIDb],
+                     node_cq: Dict[NodeIDb, Optional[tuple]]
+                     ) -> Set[NodeIDb]:
+    """Transitive fixpoint over compiled qsets: repeatedly drop nodes
+    whose own quorum set has no slice inside the surviving set (the core
+    of LocalNode::isQuorum).  Nodes sharing one compiled qset share ONE
+    slice evaluation per iteration."""
+    while True:
+        verdicts: Dict[int, bool] = {}
+        keep = set()
+        for n in nodes:
+            cq = node_cq.get(n)
+            if cq is None:
+                continue
+            ok = verdicts.get(id(cq))
+            if ok is None:
+                ok = verdicts[id(cq)] = _compiled_slice_ok(cq, nodes)
+            if ok:
+                keep.add(n)
+        if keep == nodes:
+            return nodes
+        nodes = keep
+
+
+def quorum_contains(local_qset, nodes: Set[NodeIDb],
+                    node_cq: Dict[NodeIDb, Optional[tuple]]) -> bool:
+    """is_quorum over an ALREADY-MATERIALIZED voting-node set (callers
+    that maintain per-value voter registries incrementally skip the
+    per-call O(n) predicate sweep entirely)."""
+    return _compiled_slice_ok(compile_qset_cached(local_qset),
+                              quorum_survivors(set(nodes), node_cq))
+
+
+def heard_from_quorum(local_qset, local_qset_hash: bytes,
+                      index: StatementIndex, min_counter: int) -> bool:
+    """Latched heard-from-quorum: do the voting nodes (ballot counter >=
+    `min_counter`) contain a transitively-closed quorum with a slice of
+    `local_qset`?  Verdicts latch per (counter, local qset) — see
+    StatementIndex."""
+    key = ("hfq", min_counter, local_qset_hash)
+    got = index.lookup(key)
+    if got is not None:
+        return got
+    voted = {n for n, c in index.node_counter.items() if c >= min_counter}
+    res = _compiled_slice_ok(compile_qset_cached(local_qset),
+                             quorum_survivors(voted, index.node_cq))
+    index.store(key, res, latch=True)
+    return res
+
+
 def is_quorum(local_qset, stmt_map: Dict[NodeIDb, object],
               qset_of: Callable[[object], Optional[object]],
-              voted: Callable[[object], bool]) -> bool:
+              voted: Callable[[object], bool],
+              index: Optional[StatementIndex] = None) -> bool:
     """True iff the nodes whose statement satisfies `voted` contain a quorum
     that includes a slice of local_qset.
 
@@ -142,28 +289,21 @@ def is_quorum(local_qset, stmt_map: Dict[NodeIDb, object],
     tier-1-shaped network announces the same hierarchical set) share ONE
     compiled form and ONE slice evaluation per fixpoint iteration instead
     of re-walking the XDR tree per node.
+
+    With an `index` (StatementIndex maintained by the owning protocol),
+    each node's compiled qset comes from the incremental per-slot view
+    instead of a `qset_of` lookup + compile per node per call.
     """
     nodes = {n for n, st in stmt_map.items() if voted(st)}
-    node_cq: Dict[NodeIDb, Optional[tuple]] = {}
-    for n in nodes:
-        q = qset_of(stmt_map[n])
-        node_cq[n] = None if q is None else compile_qset_cached(q)
-    while True:
-        verdicts: Dict[int, bool] = {}  # id(compiled) -> slice-in-`nodes`
-        keep = set()
+    if index is not None:
+        node_cq = index.node_cq
+    else:
+        node_cq = {}
         for n in nodes:
-            cq = node_cq[n]
-            if cq is None:
-                continue
-            ok = verdicts.get(id(cq))
-            if ok is None:
-                ok = verdicts[id(cq)] = _compiled_slice_ok(cq, nodes)
-            if ok:
-                keep.add(n)
-        if keep == nodes:
-            break
-        nodes = keep
-    return _compiled_slice_ok(compile_qset_cached(local_qset), nodes)
+            q = qset_of(stmt_map[n])
+            node_cq[n] = None if q is None else compile_qset_cached(q)
+    return _compiled_slice_ok(compile_qset_cached(local_qset),
+                              quorum_survivors(nodes, node_cq))
 
 
 def find_closest_v_blocking(qset, nodes: Set[NodeIDb],

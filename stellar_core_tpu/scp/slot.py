@@ -13,6 +13,7 @@ from ..xdr import scp as SX
 from ..xdr import types as XT
 from .ballot import BallotProtocol
 from .nomination import NominationProtocol
+from .quorum import statement_qset_hash
 
 StType = SX.SCPStatementType
 
@@ -35,15 +36,7 @@ class Slot:
 
     def qset_of_statement(self, st):
         """Quorum set referenced by a statement (None if unknown)."""
-        pl = st.pledges
-        if pl.type == StType.SCP_ST_NOMINATE:
-            h = pl.nominate.quorumSetHash
-        elif pl.type == StType.SCP_ST_PREPARE:
-            h = pl.prepare.quorumSetHash
-        elif pl.type == StType.SCP_ST_CONFIRM:
-            h = pl.confirm.quorumSetHash
-        else:
-            h = pl.externalize.commitQuorumSetHash
+        h = statement_qset_hash(st)
         if st.nodeID.value == self.local_node.node_id \
                 and h == self.local_node.qset_hash:
             return self.local_node.qset

@@ -65,17 +65,159 @@ class SimNode:
         # per-category status lines, same manager a full Application runs
         # (main/status) — evaluate_health reuses it unchanged
         self.status = StatusManager()
+        # in-sim history archive (attach_history): real publish path +
+        # real archive catchup when the gap exceeds the fleet's slot memory
+        self.archive = None
+        self.history = None
+        self.catchup_parallel = 1
+        self._catching_up = False
         self.herder.ledger_closed_hook = self._on_ledger_closed
         self.herder.out_of_sync_handler = self._on_out_of_sync
+        self.herder.sync_gap_hook = self.maybe_archive_catchup
+
+    def attach_history(self, archive, publish: bool = True,
+                       parallel: int = 1) -> None:
+        """Attach a history archive (history.archive.FileHistoryArchive —
+        typically one directory SHARED by the fleet, like a production
+        network's archive mirrors): with `publish` this node writes real
+        checkpoints as ledgers close (HistoryManager), and either way a
+        stall past ``MAX_SLOTS_TO_REMEMBER`` recovers through real
+        archive catchup (``parallel`` > 1 routes it through
+        ``catchup --parallel``-style range workers)."""
+        from ..history.manager import HistoryManager
+        self.archive = archive
+        self.catchup_parallel = parallel
+        if publish:
+            self.history = HistoryManager(
+                self.lm, self.sim.network_passphrase.decode(), [archive])
 
     def _on_out_of_sync(self) -> None:
-        # pull recent SCP state from peers (reference: getMoreSCPState;
-        # archive-based catchup takes over when the gap exceeds
-        # MAX_SLOTS_TO_REMEMBER)
+        # pull recent SCP state from peers (reference: getMoreSCPState);
+        # the sync_gap_hook hands off to archive catchup when the
+        # buffered-externalize queue proves the gap exceeds the peers'
+        # slot memory
         self.overlay.request_scp_state()
 
     def _on_ledger_closed(self, arts) -> None:
         self.closed[arts.header_entry.header.ledgerSeq] = arts.header_entry.hash
+        # floodgate GC, exactly like a full Application's close hook: a
+        # bounded record map ALSO means a replayed stale envelope reads
+        # as new and reaches the herder's slot-memory discard (the
+        # byzantine stale-replay scenarios assert that path)
+        self.overlay.clear_below(
+            max(0, self.lm.last_closed_ledger_seq - 100))
+        if self.history is not None:
+            self.history.ledger_closed(arts)
+        if self.status.get_status("history-catchup") is not None \
+                and self.herder.state == HerderState.TRACKING:
+            # archive recovery complete: the node is closing live ledgers
+            # again — /health flips from "catching-up" back to "ok"
+            self.status.clear_status("history-catchup")
+
+    # -- archive catchup (out-of-sync -> archive -> re-tracking) -----------
+    def maybe_archive_catchup(self) -> None:
+        """The handoff the reference calls CatchupManager::processLedger →
+        startCatchup: when the next slot this node needs is older than
+        any peer remembers (gap > MAX_SLOTS_TO_REMEMBER), SCP-state
+        replays cannot help — resync from the archive, then bridge the
+        remaining slots through the normal buffered-externalize path."""
+        from ..herder.herder import MAX_SLOTS_TO_REMEMBER
+        if self.archive is None or self._catching_up:
+            return
+        buffered = self.herder._buffered
+        net_tip = max(buffered, default=self.lcl)
+        if net_tip - self.lcl <= MAX_SLOTS_TO_REMEMBER:
+            return   # peers' slot memory still covers the gap
+        try:
+            has = self.archive.get_state()
+        except (ValueError, OSError):
+            return   # unreadable HAS: keep trying the SCP-state path
+        if has is None or has.current_ledger <= self.lcl:
+            return   # nothing newer published yet
+        self.run_archive_catchup()
+
+    def run_archive_catchup(self) -> None:
+        """Run REAL archive catchup (hash-verified header chain, bucket
+        apply, tx replay — `catchup --parallel` range workers when
+        `catchup_parallel` > 1) and adopt the resulting ledger state into
+        the live node."""
+        from ..catchup.catchup import CatchupError, CatchupManager
+        from ..history.archive import checkpoint_frequency
+        from ..util import eventlog
+        self._catching_up = True
+        self.herder.recovery_stats["archive_catchups"] += 1
+        self.status.set_status(
+            "history-catchup",
+            f"catching up from archive (lcl {self.lcl} is beyond the "
+            f"fleet's slot memory)")
+        eventlog.record("History", "INFO", "sim archive catchup start",
+                        node=self.node_id.hex()[:8], lcl=self.lcl,
+                        parallel=self.catchup_parallel)
+        try:
+            if self.catchup_parallel > 1:
+                from ..catchup.parallel import ParallelCatchup
+                pc = ParallelCatchup(
+                    self.archive.root,
+                    self.sim.network_passphrase.decode(),
+                    workers=self.catchup_parallel)
+                try:
+                    pc.run()
+                    new_lm = pc.load_manager()
+                    # sim nodes are in-memory: detach the loaded
+                    # manager's persistence (it points into the
+                    # throwaway range workdir) BEFORE that dir is
+                    # reclaimed below
+                    new_lm.db = None
+                    new_lm.bucket_dir = None
+                finally:
+                    pc.cleanup()
+            else:
+                cm = CatchupManager(self.sim.network_id,
+                                    self.sim.network_passphrase.decode())
+                new_lm = cm.catchup_recent(self.archive,
+                                           count=checkpoint_frequency())
+        except CatchupError as e:
+            log.warning("sim archive catchup failed at lcl=%d: %s",
+                        self.lcl, e)
+            eventlog.record("History", "ERROR", "sim archive catchup FAILED",
+                            node=self.node_id.hex()[:8], detail=str(e))
+            # the node is NOT catching up anymore — it is stuck.  Clear
+            # the category so /health reports plain "degraded" (needs
+            # attention), not the transient "catching-up" ("will be
+            # back"); the failure detail lives in the flight recorder.
+            # A later gap signal retries and re-sets the status.
+            self.status.clear_status("history-catchup")
+            self._catching_up = False
+            return
+        self._adopt_ledger_manager(new_lm)
+        try:
+            # bridge archive tip -> live consensus: apply whatever the
+            # buffered-externalize queue already holds, then re-pull SCP
+            # state for the remainder (guard still held: the drain's own
+            # dead-end signal must not re-enter catchup against the same
+            # archive tip)
+            self.herder._drain_buffered()
+            self.overlay.request_scp_state()
+        finally:
+            self._catching_up = False
+
+    def _adopt_ledger_manager(self, new_lm: LedgerManager) -> None:
+        from ..util import eventlog
+        old = self.lcl
+        self.lm = new_lm
+        self.herder.lm = new_lm
+        self.herder.tx_queue.lm = new_lm
+        if self.history is not None:
+            self.history.ledger_mgr = new_lm
+            # artifacts for the skipped range were never closed here; the
+            # straddling checkpoint window must not be published with holes
+            self.history.resume_from(new_lm.last_closed_ledger_seq + 1)
+        eventlog.record("History", "INFO", "sim archive state adopted",
+                        node=self.node_id.hex()[:8], from_lcl=old,
+                        to_lcl=new_lm.last_closed_ledger_seq)
+        log.info("sim node %s adopted archive state: lcl %d -> %d",
+                 self.node_id.hex()[:8], old,
+                 new_lm.last_closed_ledger_seq)
 
     # -- convenience -------------------------------------------------------
     @property
@@ -112,6 +254,7 @@ class Simulation:
     def __init__(self, network_passphrase: bytes = b"sim network",
                  mode: str = OVER_LOOPBACK,
                  seed: Optional[int] = None):
+        self.network_passphrase = network_passphrase
         self.network_id = sha256(network_passphrase)
         self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)
         self.nodes: List[SimNode] = []
@@ -311,6 +454,57 @@ def make_hierarchical_topology(n_orgs: int, nodes_per_org: int = 3,
         for s in org:
             sim.add_node(s, outer)
     return sim
+
+
+def make_intersection_violation_topology(group_size: int = 2,
+                                         passphrase: bytes = b"sim split",
+                                         seed: Optional[int] = None
+                                         ) -> Simulation:
+    """GENERATED INTERSECTION-VIOLATION AXIS: two disjoint near-quorums
+    bridged by ONE shared validator z (the last node).  Group A nodes
+    trust {A, z} unanimously, group B nodes trust {B, z} unanimously, and
+    z itself follows side A — so every A-side quorum is {A, z} and every
+    B-side quorum is {B, z}: they intersect ONLY at z.  The survey's
+    safety precondition (quorum intersection at honest nodes —
+    `QuorumIntersectionChecker`) fails by exactly one node: with z
+    honest the network behaves (z's value reaches both sides), with z
+    equivocating the two sides can commit different values for the same
+    slot, and the per-crank safety assertion MUST flag the fork.
+    Unanimous thresholds make every member v-blocking for its group
+    (one equivocator drives each side's federated accepts), and z
+    announces a SELF-SINGLETON quorum set — required for either side's
+    transitive quorum to close over z, and the honest-looking shape a
+    real saboteur would pick."""
+    from ..crypto.sha import sha256
+    from ..scp.quorum import singleton_qset
+    sim = Simulation(passphrase, seed=seed)
+    a = [SecretKey(sha256(b"split-a-%d" % i)) for i in range(group_size)]
+    b = [SecretKey(sha256(b"split-b-%d" % i)) for i in range(group_size)]
+    z = SecretKey(sha256(b"split-bridge"))
+    a_ids = [s.public_key.ed25519 for s in a]
+    b_ids = [s.public_key.ed25519 for s in b]
+    z_id = z.public_key.ed25519
+    qset_a = qset_of(a_ids + [z_id], group_size + 1)   # unanimous
+    qset_b = qset_of(b_ids + [z_id], group_size + 1)
+    for s in a:
+        sim.add_node(s, qset_a)
+    for s in b:
+        sim.add_node(s, qset_b)
+    sim.add_node(z, singleton_qset(z_id))
+    return sim
+
+
+def split_brain_links(group_size: int = 2):
+    """Overlay graph for the intersection-violation topology: each group
+    meshed internally, the bridge z connected to everyone, NO direct
+    A-B links (each side hears the other only through z's relay)."""
+    a = list(range(group_size))
+    b = list(range(group_size, 2 * group_size))
+    z = 2 * group_size
+    links = {frozenset((i, j)) for i in a for j in a if i < j}
+    links |= {frozenset((i, j)) for i in b for j in b if i < j}
+    links |= {frozenset((i, z)) for i in a + b}
+    return links
 
 
 def make_asymmetric_topology(n_core_orgs: int, nodes_per_org: int = 3,

@@ -52,6 +52,7 @@ CANONICAL_METRICS = frozenset({
     "herder.ledger.externalize",
     "herder.tx-queue.depth",
     "herder.tx-queue.banned",
+    "herder.scp.envelope-discarded",
     # admission (batched intake verification, herder/admission.py)
     "herder.admission.depth",
     "herder.admission.latency",

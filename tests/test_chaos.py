@@ -348,6 +348,195 @@ class TestSmallScenarios:
 
 
 # ---------------------------------------------------------------------------
+# byzantine fault family (ISSUE 12 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestByzantine:
+    def test_equivocation_healthy_intersection_never_forks(self):
+        """A signing validator equivocating (different value per peer
+        group, same slot/ballot), another emitting conflicting
+        nominations, plus stale-slot replays — in a topology where
+        quorum intersection HOLDS.  SCP's safety claim: honest nodes
+        never externalize divergent hashes; the runner's per-crank
+        safety assertion is the proof.  Stale replays must be binned by
+        the receivers' slot-memory window check (metered + flight
+        recorded)."""
+        from stellar_core_tpu.util.metrics import registry
+        meter = registry().meter("herder.scp.envelope-discarded")
+        d0 = meter.count
+        res = run_scenario(C.scenario_byzantine_equivocation(4, 3))
+        assert res.passed, res.violations
+        byz = {r["node"]: r["byzantine"] for r in res.node_records
+               if "byzantine" in r}
+        assert set(byz) == {1, 3}
+        assert byz[1]["equivocal_sent"] > 0
+        assert byz[1]["stale_replayed"] > 0
+        # every replayed stale envelope was discarded at the window
+        # check — visible on the meter (satellite: the silent dead-end
+        # is silent no more)
+        assert meter.count - d0 >= byz[1]["stale_replayed"]
+        # ... and in the flight recorder, with the reason attached
+        events = [e for e in eventlog.event_log().snapshot()
+                  if e["msg"] == "scp envelope discarded"]
+        assert any(e["fields"].get("reason") == "below-memory-window"
+                   for e in events)
+        # honest nodes all finished healthy and tracking
+        honest = [r for r in res.node_records if r["node"] not in byz]
+        assert all(r["herder_state"] == "tracking" for r in honest)
+
+    def test_intersection_violation_fork_flagged_with_artifact(
+            self, tmp_path):
+        """The generated intersection-violation axis: two disjoint
+        near-quorums bridged by one equivocating signing validator MUST
+        fork — and the safety checker must flag it against the honest
+        nodes' divergent closes (never the adversary's own bookkeeping),
+        with a replayable artifact."""
+        sc = C.scenario_intersection_violation(2)
+        assert sc.expect_failure == "safety"
+        res = run_scenario(sc, artifact_dir=str(tmp_path))
+        assert not res.passed
+        assert {v.kind for v in res.violations} == {"safety"}
+        # the fork is attributed to honest B-side nodes (2/3), never to
+        # the byzantine bridge (node 4)
+        for v in res.violations:
+            assert "node 4 " not in v.detail
+        art = json.load(open(res.artifact_path))
+        assert any("ByzantineNode" in s for s in art["schedule"])
+        bridge = art["node_records"][-1]
+        assert bridge["byzantine"]["equivocal_sent"] > 0
+        assert res.crash_bundle_path and os.path.exists(
+            res.crash_bundle_path)
+
+    def test_variant_statements_are_sane_and_properly_signed(self):
+        """Equivocal variants must be indistinguishable from honest
+        statements at the envelope layer: structurally sane and carrying
+        a valid signature from the node's REAL key — otherwise receivers
+        would just drop them and the fault would test nothing."""
+        from stellar_core_tpu.scp.ballot import BallotProtocol
+        sim = make_core_topology(4, seed=3)
+        links = C.mesh_links(4)
+        sc = _mini_core_scenario(3, [], n=4)
+        runner = ChaosRunner(sc)
+        runner.sim, runner.base_links = sim, links
+        for key in links:
+            ia, ib = tuple(key)
+            sim.connect(sim.nodes[ia], sim.nodes[ib])
+        sim.start_all_nodes(mesh=False)
+        assert sim.crank_until_ledger(2, timeout=60)
+        engine = C._ByzantineEngine(runner, 0)
+        engine.equivocate = True
+        node = sim.nodes[0]
+        env = None
+        for idx in sorted(node.herder.scp.slots, reverse=True):
+            slot = node.herder.scp.slots[idx]
+            env = slot.ballot.last_envelope or slot.nomination.last_envelope
+            if env is not None:
+                break
+        assert env is not None
+        variant = engine._variant(env, 1)
+        assert variant is not env
+        st = variant.statement
+        if st.pledges.type != C.SX.SCPStatementType.SCP_ST_NOMINATE:
+            assert BallotProtocol._sane(st)
+        # a DIFFERENT statement for the same slot, same node...
+        assert st.slotIndex == env.statement.slotIndex
+        assert st.to_xdr() != env.statement.to_xdr()
+        # ...that verifies under the node's real validator key
+        assert node.herder.verify_envelope(variant)
+
+
+# ---------------------------------------------------------------------------
+# in-sim archive recovery (ISSUE 12 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestArchiveRecovery:
+    def test_stall_past_slot_memory_retracks_via_archive(self):
+        """The full incident shape, asserted end to end: stall past
+        MAX_SLOTS_TO_REMEMBER -> SCP-state pull dead-ends -> REAL
+        archive catchup (published by the healthy fleet in-sim) ->
+        adoption -> buffered-externalize bridge -> re-tracking."""
+        from stellar_core_tpu.history.archive import checkpoint_frequency
+        res = run_scenario(C.scenario_archive_recovery(4, 3))
+        assert res.passed, res.violations
+        assert len(res.recoveries) == 1
+        stalled = res.node_records[-1]
+        stats = stalled["recovery_stats"]
+        assert stats["archive_catchups"] == 1, stats
+        assert stats["out_of_sync"] >= 1
+        assert stalled["herder_state"] == "tracking"
+        assert stalled["health"] == "ok"
+        # the campaign-scoped checkpoint cadence was restored
+        assert checkpoint_frequency() == 64
+        # the handoff left its flight-recorder trail
+        msgs = [e["msg"] for e in eventlog.event_log().snapshot()]
+        assert "sim archive catchup start" in msgs
+        assert "sim archive state adopted" in msgs
+
+    def test_recovery_via_parallel_catchup_workers(self):
+        """Same handoff through the `catchup --parallel` route: real
+        range-worker subprocesses seeded by assume-state, stitch-proven,
+        then adopted into the live sim node."""
+        res = run_scenario(C.scenario_archive_recovery(4, 3, parallel=2))
+        assert res.passed, res.violations
+        stalled = res.node_records[-1]
+        assert stalled["recovery_stats"]["archive_catchups"] == 1
+        assert stalled["herder_state"] == "tracking"
+
+    def test_catching_up_health_status_is_distinct(self):
+        """/health during archive catchup answers the DISTINCT
+        "catching-up" status (vs plain degraded out-of-sync) and flips
+        back to ok once the node re-tracks."""
+        sim = make_core_topology(3, seed=1)
+        sim.start_all_nodes()
+        assert sim.crank_until_ledger(2, timeout=60)
+        node = sim.nodes[0]
+        assert node.evaluate_health()["status"] == "ok"
+        node.status.set_status("history-catchup",
+                               "catching up from archive to 64")
+        doc = node.evaluate_health()
+        assert doc["status"] == "catching-up"
+        assert doc["checks"]["catching_up"] is True
+        assert any("catching up from archive" in r for r in doc["reasons"])
+        assert not node.is_healthy()   # load balancers route around it
+        node.status.clear_status("history-catchup")
+        assert node.evaluate_health()["status"] == "ok"
+
+    def test_publish_floor_skips_straddled_checkpoint(self, tmp_path):
+        """After adoption the recovering node has NO artifacts for the
+        skipped range: HistoryManager.resume_from must skip the boundary
+        whose window straddles the adoption instead of publishing a
+        stream with holes (which would poison later catchups)."""
+        from stellar_core_tpu.history import archive as A
+        from stellar_core_tpu.history.manager import HistoryManager
+        from stellar_core_tpu.simulation.loadgen import LoadGenerator
+        from stellar_core_tpu.ledger.manager import LedgerManager
+        from stellar_core_tpu.crypto.sha import sha256
+        prev = A.checkpoint_frequency()
+        A.set_checkpoint_frequency(8)
+        try:
+            archive = A.FileHistoryArchive(str(tmp_path))
+            mgr = LedgerManager(sha256(b"floor net"))
+            mgr.start_new_ledger()
+            hm = HistoryManager(mgr, "floor net", [archive])
+            gen = LoadGenerator(mgr, history=hm)
+            while mgr.last_closed_ledger_seq < 9:
+                gen.close_empty_ledger()
+            assert hm.published_checkpoints == [7]
+            # adoption at ledger 12: the node skipped 10..12
+            hm.resume_from(13)
+            while mgr.last_closed_ledger_seq < 18:
+                gen.close_empty_ledger()
+            # boundary 15 straddles the hole -> skipped; the NEXT full
+            # window (boundary 23) publishes again
+            assert hm.published_checkpoints == [7]
+            while mgr.last_closed_ledger_seq < 24:
+                gen.close_empty_ledger()
+            assert hm.published_checkpoints == [7, 23]
+        finally:
+            A.set_checkpoint_frequency(prev)
+
+
+# ---------------------------------------------------------------------------
 # soak tier (-m slow): 100-300 nodes
 # ---------------------------------------------------------------------------
 

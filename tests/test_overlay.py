@@ -64,6 +64,27 @@ class TestFraming:
 # ---------------------------------------------------------------------------
 # auth primitives
 
+class TestFrameSplice:
+    def test_spliced_authenticated_frame_matches_object_path(self):
+        """_send_authenticated splices the AuthenticatedMessage bytes
+        from the pre-encoded body (union arm + sequence + message + MAC)
+        — must be byte-identical to building the object and encoding
+        it."""
+        import struct
+        msg = X.StellarMessage.getPeers()
+        body = msg.to_xdr()
+        mac = b"\xab" * 32
+        for seq in (0, 7, 2**40):
+            am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
+                sequence=seq, message=msg,
+                mac=X.HmacSha256Mac(mac=mac)))
+            spliced = (b"\x00\x00\x00\x00" + struct.pack(">Q", seq)
+                       + body + mac)
+            assert am.to_xdr() == spliced
+            # and the receiver's body slice inverts the splice
+            assert spliced[12:len(spliced) - 32] == body
+
+
 class TestPeerAuth:
     def _auth(self, seed, now=lambda: 1000):
         return PeerAuth(SecretKey(seed), NID, now, auth_seed=seed)
@@ -401,7 +422,7 @@ class TestTCPTransportEdgeCases:
             got = []
             orig = ob._message_received
             ob._message_received = \
-                lambda peer, m: (got.append(m), orig(peer, m))
+                lambda peer, m, **kw: (got.append(m), orig(peer, m, **kw))
             pa.send_message(msg)
             ok = ca.crank_until(
                 lambda: any(m.switch == X.MessageType.TX_SET for m in got),

@@ -306,6 +306,134 @@ class TestStatementSanity:
         assert BallotProtocol._sane(st, self_st=True)
 
 
+class TestHeardFromQuorumCache:
+    """The incremental per-slot quorum state (quorum.StatementIndex,
+    reference: Slot::mHeardFromQuorum) must answer EXACTLY what a
+    from-scratch is_quorum walk over the raw statements answers — across
+    ballot bumps, qset changes mid-slot, counter regressions and the
+    threshold-0 edge."""
+
+    @staticmethod
+    def _raw_counter(st):
+        pl = st.pledges
+        if pl.type == SX.SCPStatementType.SCP_ST_PREPARE:
+            return pl.prepare.ballot.counter
+        if pl.type == SX.SCPStatementType.SCP_ST_CONFIRM:
+            return pl.confirm.ballot.counter
+        return 2**31 - 1
+
+    def _scratch(self, slot):
+        """The pre-cache implementation: full is_quorum over the raw
+        latest envelopes with per-call qset resolution."""
+        from stellar_core_tpu.scp import quorum as Q
+        bp = slot.ballot
+        if bp.b is None:
+            return None
+        stmts = {n: e.statement for n, e in bp.latest_envelopes.items()}
+        return Q.is_quorum(slot.local_node.qset, stmts,
+                           slot.qset_of_statement,
+                           lambda st: self._raw_counter(st) >= bp.b[0])
+
+    def _cached(self, slot):
+        from stellar_core_tpu.scp import quorum as Q
+        bp = slot.ballot
+        if bp.b is None:
+            return None
+        ln = slot.local_node
+        return Q.heard_from_quorum(ln.qset, ln.qset_hash, bp.index, bp.b[0])
+
+    def _prep_env(self, i, counter, value, qset_hash, slot=1):
+        pr = SX.SCPPrepare(quorumSetHash=qset_hash,
+                           ballot=SX.SCPBallot(counter=counter, value=value),
+                           prepared=None, preparedPrime=None, nC=0, nH=0)
+        st = SX.SCPStatement(nodeID=XT.node_id(nid(i)), slotIndex=slot,
+                             pledges=SX.SCPStatementPledges.prepare(pr))
+        return SX.SCPEnvelope(statement=st, signature=b"\0" * 64)
+
+    def _make_slot(self, threshold=3, n=4):
+        bus = Bus(n, threshold)
+        node = bus.nodes[nid(0)]
+        slot = node.get_slot(1)
+        slot.bump_state(b"v" * 32, force=True)   # sets b=(1, v)
+        return bus, slot
+
+    def test_cached_matches_scratch_as_quorum_forms(self):
+        bus, slot = self._make_slot()
+        qh = S.qset_hash(next(iter(bus.qsets.values())))
+        val = b"v" * 32
+        # statements arrive one by one; the verdict must track scratch at
+        # every step, through the False -> True transition
+        for i in (1, 2, 3):
+            assert self._cached(slot) == self._scratch(slot)
+            slot.process_envelope(self._prep_env(i, 1, val, qh))
+        assert self._cached(slot) is True
+        assert self._cached(slot) == self._scratch(slot)
+        assert slot.ballot.heard_from_quorum
+
+    def test_cached_matches_scratch_across_ballot_bumps(self):
+        bus, slot = self._make_slot()
+        qh = S.qset_hash(next(iter(bus.qsets.values())))
+        val = b"v" * 32
+        for i in (1, 2, 3):
+            slot.process_envelope(self._prep_env(i, 1, val, qh))
+        assert self._cached(slot) is True
+        # peers move to counter 3: heard at the OLD counter stays true
+        # (monotone latch), heard at the new counter must re-evaluate —
+        # and the protocol's own bump (v-blocking ahead) resets the edge
+        for i in (1, 2):
+            slot.process_envelope(self._prep_env(i, 3, val, qh))
+            assert self._cached(slot) == self._scratch(slot)
+        slot.process_envelope(self._prep_env(3, 3, val, qh))
+        assert slot.ballot.b[0] >= 3   # _attempt_bump chased the fleet
+        assert self._cached(slot) == self._scratch(slot) == True  # noqa: E712
+
+    def test_qset_change_mid_slot_invalidates_latch(self):
+        # local qset = unanimous 4-of-4: losing ONE member's slice breaks
+        # the quorum, so a mid-slot qset change must flip the verdict
+        bus, slot = self._make_slot(threshold=4)
+        qh = S.qset_hash(next(iter(bus.qsets.values())))
+        val = b"v" * 32
+        for i in (1, 2, 3):
+            slot.process_envelope(self._prep_env(i, 1, val, qh))
+        assert self._cached(slot) == self._scratch(slot) == True  # noqa: E712
+        # node 3 re-announces under a foreign qset nobody here satisfies;
+        # its newer statement (same counter, higher value) replaces the
+        # old one and the latched True MUST be dropped, not served stale
+        foreign = make_qset([nid(9)], 1)
+        bus.qsets[S.qset_hash(foreign)] = foreign
+        slot.process_envelope(
+            self._prep_env(3, 1, b"w" * 32, S.qset_hash(foreign)))
+        assert self._scratch(slot) is False
+        assert self._cached(slot) == self._scratch(slot)
+
+    def test_threshold_zero_edge(self):
+        # a threshold-0 local qset is trivially satisfied (PR 6 review
+        # edge: the compiled walker must agree with is_quorum_slice) —
+        # heard-from-quorum must answer True even with zero voters
+        from stellar_core_tpu.scp import quorum as Q
+        q0 = make_qset([nid(7)], 0)
+        idx = Q.StatementIndex()
+        assert Q.heard_from_quorum(q0, S.qset_hash(q0), idx, 1) is True
+        stmts = {}
+        assert Q.is_quorum(q0, stmts, lambda st: None, lambda st: True)
+
+    def test_statement_index_counter_regression_drops_latch(self):
+        """A node whose newer statement carries a LOWER counter (legal
+        across a PREPARE->CONFIRM phase edge; trivial for a Byzantine
+        orderer) must invalidate monotone latches — the voted set can
+        shrink, so a latched True is no longer safe to serve."""
+        from stellar_core_tpu.scp import quorum as Q
+        q = make_qset([nid(1)], 1)
+        cq_holder = make_qset([nid(1)], 1)
+        idx = Q.StatementIndex()
+        idx.note_statement(nid(1), 5, cq_holder, b"h1")
+        assert Q.heard_from_quorum(q, b"local", idx, 5) is True
+        assert idx.lookup(("hfq", 5, b"local")) is True   # latched
+        idx.note_statement(nid(1), 2, cq_holder, b"h1")   # regression
+        assert idx.lookup(("hfq", 5, b"local")) is None   # latch dropped
+        assert Q.heard_from_quorum(q, b"local", idx, 5) is False
+
+
 def test_watcher_nominate_returns_false():
     bus = Bus(3)
     qset = next(iter(bus.qsets.values()))
