@@ -1,9 +1,19 @@
-.PHONY: native test lint race metrics obs bucketdb bucketdb-slow chaos \
-	chaos-byz chaos-soak loadgen loadgen-slow catchup-par fleet \
-	fleet-soak clean
+.PHONY: native native-live test lint race metrics obs bucketdb \
+	bucketdb-slow chaos chaos-byz chaos-soak loadgen loadgen-slow \
+	catchup-par fleet fleet-soak clean
 
 native:
 	python setup.py build_ext --inplace
+
+# native live-close differential tier (ISSUE 13): the 24/24 op-frame
+# fuzz corpus + the live-close suite with EVERY close spot-checked
+# against the Python oracle (NATIVE_CLOSE_DIFFERENTIAL=1 — results,
+# fees, header hash and bucket hashes compared per close; any
+# divergence fail-stops with a crash bundle naming the op/ledger)
+native-live: native
+	env JAX_PLATFORMS=cpu NATIVE_CLOSE_DIFFERENTIAL=1 python -m pytest \
+		tests/test_native_close.py tests/test_capply.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # corelint: project-native static analysis (clock discipline, LedgerTxn
 # paths, decode-free seam, exception hygiene, metric registry, lock

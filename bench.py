@@ -705,6 +705,95 @@ def bench_fleet(time_left_fn):
     return vals
 
 
+def bench_native_close(time_left_fn):
+    """Native live close section (ISSUE 13): LedgerManager.close driven
+    by the C engine (ledger/native_close.py) vs the pure-Python close on
+    identical payment traffic, hash-identity asserted.  Deadline-aware:
+    the Python side runs first (it is the slow side and its rate decides
+    whether the native side still fits); pre-emption reports partial
+    results.  Last-good cached like the other CPU sections."""
+    import random as _random
+
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.ledger.manager import LedgerManager
+    from stellar_core_tpu.ledger.native_close import native_close_available
+    from stellar_core_tpu.testutils import (TestAccount, create_account_op,
+                                            native_payment_op, network_id)
+
+    nid = network_id("native close bench")
+    n_ledgers = int(os.environ.get("BENCH_NATIVE_CLOSE_LEDGERS", "200"))
+    txs_per_ledger = 10
+
+    def run(native: bool):
+        mgr = LedgerManager(nid, invariant_manager=None)
+        mgr.start_new_ledger()
+        if native:
+            assert mgr.attach_native_close(differential=0), \
+                "native close attach failed"
+        root_sk = mgr.root_account_secret()
+        ent = mgr.root.get_entry(
+            X.account_key_xdr(root_sk.public_key.ed25519))
+        root = TestAccount(mgr, root_sk, ent.data.value.seqNum)
+        sks = [SecretKey(bytes([40 + i]) * 32) for i in range(16)]
+        mgr.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 10 ** 12)
+            for sk in sks])], 1_700_000_000)
+        accts = []
+        for sk in sks:
+            e = mgr.root.get_entry(X.account_key_xdr(sk.public_key.ed25519))
+            accts.append(TestAccount(mgr, sk, e.data.value.seqNum))
+        rng = _random.Random(9)
+        ct = 1_700_000_000
+        t0 = time.perf_counter()
+        for _ in range(n_ledgers):
+            ct += 5
+            frames = []
+            for _ in range(txs_per_ledger):
+                a = accts[rng.randrange(len(accts))]
+                frames.append(a.tx([native_payment_op(
+                    accts[rng.randrange(len(accts))].account_id,
+                    1000 + rng.randrange(10 ** 6))]))
+            mgr.close_ledger(frames, ct)
+        dur = time.perf_counter() - t0
+        fallbacks = 0
+        if native:
+            # a mid-run degrade would silently report PYTHON throughput
+            # as the native rate — exactly the regression this section
+            # exists to expose
+            assert mgr.native_closer.degraded is None, \
+                mgr.native_closer.degraded
+            fallbacks = mgr.native_closer.fallbacks
+            mgr.detach_native_close()
+        return n_ledgers / dur, mgr.lcl_hash, fallbacks
+
+    dummy = LedgerManager(nid, invariant_manager=None)
+    dummy.start_new_ledger()
+    if not native_close_available(dummy):
+        return {"native_close": "SKIPPED(_capply not built)"}
+    _stage(f"native_close: python side ({n_ledgers} ledgers x "
+           f"{txs_per_ledger} txs)...")
+    py_rate, py_hash, _ = run(native=False)
+    if time_left_fn() < (n_ledgers / py_rate) * 0.6 + 30:
+        # the native side is ~3x faster than what just fit — but don't
+        # start a side that cannot finish; report the python half only
+        return {"native_close": "PARTIAL(budget, python side only)",
+                "native_close_python_ledgers_per_sec": round(py_rate, 1),
+                "native_close_ledgers": n_ledgers}
+    _stage("native_close: native side...")
+    c_rate, c_hash, fallbacks = run(native=True)
+    assert c_hash == py_hash, "native live close diverged from Python"
+    return {
+        "native_close_ledgers_per_sec": round(c_rate, 1),
+        "native_close_python_ledgers_per_sec": round(py_rate, 1),
+        "native_close_vs_python": round(c_rate / py_rate, 3),
+        "native_close_ledgers": n_ledgers,
+        "native_close_txs_per_ledger": txs_per_ledger,
+        "native_close_fallbacks": fallbacks,
+        "native_close_hashes_identical": True,
+    }
+
+
 def bench_merge_throughput(workdir):
     """ISSUE 3 acceptance: streaming-merge throughput.  Two synthetic
     buckets (disjoint + colliding keys) merged by the decoded path and by
@@ -1341,6 +1430,17 @@ def main():
         extra["catchup_parallel"] = "SKIPPED(budget)"
         _stale_fill(extra, "catchup_parallel")
 
+    # native live close (ISSUE 13): CPU-only, live LedgerManager.close
+    # through the C engine vs Python on identical traffic
+    if budget_fits("native_close", 150):
+        _stage("native_close bench (CPU-only)...")
+        nc_vals = bench_native_close(time_left)
+        _cache_put("native_close", _merge_last_good("native_close", nc_vals))
+        extra.update(nc_vals)
+    else:
+        extra["native_close"] = "SKIPPED(budget)"
+        _stale_fill(extra, "native_close")
+
     if not budget_fits("device probe + accel sections", 240):
         # nothing device-side fits anymore: emit what the CPU sections
         # measured plus last-good cache for the rest — never rc=124 with
@@ -1436,6 +1536,12 @@ def main():
                 "replay_ledgers": n_ledgers,
                 "replay_cpu_ledgers_per_sec": round(cpu_rate, 1),
                 "replay_hashes_identical": True,
+                # checkpoint outcome split (ISSUE 13): a silent native
+                # fallback regression shows as a nonzero fallback column
+                "replay_native_checkpoints":
+                    phases.get("native_checkpoints", 0),
+                "replay_fallback_checkpoints":
+                    phases.get("native_fallback_checkpoints", 0),
                 "sig_offload_hit_rate": round(hit_rate, 3),
                 "replay_phases": phases,
                 "metrics": obs,

@@ -23,19 +23,26 @@
  * Supported tx surface (probe-gated): v0/v1 envelopes AND fee-bump
  * envelopes (outer LOW-threshold auth, inner result embedded verbatim);
  * preconditions NONE/TIME/V2, any memo; ed25519/preauth/hashX signers.
- * 17 op types apply natively: CREATE_ACCOUNT, PAYMENT (native + credit),
+ * ALL 24 classic op types apply natively (round 12 closed the set):
+ * CREATE_ACCOUNT, PAYMENT (native + credit), PATH_PAYMENT_STRICT_RECEIVE,
+ * PATH_PAYMENT_STRICT_SEND (order book vs CAP-38 pool per hop),
  * MANAGE_SELL_OFFER, MANAGE_BUY_OFFER, CREATE_PASSIVE_SELL_OFFER,
- * SET_OPTIONS, CHANGE_TRUST (classic assets), ALLOW_TRUST, ACCOUNT_MERGE,
- * INFLATION, MANAGE_DATA, BUMP_SEQUENCE, CREATE_CLAIMABLE_BALANCE,
+ * SET_OPTIONS, CHANGE_TRUST (classic + pool-share lines), ALLOW_TRUST,
+ * ACCOUNT_MERGE, INFLATION, MANAGE_DATA, BUMP_SEQUENCE,
+ * BEGIN/END_SPONSORING_FUTURE_RESERVES, REVOKE_SPONSORSHIP (CAP-33
+ * sandwiches incl. per-signer slots), CREATE_CLAIMABLE_BALANCE,
  * CLAIM_CLAIMABLE_BALANCE, CLAWBACK, CLAWBACK_CLAIMABLE_BALANCE,
- * SET_TRUST_LINE_FLAGS.
+ * SET_TRUST_LINE_FLAGS, LIQUIDITY_POOL_DEPOSIT, LIQUIDITY_POOL_WITHDRAW.
  *
  * Fallback set (probe answers "unsupported"; the caller replays that
- * checkpoint in Python): PATH_PAYMENT_STRICT_RECEIVE/SEND, the
- * sponsorship trio (BEGIN/END_SPONSORING_FUTURE_RESERVES,
- * REVOKE_SPONSORSHIP), LIQUIDITY_POOL_DEPOSIT/WITHDRAW, pool-share
- * CHANGE_TRUST lines, Soroban ops, and generalized tx sets.  Sponsorship
- * DATA already in state is preserved and released correctly either way.
+ * checkpoint in Python): Soroban ops, soroban-typed RevokeSponsorship
+ * keys, and generalized tx sets.
+ *
+ * Live close (round 12): close_ledger() applies ONE externalized ledger
+ * with no archive header to check against — the engine computes the
+ * header/results and returns them with the entry delta so the Python
+ * manager mirrors its read view (ledger/native_close.py drives it and
+ * differentially spot-checks against the Python close).
  */
 
 #define PY_SSIZE_T_CLEAN
@@ -1037,6 +1044,22 @@ typedef struct CTx_ {
 
 static int skip_predicate(Rd *r, int depth);
 
+/* skip one Asset (native / alphanum4 / alphanum12); returns -1 on
+ * malformed bytes */
+static int
+skip_asset(Rd *r)
+{
+    uint32_t at = rd_u32(r);
+    if (r->err)
+        return -1;
+    if (at == 0)
+        return 0;
+    if (at != 1 && at != 2) { r->err = 1; return -1; }
+    rd_skip(r, at == 1 ? 4 : 12);
+    if (rd_u32(r) != 0) { r->err = 1; return -1; }  /* issuer PK type */
+    return rd_skip(r, 32);
+}
+
 /* parse one Operation; returns -1 on parse error */
 static int
 parse_op(Rd *r, COp *op, CTx *tx)
@@ -1114,6 +1137,24 @@ parse_op(Rd *r, COp *op, CTx *tx)
         rd_skip(r, 8 + 4 + 4);                 /* amount, price */
         break;
     }
+    case 2: case 13: {            /* PATH_PAYMENT_STRICT_RECEIVE / SEND */
+        if (skip_asset(r) < 0)                 /* sendAsset */
+            return -1;
+        rd_skip(r, 8);                         /* sendMax / sendAmount */
+        uint32_t mt = rd_u32(r);
+        if (mt == 0x100) { tx->has_muxed = 1; rd_skip(r, 8); }
+        else if (mt != 0) { r->err = 1; return -1; }
+        rd_skip(r, 32);                        /* destination */
+        if (skip_asset(r) < 0)                 /* destAsset */
+            return -1;
+        rd_skip(r, 8);                         /* destAmount / destMin */
+        uint32_t np = rd_u32(r);
+        if (r->err || np > 5) { r->err = 1; return -1; }
+        for (uint32_t i = 0; i < np; i++)
+            if (skip_asset(r) < 0)
+                return -1;
+        break;
+    }
     case 6: {                                 /* CHANGE_TRUST */
         uint32_t lt = rd_u32(r);
         if (lt == 0) {
@@ -1123,11 +1164,85 @@ parse_op(Rd *r, COp *op, CTx *tx)
             if (rd_u32(r) != 0) { r->err = 1; return -1; }
             rd_skip(r, 32);
         } else if (lt == 3) {
-            return 1;           /* pool-share trustline: fall back */
+            /* pool-share line: LiquidityPoolParameters.constantProduct */
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }
+            if (skip_asset(r) < 0 || skip_asset(r) < 0)
+                return -1;
+            rd_skip(r, 4);                     /* fee (i32) */
         } else { r->err = 1; return -1; }
         rd_skip(r, 8);
         break;
     }
+    case 16:                                  /* BEGIN_SPONSORING_F_R */
+        if (rd_u32(r) != 0) { r->err = 1; return -1; }   /* PK type */
+        rd_skip(r, 32);
+        break;
+    case 17:                                  /* END_SPONSORING (void) */
+        break;
+    case 18: {                                /* REVOKE_SPONSORSHIP */
+        uint32_t arm = rd_u32(r);
+        if (r->err)
+            return -1;
+        if (arm == 0) {                       /* LEDGER_ENTRY: LedgerKey */
+            uint32_t kt = rd_u32(r);
+            if (r->err)
+                return -1;
+            switch (kt) {
+            case 0:                           /* ACCOUNT */
+                if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                rd_skip(r, 32);
+                break;
+            case 1: {                         /* TRUSTLINE */
+                if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                rd_skip(r, 32);
+                uint32_t at = rd_u32(r);
+                if (at == 0) {
+                    /* native */
+                } else if (at == 1 || at == 2) {
+                    rd_skip(r, at == 1 ? 4 : 12);
+                    if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                    rd_skip(r, 32);
+                } else if (at == 3) {
+                    rd_skip(r, 32);           /* poolID */
+                } else { r->err = 1; return -1; }
+                break;
+            }
+            case 2:                           /* OFFER */
+                if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                rd_skip(r, 32 + 8);
+                break;
+            case 3: {                         /* DATA */
+                if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                rd_skip(r, 32);
+                uint32_t nl;
+                if (!rd_varopaque(r, 64, &nl)) return -1;
+                break;
+            }
+            case 4:                           /* CLAIMABLE_BALANCE */
+                if (rd_u32(r) != 0) { r->err = 1; return -1; }
+                rd_skip(r, 32);
+                break;
+            case 5:                           /* LIQUIDITY_POOL */
+                rd_skip(r, 32);
+                break;
+            default:
+                return 1;     /* soroban-typed key: fall back to Python */
+            }
+        } else if (arm == 1) {                /* SIGNER */
+            if (rd_u32(r) != 0) { r->err = 1; return -1; }
+            rd_skip(r, 32);
+            CSigner sg;
+            if (parse_signer_key(r, &sg) < 0)
+                return -1;
+        } else { r->err = 1; return -1; }
+        break;
+    }
+    case 22:                                  /* LIQUIDITY_POOL_DEPOSIT */
+        rd_skip(r, 32 + 8 + 8 + 8 + 8);       /* pool, maxA, maxB, 2 prices */
+        break;
+    case 23:                                  /* LIQUIDITY_POOL_WITHDRAW */
+        rd_skip(r, 32 + 8 + 8 + 8);
+        break;
     case 7: {                                 /* ALLOW_TRUST */
         if (rd_u32(r) != 0) { r->err = 1; return -1; }   /* PK type */
         rd_skip(r, 32);
@@ -1975,13 +2090,30 @@ apply_upgrades(CHeader *h)
 
 /* ---- the engine ------------------------------------------------------- */
 
+/* one open Begin/End sponsorship sandwich (CAP-33): `sponsor` covers every
+ * reserve created FOR `sponsored` until the matching End op */
+typedef struct {
+    uint8_t sponsored[32];
+    uint8_t sponsor[32];
+} Sandwich;
+
 typedef struct {
     PyObject_HEAD
     uint8_t network_id[32];
     int state_loaded;
+    int poisoned;               /* failure after the store fold began */
     Map store;                  /* authoritative entries */
     Map ledger_delta;           /* current ledger's changes (NULL = dead) */
     Map tx_delta;               /* current tx's nested overlay */
+    Map op_delta;               /* current op's overlay (per-op rollback,
+                                   mirror frame.py's per-op LedgerTxn) */
+    Map hop_delta;              /* path-payment book-attempt overlay
+                                   (mirror _convert_hop's child LedgerTxn) */
+    Map *cur;                   /* write target of the active layer */
+    int op_active, hop_active;
+    /* per-tx Begin/End sandwich state (mirror frame._sponsorship_ctx) */
+    Sandwich sandwich[MAX_OPS];
+    int n_sandwich;
     CBucketList bl;
     CHeader header;             /* last closed header */
     uint8_t lcl_hash[32];
@@ -1995,19 +2127,46 @@ typedef struct {
     uint64_t ledgers_applied, txs_applied;
 } Engine;
 
-/* entry lookup through tx_delta -> ledger_delta -> store.
- * Returns borrowed RB* (NULL when absent/dead). */
+/* entry lookup through hop_delta -> op_delta -> tx_delta -> ledger_delta
+ * -> store.  Returns borrowed RB* (NULL when absent/dead). */
 static RB *
 eng_get(Engine *e, const uint8_t *key, int klen)
 {
     int present;
-    RB *v = map_get(&e->tx_delta, key, klen, &present);
+    RB *v;
+    if (e->hop_active) {
+        v = map_get(&e->hop_delta, key, klen, &present);
+        if (present)
+            return v;
+    }
+    if (e->op_active) {
+        v = map_get(&e->op_delta, key, klen, &present);
+        if (present)
+            return v;
+    }
+    v = map_get(&e->tx_delta, key, klen, &present);
     if (present)
         return v;
     v = map_get(&e->ledger_delta, key, klen, &present);
     if (present)
         return v;
     return map_get(&e->store, key, klen, &present);
+}
+
+/* fold the upper overlay into the lower one (op commit / hop commit) */
+static int
+eng_fold_overlay(Map *upper, Map *lower)
+{
+    for (int i = 0; i < upper->cap; i++) {
+        MapSlot *s = &upper->slots[i];
+        if (s->state != 1)
+            continue;
+        if (map_put(lower, rb_ref(s->key),
+                    s->val ? rb_ref(s->val) : NULL) < 0)
+            return -1;
+    }
+    map_clear(upper);
+    return 0;
 }
 
 /* write into the CURRENT overlay (tx_delta during tx apply, ledger_delta
@@ -2070,8 +2229,35 @@ eng_commit_tx(Engine *e)
 static void
 eng_rollback_tx(Engine *e)
 {
+    map_clear(&e->hop_delta);
+    map_clear(&e->op_delta);
     map_clear(&e->tx_delta);
+    e->hop_active = 0;
+    e->op_active = 0;
+    e->cur = &e->tx_delta;
 }
+
+/* active-sandwich lookup (mirror sponsorship.active_sponsor): the account
+ * sponsoring future reserves of `owner` in this tx, or NULL */
+static const uint8_t *
+active_sponsor_c(Engine *e, const uint8_t owner[32])
+{
+    for (int i = 0; i < e->n_sandwich; i++)
+        if (memcmp(e->sandwich[i].sponsored, owner, 32) == 0)
+            return e->sandwich[i].sponsor;
+    return NULL;
+}
+
+/* CAP-33 sponsorship core (defined with the round-12 op set below) */
+#define SP_SUCCESS 0
+#define SP_LOW_RESERVE 1
+#define SP_TOO_MANY 2
+
+static int establish_sponsorship_c(Engine *e, const uint8_t sponsor_id[32],
+                                   CAccount *owner, int mult);
+static int sponsorship_error_c(Buf *rb, int32_t op_type, int32_t low_code,
+                               int code);
+static void acc_ensure_v2(CAccount *a);
 
 /* reserve math in 128-bit (Python ints are unbounded) ------------------- */
 
@@ -2166,9 +2352,29 @@ op_create_account(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     account_key_xdr_c(dest, dk);
     if (eng_get(e, dk, 40) != NULL)
         return res_inner(rb, 0, -4) < 0 ? -1 : 0;   /* ALREADY_EXIST */
-    /* no sandwich possible natively (sponsorship ops fall back) */
-    if (starting < (i128)2 * h->base_reserve)
+    CAccount na;
+    memset(&na, 0, sizeof(na));
+    na.last_modified = h->ledger_seq;
+    memcpy(na.account_id, dest, 32);
+    na.balance = starting;
+    na.seq_num = (int64_t)h->ledger_seq << 32;
+    na.thresholds[0] = 1;                            /* defaults */
+    /* sponsored create (CAP-33 sandwich, v14+): the sponsor's reserve
+     * covers the new account's 2 base reserves, checked BEFORE the
+     * source pays the starting balance */
+    const uint8_t *sponsor = h->ledger_version >= 14
+        ? active_sponsor_c(e, dest) : NULL;
+    if (sponsor != NULL) {
+        int sc = sponsorship_error_c(rb, 0, -3,
+            establish_sponsorship_c(e, sponsor, &na, 2));
+        if (sc)
+            return sc < 0 ? -1 : 0;
+        na.entry_ext_v1 = 1;
+        na.has_sponsor = 1;
+        memcpy(na.sponsor, sponsor, 32);
+    } else if (starting < (i128)2 * h->base_reserve) {
         return res_inner(rb, 0, -3) < 0 ? -1 : 0;   /* LOW_RESERVE */
+    }
     CAccount src;
     int got = eng_get_account(e, src_id, &src);
     if (got < 0)
@@ -2177,16 +2383,9 @@ op_create_account(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         return -1;                                   /* checked earlier */
     if (!add_balance_c(h, &src, -starting, 1))
         return res_inner(rb, 0, -2) < 0 ? -1 : 0;   /* UNDERFUNDED */
-    if (eng_put_account(e, &e->tx_delta, &src) < 0)
+    if (eng_put_account(e, e->cur, &src) < 0)
         return -1;
-    CAccount na;
-    memset(&na, 0, sizeof(na));
-    na.last_modified = h->ledger_seq;
-    memcpy(na.account_id, dest, 32);
-    na.balance = starting;
-    na.seq_num = (int64_t)h->ledger_seq << 32;
-    na.thresholds[0] = 1;                            /* defaults */
-    if (eng_put_account(e, &e->tx_delta, &na) < 0)
+    if (eng_put_account(e, e->cur, &na) < 0)
         return -1;
     return res_inner(rb, 0, 0) < 0 ? -1 : 1;
 }
@@ -2229,8 +2428,8 @@ op_payment(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32], Buf *rb)
         return res_inner(rb, 1, -8) < 0 ? -1 : 0;   /* LINE_FULL */
     src.last_modified = h->ledger_seq;
     dst.last_modified = h->ledger_seq;
-    if (eng_put_account(e, &e->tx_delta, &src) < 0 ||
-        eng_put_account(e, &e->tx_delta, &dst) < 0)
+    if (eng_put_account(e, e->cur, &src) < 0 ||
+        eng_put_account(e, e->cur, &dst) < 0)
         return -1;
     return res_inner(rb, 1, 0) < 0 ? -1 : 1;
 }
@@ -2382,7 +2581,7 @@ op_set_options(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                         if (sp.ext_level < 2)
                             sp.ext_level = 2;
                         sp.last_modified = h->ledger_seq;
-                        if (eng_put_account(e, &e->tx_delta, &sp) < 0)
+                        if (eng_put_account(e, e->cur, &sp) < 0)
                             return -1;
                         /* re-read src if sponsor == src (same account) */
                         if (memcmp(sponsor, src_id, 32) == 0) {
@@ -2407,8 +2606,19 @@ op_set_options(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         } else {
             if (src.n_signers >= 20)
                 return res_inner(rb, 5, -2) < 0 ? -1 : 0;  /* TOO_MANY_SIGNERS */
-            if (!add_num_entries_c(h, &src, 1))
+            /* sponsored signer (CAP-33 sandwich, v14+): the sponsor's
+             * reserve covers the new subentry */
+            const uint8_t *sp_id = h->ledger_version >= 14
+                ? active_sponsor_c(e, src_id) : NULL;
+            if (sp_id != NULL) {
+                int sc = sponsorship_error_c(rb, 5, -1,
+                    establish_sponsorship_c(e, sp_id, &src, 1));
+                if (sc)
+                    return sc < 0 ? -1 : 0;
+                src.num_sub += 1;
+            } else if (!add_num_entries_c(h, &src, 1)) {
                 return res_inner(rb, 5, -1) < 0 ? -1 : 0;  /* LOW_RESERVE */
+            }
             /* sorted insert position by signer-key XDR */
             int pos = src.n_signers;
             for (int i = 0; i < src.n_signers; i++) {
@@ -2423,24 +2633,24 @@ op_set_options(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                 src.signers[i] = src.signers[i - 1];
             src.signers[pos] = signer;
             src.n_signers++;
-            /* record_signer_insert: only when v2 ext already exists */
-            if (has_v2) {
-                /* pad to previous signer count, insert None at pos */
-                while (src.n_ssids < src.n_signers - 1) {
+            /* record_signer_insert: a sponsored insert materializes the
+             * v2 ext; an unsponsored one records only when v2 exists */
+            if (sp_id != NULL || src.ext_level >= 2) {
+                acc_ensure_v2(&src);
+                while (src.n_ssids < src.n_signers) {  /* pad to new count */
                     src.ssids[src.n_ssids].present = 0;
                     src.n_ssids++;
                 }
-                for (int i = src.n_ssids; i > pos; i--)
+                for (int i = src.n_ssids - 1; i > pos; i--)
                     src.ssids[i] = src.ssids[i - 1];
-                src.ssids[pos].present = 0;
-                src.n_ssids++;
-                if (src.n_ssids > src.n_signers)
-                    src.n_ssids = src.n_signers;
+                src.ssids[pos].present = sp_id != NULL;
+                if (sp_id != NULL)
+                    memcpy(src.ssids[pos].id, sp_id, 32);
             }
         }
     }
     src.last_modified = h->ledger_seq;
-    if (eng_put_account(e, &e->tx_delta, &src) < 0)
+    if (eng_put_account(e, e->cur, &src) < 0)
         return -1;
     return res_inner(rb, 5, 0) < 0 ? -1 : 1;
 }
@@ -2642,7 +2852,7 @@ remove_one_time_signers_c(Engine *e, CTx *tx)
                             return -1;
                         sp.num_sponsoring -= 1;
                         sp.last_modified = h->ledger_seq;
-                        if (eng_put_account(e, &e->tx_delta, &sp) < 0)
+                        if (eng_put_account(e, e->cur, &sp) < 0)
                             return -1;
                     }
                     if (acc.num_sponsored < 1)
@@ -2656,7 +2866,7 @@ remove_one_time_signers_c(Engine *e, CTx *tx)
             }
         }
         if (changed) {
-            if (eng_put_account(e, &e->tx_delta, &acc) < 0)
+            if (eng_put_account(e, e->cur, &acc) < 0)
                 return -1;
         }
     }
@@ -2676,6 +2886,14 @@ static int op_manage_offer(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_create_cb(Engine *, CTx *, COp *, int, const uint8_t *, Buf *);
 static int op_claim_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 static int op_clawback_cb(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+/* round-12 full-coverage op set: path payments, sponsorship, pools */
+static int op_path_payment(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_begin_sponsoring(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_end_sponsoring(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_revoke_sponsorship(Engine *, CTx *, COp *, const uint8_t *,
+                                 Buf *);
+static int op_pool_deposit(Engine *, CTx *, COp *, const uint8_t *, Buf *);
+static int op_pool_withdraw(Engine *, CTx *, COp *, const uint8_t *, Buf *);
 
 /* apply one NON-fee-bump tx; appends its TransactionResult XDR to
  * `out`.  Mirrors TransactionFrame.apply: all-or-nothing via tx_delta. */
@@ -2690,6 +2908,11 @@ apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
         return tx_result_void(out, fee, TXC_BAD_SEQ);
 
     map_clear(&e->tx_delta);
+    map_clear(&e->op_delta);
+    map_clear(&e->hop_delta);
+    e->op_active = e->hop_active = 0;
+    e->cur = &e->tx_delta;
+    e->n_sandwich = 0;          /* fresh Begin/End sandwich state per apply */
     /* header.idPool is bumped by offer creation inside ops; a failed tx
      * rolls it back along with the entry delta (the oracle's inner
      * LedgerTxn holds the header mutation until commit) */
@@ -2729,13 +2952,19 @@ apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
          * functions */
         /* version gates run FIRST (mirror OperationFrame.check_valid:
          * MIN_PROTOCOL_VERSION precedes the signature check) —
-         * BumpSequence v10+, Clawback/SetTrustLineFlags v17+ */
+         * BumpSequence v10+, path strict-send v12+, sponsorship trio +
+         * claimable balances v14+, Clawback/SetTrustLineFlags v17+,
+         * liquidity pools v18+ */
         if ((op->op_type == 11 && h->ledger_version < 10) ||
             (op->op_type == 12 && h->ledger_version < 11) ||
-            ((op->op_type == 14 || op->op_type == 15) &&
-             h->ledger_version < 14) ||
+            (op->op_type == 13 && h->ledger_version < 12) ||
+            ((op->op_type == 14 || op->op_type == 15 ||
+              op->op_type == 16 || op->op_type == 17 ||
+              op->op_type == 18) && h->ledger_version < 14) ||
             ((op->op_type == 19 || op->op_type == 20 ||
-              op->op_type == 21) && h->ledger_version < 17)) {
+              op->op_type == 21) && h->ledger_version < 17) ||
+            ((op->op_type == 22 || op->op_type == 23) &&
+             h->ledger_version < 18)) {
             if (res_outer(&ops_buf, -3) < 0) { rc = -1; goto done; }
             ok = 0;
             continue;
@@ -2759,6 +2988,14 @@ apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
             ok = 0;
             continue;
         }
+        /* each op applies in its OWN overlay, rolled back on op failure
+         * (mirror frame.apply's per-op LedgerTxn) — a mutate-then-fail
+         * path (RevokeSponsorship transfer, sponsored CreateAccount
+         * UNDERFUNDED) must leave no mutations for later ops to see */
+        map_clear(&e->op_delta);
+        e->op_active = 1;
+        e->cur = &e->op_delta;
+        uint64_t op_saved_id_pool = h->id_pool;
         int r;
         switch (op->op_type) {
         case 0: r = op_create_account(e, tx, op, op_src, &ops_buf); break;
@@ -2774,6 +3011,9 @@ apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
                         : op_payment_credit(e, tx, op, op_src, &ops_buf);
             break;
         }
+        case 2: case 13:
+            r = op_path_payment(e, tx, op, op_src, &ops_buf);
+            break;
         case 3: case 4: case 12:
             r = op_manage_offer(e, tx, op, op_src, &ops_buf);
             break;
@@ -2789,14 +3029,38 @@ apply_tx_core(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
         case 11: r = op_bump_sequence(e, tx, op, op_src, &ops_buf); break;
         case 14: r = op_create_cb(e, tx, op, i, op_src, &ops_buf); break;
         case 15: r = op_claim_cb(e, tx, op, op_src, &ops_buf); break;
+        case 16: r = op_begin_sponsoring(e, tx, op, op_src, &ops_buf); break;
+        case 17: r = op_end_sponsoring(e, tx, op, op_src, &ops_buf); break;
+        case 18: r = op_revoke_sponsorship(e, tx, op, op_src, &ops_buf); break;
         case 19: r = op_clawback(e, tx, op, op_src, &ops_buf); break;
         case 20: r = op_clawback_cb(e, tx, op, op_src, &ops_buf); break;
         case 21: r = op_set_tl_flags(e, tx, op, op_src, &ops_buf); break;
+        case 22: r = op_pool_deposit(e, tx, op, op_src, &ops_buf); break;
+        case 23: r = op_pool_withdraw(e, tx, op, op_src, &ops_buf); break;
         default: r = -1; break;
         }
+        e->hop_active = 0;
+        map_clear(&e->hop_delta);
+        if (r > 0) {
+            if (eng_fold_overlay(&e->op_delta, &e->tx_delta) < 0)
+                r = -1;
+        } else {
+            map_clear(&e->op_delta);
+            h->id_pool = op_saved_id_pool;
+        }
+        e->op_active = 0;
+        e->cur = &e->tx_delta;
         if (r < 0) { rc = -1; goto done; }
         if (r == 0)
             ok = 0;
+    }
+    if (ok && e->n_sandwich) {
+        /* a BeginSponsoringFutureReserves left unclosed at tx end fails
+         * the whole tx (mirror frame.apply: txBAD_SPONSORSHIP) */
+        eng_rollback_tx(e);
+        h->id_pool = saved_id_pool;
+        PyMem_Free(ops_buf.p);
+        return tx_result_void(out, fee, -14);
     }
     if (ok && tx->n_extra_signers) {
         /* _check_extra_signers: each extra signer as a 1-of-1 set */
@@ -3085,6 +3349,64 @@ fail:
     return NULL;
 }
 
+/* deep-copy a header (snapshot for live-close rollback) */
+static int
+cheader_copy(const CHeader *src, CHeader *dst)
+{
+    *dst = *src;
+    dst->scp_value = NULL;
+    dst->ext = NULL;
+    if (src->scp_value) {
+        dst->scp_value = PyMem_Malloc(src->scp_len);
+        if (!dst->scp_value) { PyErr_NoMemory(); return -1; }
+        memcpy(dst->scp_value, src->scp_value, src->scp_len);
+        for (int i = 0; i < src->n_upgrades; i++)
+            dst->upgrades[i].p = dst->scp_value +
+                (src->upgrades[i].p - src->scp_value);
+    }
+    if (src->ext) {
+        dst->ext = PyMem_Malloc(src->ext_len);
+        if (!dst->ext) {
+            PyMem_Free(dst->scp_value);
+            dst->scp_value = NULL;
+            PyErr_NoMemory();
+            return -1;
+        }
+        memcpy(dst->ext, src->ext, src->ext_len);
+    }
+    return 0;
+}
+
+/* shared apply core: fee phase + per-tx apply (in apply order) + voted
+ * upgrades.  Appends the TransactionResultSet XDR (count + pairs) to
+ * `results`.  Returns 0 / -1 (state may be partially mutated in the
+ * delta maps only — callers roll back by clearing them + restoring the
+ * header). */
+static int
+apply_tx_phase(Engine *e, CTx *txs, int n_txs, Buf *results)
+{
+    CHeader *h = &e->header;
+    uint64_t close_time = h->close_time;
+    int order[MAX_TX_PER_LEDGER];
+    if (n_txs)
+        apply_order_c(txs, n_txs, order);
+    for (int i = 0; i < n_txs; i++)
+        if (fee_phase_c(e, &txs[order[i]]) < 0)
+            return -1;
+    if (buf_u32(results, (uint32_t)n_txs) < 0)
+        return -1;
+    for (int i = 0; i < n_txs; i++) {
+        CTx *tx = &txs[order[i]];
+        if (buf_put(results, tx->content_hash, 32) < 0)
+            return -1;
+        if (apply_tx_c(e, tx, close_time, results) < 0)
+            return -1;
+    }
+    sha256_of(results->p, results->len, h->tx_set_result_hash);
+    apply_upgrades(h);
+    return 0;
+}
+
 /* apply one ledger from its raw records.  Returns 0 / -1 (Python error
  * set). */
 static int
@@ -3153,37 +3475,13 @@ close_one_ledger(Engine *e, const uint8_t *hdr_rec, int hdr_len,
         cheader_clear(&hin);
         return raise_capply("bad scpValue at ledger %lu", seq);
     }
-    uint64_t close_time = h->close_time;
-
-    /* phases 1+2 in apply order */
-    int order[MAX_TX_PER_LEDGER];
-    if (n_txs)
-        apply_order_c(txs, n_txs, order);
-    for (int i = 0; i < n_txs; i++) {
-        if (fee_phase_c(e, &txs[order[i]]) < 0) {
-            cheader_clear(&hin);
-            if (!PyErr_Occurred())
-                raise_capply("fee phase failed at ledger %lu", seq);
-            return -1;
-        }
-    }
-    /* result pairs, in apply order */
+    /* phases 1+2 in apply order, result hash, voted upgrades */
     Buf results = {0};
-    if (buf_u32(&results, (uint32_t)n_txs) < 0)
+    if (apply_tx_phase(e, txs, n_txs, &results) < 0)
         goto fail;
-    for (int i = 0; i < n_txs; i++) {
-        CTx *tx = &txs[order[i]];
-        if (buf_put(&results, tx->content_hash, 32) < 0)
-            goto fail;
-        if (apply_tx_c(e, tx, close_time, &results) < 0)
-            goto fail;
-    }
-    sha256_of(results.p, results.len, h->tx_set_result_hash);
     PyMem_Free(results.p);
     results.p = NULL;
     results.len = results.cap = 0;
-
-    apply_upgrades(h);
 
     CBucket *fresh = build_fresh_and_fold(e, seq);
     if (!fresh)
@@ -3236,6 +3534,8 @@ Engine_dealloc(Engine *self)
     map_free(&self->store);
     map_free(&self->ledger_delta);
     map_free(&self->tx_delta);
+    map_free(&self->op_delta);
+    map_free(&self->hop_delta);
     cbl_free(&self->bl);
     cheader_clear(&self->header);
     PyMem_Free(self->vcache.slots);
@@ -3263,11 +3563,17 @@ Engine_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
     if (map_init(&self->store, 1024) < 0 ||
         map_init(&self->ledger_delta, 256) < 0 ||
         map_init(&self->tx_delta, 64) < 0 ||
+        map_init(&self->op_delta, 64) < 0 ||
+        map_init(&self->hop_delta, 64) < 0 ||
         cbl_init(&self->bl) < 0 ||
         vcache_init(&self->vcache) < 0) {
         Py_DECREF(self);
         return NULL;
     }
+    self->cur = &self->tx_delta;
+    self->op_active = self->hop_active = 0;
+    self->n_sandwich = 0;
+    self->poisoned = 0;
     return (PyObject *)self;
 }
 
@@ -3337,6 +3643,11 @@ Engine_import_state(Engine *self, PyObject *args)
     map_clear(&self->store);
     map_clear(&self->ledger_delta);
     map_clear(&self->tx_delta);
+    map_clear(&self->op_delta);
+    map_clear(&self->hop_delta);
+    self->cur = &self->tx_delta;
+    self->op_active = self->hop_active = 0;
+    self->poisoned = 0;
     PyObject *it = PyObject_GetIter(entries);
     if (!it)
         return NULL;
@@ -3419,6 +3730,14 @@ bucket_stream_py(CBucket *b)
 static PyObject *
 Engine_export_state(Engine *self, PyObject *args)
 {
+    if (self->poisoned) {
+        /* a post-fold close failure left the store/header torn —
+         * exporting it would hand the caller silently-diverged state */
+        PyErr_SetString(CapplyError,
+                        "engine poisoned by a failed close; state is "
+                        "unrecoverable");
+        return NULL;
+    }
     Buf hb = {0};
     if (serialize_header(&self->header, &hb) < 0) {
         PyMem_Free(hb.p);
@@ -3474,6 +3793,60 @@ Engine_export_state(Engine *self, PyObject *args)
 fail:
     Py_XDECREF(hdr);
     Py_XDECREF(entries);
+    Py_XDECREF(buckets);
+    Py_XDECREF(nexts);
+    return NULL;
+}
+
+/* header + serialized buckets only — the checkpoint-boundary sync seam
+ * of native live close.  export_state() additionally materializes a
+ * Python pair per store entry; boundaries need none of that. */
+static PyObject *
+Engine_export_buckets(Engine *self, PyObject *args)
+{
+    if (self->poisoned) {
+        PyErr_SetString(CapplyError,
+                        "engine poisoned by a failed close; state is "
+                        "unrecoverable");
+        return NULL;
+    }
+    Buf hb = {0};
+    if (serialize_header(&self->header, &hb) < 0) {
+        PyMem_Free(hb.p);
+        return NULL;
+    }
+    PyObject *hdr = PyBytes_FromStringAndSize((char *)hb.p, hb.len);
+    PyMem_Free(hb.p);
+    if (!hdr)
+        return NULL;
+    PyObject *buckets = PyList_New(0);
+    PyObject *nexts = PyList_New(0);
+    if (!buckets || !nexts)
+        goto fail;
+    for (int i = 0; i < NUM_LEVELS; i++) {
+        CLevel *lvl = &self->bl.levels[i];
+        PyObject *c = bucket_stream_py(lvl->curr);
+        PyObject *sn = bucket_stream_py(lvl->snap);
+        if (!c || !sn || PyList_Append(buckets, c) < 0 ||
+            PyList_Append(buckets, sn) < 0) {
+            Py_XDECREF(c); Py_XDECREF(sn);
+            goto fail;
+        }
+        Py_DECREF(c); Py_DECREF(sn);
+        if (lvl->next_out) {
+            PyObject *nx = bucket_stream_py(lvl->next_out);
+            if (!nx || PyList_Append(nexts, nx) < 0) {
+                Py_XDECREF(nx);
+                goto fail;
+            }
+            Py_DECREF(nx);
+        } else if (PyList_Append(nexts, Py_None) < 0) {
+            goto fail;
+        }
+    }
+    return Py_BuildValue("(NNN)", hdr, buckets, nexts);
+fail:
+    Py_XDECREF(hdr);
     Py_XDECREF(buckets);
     Py_XDECREF(nexts);
     return NULL;
@@ -3603,6 +3976,173 @@ Engine_lcl(Engine *self, PyObject *args)
 {
     return Py_BuildValue("(ky#)", (unsigned long)self->header.ledger_seq,
                          self->lcl_hash, (Py_ssize_t)32);
+}
+
+/* Live ledger close (round 12): apply ONE ledger from the externalized
+ * StellarValue + the tx record (a TransactionHistoryEntry, None for an
+ * empty set).  Unlike apply_checkpoint there is no archive header to
+ * verify against — the engine COMPUTES the header and returns it with
+ * the result set and the ledger's entry delta, so the Python manager can
+ * mirror its read view.  All failures before the store fold roll the
+ * header and delta maps back (the engine stays usable — degrade to the
+ * Python close); a failure after the fold poisons the engine. */
+static PyObject *
+Engine_close_ledger(Engine *self, PyObject *args)
+{
+    PyObject *tx_rec_obj;
+    const uint8_t *scp;
+    Py_ssize_t scp_len;
+    if (!PyArg_ParseTuple(args, "Oy#", &tx_rec_obj, &scp, &scp_len))
+        return NULL;
+    if (!self->state_loaded) {
+        PyErr_SetString(CapplyError, "no state imported");
+        return NULL;
+    }
+    if (self->poisoned) {
+        PyErr_SetString(CapplyError, "engine poisoned by an earlier "
+                        "failed close");
+        return NULL;
+    }
+    uint32_t seq = self->header.ledger_seq + 1;
+    CTx *txs = PyMem_Malloc(sizeof(CTx) * MAX_TX_PER_LEDGER);
+    if (!txs)
+        return PyErr_NoMemory();
+    zero_tx_inners(txs);
+    int n_txs = 0;
+    uint8_t set_hash[32];
+    if (tx_rec_obj != Py_None) {
+        char *tp;
+        Py_ssize_t tl;
+        if (PyBytes_AsStringAndSize(tx_rec_obj, &tp, &tl) < 0)
+            goto fail_free;
+        const uint8_t *set_p;
+        int set_len;
+        uint32_t rec_seq;
+        int rc = parse_tx_record((uint8_t *)tp, (int)tl, self->network_id,
+                                 txs, &n_txs, &set_p, &set_len, &rec_seq);
+        if (rc) {
+            raise_capply(rc > 0
+                ? "unsupported tx at ledger %lu (native probe miss)"
+                : "malformed tx record at ledger %lu", seq);
+            goto fail_free;
+        }
+        if (rec_seq != seq) {
+            raise_capply("tx record seq mismatch at ledger %lu", seq);
+            goto fail_free;
+        }
+        sha256_of(set_p, set_len, set_hash);
+    } else {
+        Sha256 s;
+        sha_init(&s);
+        sha_update(&s, self->lcl_hash, 32);
+        static const uint8_t zero4[4] = {0, 0, 0, 0};
+        sha_update(&s, zero4, 4);
+        sha_final(&s, set_hash);
+    }
+    /* the externalized value must name the tx set being applied */
+    {
+        CHeader probe;
+        memset(&probe, 0, sizeof(probe));
+        Rd sr;
+        rd_init(&sr, scp, (int)scp_len);
+        if (parse_scp_value(&sr, &probe) < 0 || sr.off != sr.len) {
+            cheader_clear(&probe);
+            raise_capply("bad scpValue at ledger %lu", seq);
+            goto fail_free;
+        }
+        int match = memcmp(probe.tx_set_hash, set_hash, 32) == 0;
+        cheader_clear(&probe);
+        if (!match) {
+            raise_capply(
+                "externalized value names a different tx set at %lu", seq);
+            goto fail_free;
+        }
+    }
+    /* header snapshot for rollback (store untouched until the fold) */
+    CHeader saved;
+    if (cheader_copy(&self->header, &saved) < 0)
+        goto fail_free;
+    CHeader *h = &self->header;
+    h->ledger_seq = seq;
+    memcpy(h->previous_hash, self->lcl_hash, 32);
+    Buf results = {0};
+    if (cheader_set_scp(h, scp, (int)scp_len) < 0 ||
+        apply_tx_phase(self, txs, n_txs, &results) < 0) {
+        /* clean rollback: restore the header, drop the deltas */
+        cheader_clear(&self->header);
+        self->header = saved;
+        map_clear(&self->ledger_delta);
+        eng_rollback_tx(self);
+        PyMem_Free(results.p);
+        if (!PyErr_Occurred())
+            raise_capply("apply failed at ledger %lu", seq);
+        goto fail_free;
+    }
+    cheader_clear(&saved);
+    /* seal: from here a failure poisons the engine (store mutated) */
+    CBucket *fresh = build_fresh_and_fold(self, seq);
+    if (!fresh || cbl_add_batch(&self->bl, seq, h->ledger_version,
+                                fresh) < 0) {
+        cbucket_unref(fresh);
+        PyMem_Free(results.p);
+        self->poisoned = 1;
+        if (!PyErr_Occurred())
+            raise_capply("seal failed at ledger %lu", seq);
+        goto fail_free;
+    }
+    cbl_hash(&self->bl, h->bucket_list_hash);
+    static const uint32_t intervals[4] = {50, 5000, 50000, 500000};
+    for (int i = 0; i < 4; i++)
+        if (seq % intervals[i] == 0)
+            memcpy(h->skip_list[i], h->previous_hash, 32);
+    Buf hb = {0};
+    PyObject *delta = NULL, *out = NULL;
+    if (serialize_header(h, &hb) < 0)
+        goto fail_sealed;
+    sha256_of(hb.p, hb.len, self->lcl_hash);
+    self->ledgers_applied++;
+    /* the ledger's entry delta, for the Python manager's read mirror */
+    delta = PyList_New(0);
+    if (!delta)
+        goto fail_sealed;
+    for (int i = 0; i < fresh->n; i++) {
+        RB *k = fresh->keys[i], *rec = fresh->recs[i];
+        PyObject *pair;
+        if (rec_type(rec) == BE_DEAD)
+            pair = Py_BuildValue("(y#O)", k->bytes, (Py_ssize_t)k->len,
+                                 Py_None);
+        else
+            pair = Py_BuildValue("(y#y#)", k->bytes, (Py_ssize_t)k->len,
+                                 rec->bytes + 4, (Py_ssize_t)(rec->len - 4));
+        if (!pair || PyList_Append(delta, pair) < 0) {
+            Py_XDECREF(pair);
+            goto fail_sealed;
+        }
+        Py_DECREF(pair);
+    }
+    out = Py_BuildValue("(ky#y#y#N)", (unsigned long)seq,
+                        self->lcl_hash, (Py_ssize_t)32,
+                        hb.p, (Py_ssize_t)hb.len,
+                        results.p, (Py_ssize_t)results.len, delta);
+    delta = NULL;                   /* N stole the reference */
+    PyMem_Free(hb.p);
+    PyMem_Free(results.p);
+    cbucket_unref(fresh);
+    free_tx_inners(txs);
+    PyMem_Free(txs);
+    if (!out)
+        self->poisoned = 1;         /* close happened; result lost (OOM) */
+    return out;
+fail_sealed:
+    Py_XDECREF(delta);
+    PyMem_Free(hb.p);
+    PyMem_Free(results.p);
+    cbucket_unref(fresh);
+    self->poisoned = 1;
+fail_free:
+    free_tx_inners(txs);
+    PyMem_Free(txs);
+    return NULL;
 }
 
 static PyObject *
@@ -3835,10 +4375,16 @@ static PyMethodDef Engine_methods[] = {
     {"export_state", (PyCFunction)Engine_export_state, METH_NOARGS,
      "-> (header_xdr, lcl_hash, entries, bucket_streams[22], "
      "next_streams[11])"},
+    {"export_buckets", (PyCFunction)Engine_export_buckets, METH_NOARGS,
+     "-> (header_xdr, bucket_streams[22], next_streams[11]) — no entry "
+     "materialization (checkpoint-boundary sync)"},
     {"probe", (PyCFunction)Engine_probe, METH_VARARGS,
      "probe(tx_recs) -> bool: every tx natively applicable?"},
     {"apply_checkpoint", (PyCFunction)Engine_apply_checkpoint, METH_VARARGS,
      "apply_checkpoint(header_recs, tx_recs, max_seq) -> n_applied"},
+    {"close_ledger", (PyCFunction)Engine_close_ledger, METH_VARARGS,
+     "close_ledger(tx_rec|None, scp_value_xdr) -> (seq, lcl_hash, "
+     "header_xdr, result_set_xdr, delta[(key, entry|None)])"},
     {"lcl", (PyCFunction)Engine_lcl, METH_NOARGS, "-> (seq, hash)"},
     {"seed_verdicts", (PyCFunction)Engine_seed_verdicts, METH_VARARGS,
      "seed_verdicts(pks, sigs, msgs, verdicts)"},
@@ -3964,10 +4510,11 @@ typedef struct {
     uint8_t sponsor[32];
     /* TrustLineEntry */
     uint8_t account_id[32];
-    uint32_t asset_type;        /* 1 alphanum4 / 2 alphanum12 (native and
-                                   pool-share never stored natively) */
+    uint32_t asset_type;        /* 1 alphanum4 / 2 alphanum12 / 3 pool
+                                   share (native never stored) */
     uint8_t asset_code[12];
     uint8_t issuer[32];
+    uint8_t pool_id[32];        /* asset_type == 3 */
     int64_t balance;
     int64_t limit;
     uint32_t flags;
@@ -4000,8 +4547,12 @@ parse_trustline_entry(const uint8_t *data, int len, CTrustLine *t)
         if (!c) return -1;
         memcpy(t->asset_code, c, 12);
         if (parse_account_id(&r, t->issuer) < 0) return -1;
+    } else if (t->asset_type == 3) {
+        const uint8_t *c = rd_take(&r, 32);   /* liquidityPoolID */
+        if (!c) return -1;
+        memcpy(t->pool_id, c, 32);
     } else {
-        return -1;              /* native/pool-share: not native-applied */
+        return -1;              /* native: never stored as a trustline */
     }
     t->balance = rd_i64(&r);
     t->limit = rd_i64(&r);
@@ -4051,13 +4602,22 @@ write_tl_asset(Buf *b, uint32_t asset_type, const uint8_t code[12],
     return write_account_id(b, issuer);
 }
 
+/* TrustLineAsset for a pool-share line: tag 3 + liquidityPoolID */
+static int
+write_tl_pool_asset(Buf *b, const uint8_t pool_id[32])
+{
+    return buf_u32(b, 3) < 0 || buf_put(b, pool_id, 32) < 0 ? -1 : 0;
+}
+
 static int
 serialize_trustline_entry(const CTrustLine *t, Buf *b)
 {
     if (buf_u32(b, t->last_modified) < 0 ||
         buf_u32(b, 1) < 0 ||
         write_account_id(b, t->account_id) < 0 ||
-        write_tl_asset(b, t->asset_type, t->asset_code, t->issuer) < 0 ||
+        (t->asset_type == 3
+         ? write_tl_pool_asset(b, t->pool_id)
+         : write_tl_asset(b, t->asset_type, t->asset_code, t->issuer)) < 0 ||
         buf_i64(b, t->balance) < 0 ||
         buf_i64(b, t->limit) < 0 ||
         buf_u32(b, t->flags) < 0 ||
@@ -4095,6 +4655,16 @@ trustline_key_xdr_c(const uint8_t acc[32], uint32_t asset_type,
     if (buf_u32(b, 1) < 0 || write_account_id(b, acc) < 0)
         return -1;
     return write_tl_asset(b, asset_type, code, issuer);
+}
+
+/* pool-share trustline LedgerKey: tag 1 + accountID + (tag 3 + poolID) */
+static int
+pool_trustline_key_xdr_c(const uint8_t acc[32], const uint8_t pool_id[32],
+                         Buf *b)
+{
+    if (buf_u32(b, 1) < 0 || write_account_id(b, acc) < 0)
+        return -1;
+    return write_tl_pool_asset(b, pool_id);
 }
 
 /* mirror utils.add_trustline_balance */
@@ -4144,7 +4714,7 @@ store_trustline(Engine *e, Buf *kb, CTrustLine *tl, Buf *rb,
     if (serialize_trustline_entry(tl, &eb) < 0)
         goto out;
     RB *val = rb_new(eb.p, eb.len);
-    if (!val || eng_put(e, &e->tx_delta, kb->p, kb->len, val) < 0)
+    if (!val || eng_put(e, e->cur, kb->p, kb->len, val) < 0)
         goto out;
     rc = res_inner(rb, op_type, 0) < 0 ? -1 : 1;
 out:
@@ -4196,7 +4766,7 @@ release_entry_sponsor(Engine *e, const uint8_t sponsor[32], int mult,
             return -1;
         sp.num_sponsoring -= (uint32_t)mult;
         sp.last_modified = e->header.ledger_seq;
-        if (eng_put_account(e, &e->tx_delta, &sp) < 0)
+        if (eng_put_account(e, e->cur, &sp) < 0)
             return -1;
     }
     if (owner != NULL) {
@@ -4255,7 +4825,7 @@ payment_tl_side(Engine *e, Buf *rb, const uint8_t acc[32],
     }
     RB *val = rb_new(eb.p, eb.len);
     PyMem_Free(eb.p);
-    if (!val || eng_put(e, &e->tx_delta, kb.p, kb.len, val) < 0)
+    if (!val || eng_put(e, e->cur, kb.p, kb.len, val) < 0)
         goto out;
     rc = 1;                      /* caller writes the shared success result */
 out:
@@ -4310,7 +4880,11 @@ op_payment_credit(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     return res_inner(rb, 1, 0) < 0 ? -1 : 1;
 }
 
-/* mirror ChangeTrustOpFrame, classic-asset arm (pool share probe-rejected) */
+/* CAP-38 pool-share trustline arm (defined with the pool machinery) */
+static int apply_pool_share_ct(Engine *, CTx *, COp *, const uint8_t *,
+                               Buf *);
+
+/* mirror ChangeTrustOpFrame (classic assets + CAP-38 pool shares) */
 static int
 op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                 Buf *rb)
@@ -4320,11 +4894,13 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     uint32_t lt = rd_u32(&r);
     uint8_t code[12] = {0};
     uint8_t issuer[32] = {0};
+    if (lt == 3)
+        return apply_pool_share_ct(e, tx, op, src_id, rb);
     if (lt == 1 || lt == 2) {
         if (parse_alphanum(&r, lt, code, issuer) < 0)
             return -1;
     } else if (lt != 0) {
-        return -1;              /* pool share: probe rejected */
+        return -1;
     }
     int64_t limit = rd_i64(&r);
     if (r.err)
@@ -4371,17 +4947,11 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
             PyMem_Free(kb.p);
             return -1;
         }
-        if (!add_num_entries_c(h, &src, 1))
-            CT_FAIL(-4);                             /* LOW_RESERVE */
         uint32_t flags = 0;
         if (!(iss.flags & 0x1))                      /* AUTH_REQUIRED */
             flags |= 1;                              /* AUTHORIZED */
         if (iss.flags & 0x8)                         /* CLAWBACK_ENABLED */
             flags |= 4;                              /* TL_CLAWBACK */
-        if (eng_put_account(e, &e->tx_delta, &src) < 0) {
-            PyMem_Free(kb.p);
-            return -1;
-        }
         CTrustLine tl;
         memset(&tl, 0, sizeof(tl));
         memcpy(tl.account_id, src_id, 32);
@@ -4390,6 +4960,26 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         memcpy(tl.issuer, issuer, 32);
         tl.limit = limit;
         tl.flags = flags;
+        const uint8_t *sp_id = h->ledger_version >= 14
+            ? active_sponsor_c(e, src_id) : NULL;
+        if (sp_id != NULL) {
+            int sc = sponsorship_error_c(rb, 6, -4,
+                establish_sponsorship_c(e, sp_id, &src, 1));
+            if (sc) {
+                PyMem_Free(kb.p);
+                return sc < 0 ? -1 : 0;
+            }
+            tl.entry_ext_v1 = 1;
+            tl.has_sponsor = 1;
+            memcpy(tl.sponsor, sp_id, 32);
+            src.num_sub += 1;
+        } else if (!add_num_entries_c(h, &src, 1)) {
+            CT_FAIL(-4);                             /* LOW_RESERVE */
+        }
+        if (eng_put_account(e, e->cur, &src) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
         return store_trustline(e, &kb, &tl, rb, 6);
     }
 
@@ -4403,7 +4993,7 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
             CT_FAIL(-3);                             /* INVALID_LIMIT */
         if (tl.liab_buying || tl.liab_selling)
             CT_FAIL(-7);                             /* CANNOT_DELETE */
-        if (eng_put(e, &e->tx_delta, kb.p, kb.len, NULL) < 0) {
+        if (eng_put(e, e->cur, kb.p, kb.len, NULL) < 0) {
             PyMem_Free(kb.p);
             return -1;
         }
@@ -4416,7 +5006,7 @@ op_change_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         } else {
             add_num_entries_c(h, &src, -1);
         }
-        int rc2 = eng_put_account(e, &e->tx_delta, &src);
+        int rc2 = eng_put_account(e, e->cur, &src);
         PyMem_Free(kb.p);
         if (rc2 < 0)
             return -1;
@@ -4523,7 +5113,7 @@ op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                 return -1;
             }
         }
-        if (eng_put(e, &e->tx_delta, kb.p, kb.len, NULL) < 0) {
+        if (eng_put(e, e->cur, kb.p, kb.len, NULL) < 0) {
             PyMem_Free(kb.p);
             return -1;
         }
@@ -4536,7 +5126,7 @@ op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         } else {
             add_num_entries_c(h, &src, -1);
         }
-        int rc2 = eng_put_account(e, &e->tx_delta, &src);
+        int rc2 = eng_put_account(e, e->cur, &src);
         PyMem_Free(kb.p);
         if (rc2 < 0)
             return -1;
@@ -4545,12 +5135,23 @@ op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
 
     Buf eb = {0};
     int rc2;
+    const uint8_t *md_sponsor = NULL;
     if (rec == NULL) {                               /* create */
-        if (!add_num_entries_c(h, &src, 1)) {
+        md_sponsor = h->ledger_version >= 14
+            ? active_sponsor_c(e, src_id) : NULL;
+        if (md_sponsor != NULL) {
+            int sc = sponsorship_error_c(rb, 10, -3,
+                establish_sponsorship_c(e, md_sponsor, &src, 1));
+            if (sc) {
+                PyMem_Free(kb.p);
+                return sc < 0 ? -1 : 0;
+            }
+            src.num_sub += 1;
+        } else if (!add_num_entries_c(h, &src, 1)) {
             PyMem_Free(kb.p);
             return res_inner(rb, 10, -3) < 0 ? -1 : 0;  /* LOW_RESERVE */
         }
-        if (eng_put_account(e, &e->tx_delta, &src) < 0) {
+        if (eng_put_account(e, e->cur, &src) < 0) {
             PyMem_Free(kb.p);
             return -1;
         }
@@ -4577,7 +5178,7 @@ op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         }
         RB *v = rb_new(eb.p, eb.len);
         PyMem_Free(eb.p);
-        rc2 = v ? eng_put(e, &e->tx_delta, kb.p, kb.len, v) : -1;
+        rc2 = v ? eng_put(e, e->cur, kb.p, kb.len, v) : -1;
         PyMem_Free(kb.p);
         if (rc2 < 0)
             return -1;
@@ -4587,13 +5188,21 @@ op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         write_account_id(&eb, src_id) < 0 ||
         buf_varopaque(&eb, name, (int)name_len) < 0 ||
         buf_varopaque(&eb, val, (int)val_len) < 0 ||
-        buf_i32(&eb, 0) < 0 || buf_i32(&eb, 0) < 0) {
+        buf_i32(&eb, 0) < 0) {
+        PyMem_Free(kb.p); PyMem_Free(eb.p);
+        return -1;
+    }
+    /* LedgerEntry ext: v1(sponsoringID) on a sandwich-sponsored create */
+    if (md_sponsor != NULL
+        ? (buf_i32(&eb, 1) < 0 || buf_u32(&eb, 1) < 0 ||
+           write_account_id(&eb, md_sponsor) < 0 || buf_i32(&eb, 0) < 0)
+        : buf_i32(&eb, 0) < 0) {
         PyMem_Free(kb.p); PyMem_Free(eb.p);
         return -1;
     }
     RB *v = rb_new(eb.p, eb.len);
     PyMem_Free(eb.p);
-    rc2 = v ? eng_put(e, &e->tx_delta, kb.p, kb.len, v) : -1;
+    rc2 = v ? eng_put(e, e->cur, kb.p, kb.len, v) : -1;
     PyMem_Free(kb.p);
     if (rc2 < 0)
         return -1;
@@ -4618,7 +5227,7 @@ op_bump_sequence(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     if (bump_to > src.seq_num) {
         src.seq_num = bump_to;
         src.last_modified = e->header.ledger_seq;
-        if (eng_put_account(e, &e->tx_delta, &src) < 0)
+        if (eng_put_account(e, e->cur, &src) < 0)
             return -1;
     }
     return res_inner(rb, 11, 0) < 0 ? -1 : 1;
@@ -4654,6 +5263,14 @@ op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
         return -1;
     if (src.flags & 0x4)
         return res_inner(rb, 8, -3) < 0 ? -1 : 0;    /* IMMUTABLE_SET */
+    if (h->ledger_version >= 14) {
+        /* a party to an OPEN Begin/End sandwich — sponsored account OR
+         * sponsor — cannot merge away mid-tx (mirror MergeOpFrame) */
+        for (int i = 0; i < e->n_sandwich; i++)
+            if (memcmp(e->sandwich[i].sponsored, src_id, 32) == 0 ||
+                memcmp(e->sandwich[i].sponsor, src_id, 32) == 0)
+                return res_inner(rb, 8, -7) < 0 ? -1 : 0;  /* IS_SPONSOR */
+    }
     if (src.num_sub != 0)
         return res_inner(rb, 8, -4) < 0 ? -1 : 0;    /* HAS_SUB_ENTRIES */
     if (src.num_sponsoring != 0)
@@ -4665,7 +5282,7 @@ op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     if (!add_balance_c(h, &dst, balance, 0))
         return res_inner(rb, 8, -6) < 0 ? -1 : 0;    /* DEST_FULL */
     dst.last_modified = h->ledger_seq;
-    if (eng_put_account(e, &e->tx_delta, &dst) < 0)
+    if (eng_put_account(e, e->cur, &dst) < 0)
         return -1;
     if (src.entry_ext_v1 && src.has_sponsor) {
         /* the dying account's entry releases its sponsor's 2 units */
@@ -4674,7 +5291,7 @@ op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
     }
     uint8_t kx[40];
     account_key_xdr_c(src_id, kx);
-    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+    if (eng_put(e, e->cur, kx, 40, NULL) < 0)
         return -1;
     /* success arm carries sourceAccountBalance (i64) */
     if (buf_i32(rb, 0) < 0 || buf_i32(rb, 8) < 0 ||
@@ -5188,7 +5805,7 @@ adjust_side_liab(Engine *e, const uint8_t acc[32], const CAssetC *asset,
             return 0;
         if (!account_add_liab(&e->header, &a, d_buying, d_selling))
             return 0;
-        return eng_put_account(e, &e->tx_delta, &a) < 0 ? -1 : 1;
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 1;
     }
     if (is_issuer_asset(acc, asset))
         return 1;
@@ -5216,7 +5833,7 @@ adjust_side_liab(Engine *e, const uint8_t acc[32], const CAssetC *asset,
     int rc = -1;
     if (serialize_trustline_entry(&tl, &eb) == 0) {
         RB *val = rb_new(eb.p, eb.len);
-        rc = (val && eng_put(e, &e->tx_delta, kb.p, kb.len, val) == 0)
+        rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
              ? 1 : -1;
     }
     PyMem_Free(eb.p);
@@ -5328,7 +5945,7 @@ transfer_c(Engine *e, const uint8_t acc[32], const CAssetC *asset,
             return 0;
         if (!add_balance_c(&e->header, &a, delta, 1))
             return 0;
-        return eng_put_account(e, &e->tx_delta, &a) < 0 ? -1 : 1;
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 1;
     }
     Buf kb = {0};
     if (trustline_key_xdr_c(acc, asset->type, asset->code, asset->issuer,
@@ -5354,7 +5971,7 @@ transfer_c(Engine *e, const uint8_t acc[32], const CAssetC *asset,
     int rc = -1;
     if (serialize_trustline_entry(&tl, &eb) == 0) {
         RB *val = rb_new(eb.p, eb.len);
-        rc = (val && eng_put(e, &e->tx_delta, kb.p, kb.len, val) == 0)
+        rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
              ? 1 : -1;
     }
     PyMem_Free(eb.p);
@@ -5406,8 +6023,16 @@ scan_book(Engine *e, const CAssetC *wheat, const CAssetC *sheep, CBook *bk)
     Map seen;
     if (map_init(&seen, 256) < 0)
         return -1;
-    Map *layers[3] = { &e->tx_delta, &e->ledger_delta, &e->store };
-    for (int li = 0; li < 3; li++) {
+    Map *layers[5];
+    int n_layers = 0;
+    if (e->hop_active)
+        layers[n_layers++] = &e->hop_delta;
+    if (e->op_active)
+        layers[n_layers++] = &e->op_delta;
+    layers[n_layers++] = &e->tx_delta;
+    layers[n_layers++] = &e->ledger_delta;
+    layers[n_layers++] = &e->store;
+    for (int li = 0; li < n_layers; li++) {
         Map *m = layers[li];
         for (int i = 0; i < m->cap; i++) {
             MapSlot *s = &m->slots[i];
@@ -5465,7 +6090,7 @@ erase_offer_c(Engine *e, const COffer *o)
             memcpy(sponsor, cur.sponsor, 32);
         }
     }
-    if (eng_put(e, &e->tx_delta, kx, 48, NULL) < 0)
+    if (eng_put(e, e->cur, kx, 48, NULL) < 0)
         return -1;
     CAccount acc;
     if (eng_get_account(e, o->seller, &acc) <= 0)
@@ -5475,7 +6100,7 @@ erase_offer_c(Engine *e, const COffer *o)
             return -1;
     }
     acc.num_sub -= 1;
-    return eng_put_account(e, &e->tx_delta, &acc);
+    return eng_put_account(e, e->cur, &acc);
 }
 
 typedef struct {
@@ -5595,7 +6220,7 @@ convert_with_offers_c(Engine *e, const CAssetC *sheep, const CAssetC *wheat,
                 }
                 RB *val = rb_new(eb.p, eb.len);
                 PyMem_Free(eb.p);
-                if (!val || eng_put(e, &e->tx_delta, kx, 48, val) < 0) {
+                if (!val || eng_put(e, e->cur, kx, 48, val) < 0) {
                     rc = -1;
                     break;
                 }
@@ -5707,7 +6332,7 @@ apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
             memcpy(old_sponsor, old.sponsor, 32);
         if (offer_liabilities(e, &old, 0) != 1)
             return -1;          /* oracle asserts the release succeeds */
-        if (eng_put(e, &e->tx_delta, kx, 48, NULL) < 0)
+        if (eng_put(e, e->cur, kx, 48, NULL) < 0)
             return -1;
         if (sell_amount == 0) {
             CAccount acc;
@@ -5718,7 +6343,7 @@ apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
                     return -1;
             }
             acc.num_sub -= 1;
-            if (eng_put_account(e, &e->tx_delta, &acc) < 0)
+            if (eng_put_account(e, e->cur, &acc) < 0)
                 return -1;
             CCross none;
             memset(&none, 0, sizeof(none));
@@ -5787,7 +6412,7 @@ apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
                 }
             }
             acc.num_sub -= 1;
-            if (eng_put_account(e, &e->tx_delta, &acc) < 0) {
+            if (eng_put_account(e, e->cur, &acc) < 0) {
                 PyMem_Free(cross.claims.p);
                 return -1;
             }
@@ -5814,9 +6439,23 @@ apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
             PyMem_Free(cross.claims.p);
             return -1;
         }
-        if (!add_num_entries_c(h, &acc, 1))
+        const uint8_t *sp_id = h->ledger_version >= 14
+            ? active_sponsor_c(e, src) : NULL;
+        if (sp_id != NULL) {
+            int sc = sponsorship_error_c(rb, op_type, -12,
+                establish_sponsorship_c(e, sp_id, &acc, 1));
+            if (sc) {
+                PyMem_Free(cross.claims.p);
+                return sc < 0 ? -1 : 0;
+            }
+            off.entry_ext_v1 = 1;
+            off.has_sponsor = 1;
+            memcpy(off.sponsor, sp_id, 32);
+            acc.num_sub += 1;
+        } else if (!add_num_entries_c(h, &acc, 1)) {
             MG_FAIL(-12);                             /* LOW_RESERVE */
-        if (eng_put_account(e, &e->tx_delta, &acc) < 0) {
+        }
+        if (eng_put_account(e, e->cur, &acc) < 0) {
             PyMem_Free(cross.claims.p);
             return -1;
         }
@@ -5839,7 +6478,7 @@ apply_manage_c(Engine *e, Buf *rb, int32_t op_type,
     offer_key_xdr_c(off.seller, off.offer_id, kx);
     RB *val = rb_new(eb.p, eb.len);
     PyMem_Free(eb.p);
-    if (!val || eng_put(e, &e->tx_delta, kx, 48, val) < 0) {
+    if (!val || eng_put(e, e->cur, kx, 48, val) < 0) {
         PyMem_Free(cross.claims.p);
         return -1;
     }
@@ -6195,12 +6834,24 @@ op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
     if (malformed)
         return res_inner(rb, 14, -1) < 0 ? -1 : 0;   /* MALFORMED */
 
+    /* reserve for claimants is a sponsored reserve: the sandwich sponsor
+     * takes it when one is active for the source, else the source
+     * sponsors its own creation (mirror CreateClaimableBalanceOpFrame) */
+    const uint8_t *cb_sponsor = active_sponsor_c(e, src);
+    if (cb_sponsor != NULL) {
+        int sc = sponsorship_error_c(rb, 14, -2,
+            establish_sponsorship_c(e, cb_sponsor, NULL, (int)nc));
+        if (sc)
+            return sc < 0 ? -1 : 0;
+    }
     CAccount srca;
     if (eng_get_account(e, src, &srca) <= 0)
         return -1;
-    /* the source sponsors its own creation (no sandwich natively) */
-    if (!add_num_sponsoring_c(h, &srca, (int)nc))
-        return res_inner(rb, 14, -2) < 0 ? -1 : 0;   /* LOW_RESERVE */
+    if (cb_sponsor == NULL) {
+        cb_sponsor = src;
+        if (!add_num_sponsoring_c(h, &srca, (int)nc))
+            return res_inner(rb, 14, -2) < 0 ? -1 : 0;   /* LOW_RESERVE */
+    }
     if (asset.type == 0) {
         if (!add_balance_c(h, &srca, -amount, 1))
             return res_inner(rb, 14, -5) < 0 ? -1 : 0;  /* UNDERFUNDED */
@@ -6276,7 +6927,7 @@ op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
         }
     }
     srca.last_modified = h->ledger_seq;
-    if (eng_put_account(e, &e->tx_delta, &srca) < 0)
+    if (eng_put_account(e, e->cur, &srca) < 0)
         return -1;
     /* build the CB LedgerEntry */
     Buf eb = {0};
@@ -6299,9 +6950,9 @@ op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
         PyMem_Free(eb.p);
         return -1;
     }
-    /* LedgerEntry ext v1 with sponsoringID = source */
+    /* LedgerEntry ext v1 with sponsoringID = sandwich sponsor or source */
     if (buf_i32(&eb, 1) < 0 || buf_u32(&eb, 1) < 0 ||
-        write_account_id(&eb, src) < 0 || buf_i32(&eb, 0) < 0) {
+        write_account_id(&eb, cb_sponsor) < 0 || buf_i32(&eb, 0) < 0) {
         PyMem_Free(eb.p);
         return -1;
     }
@@ -6309,7 +6960,7 @@ op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
     cb_key_xdr_c(bid, kx);
     RB *val = rb_new(eb.p, eb.len);
     PyMem_Free(eb.p);
-    if (!val || eng_put(e, &e->tx_delta, kx, 40, val) < 0)
+    if (!val || eng_put(e, e->cur, kx, 40, val) < 0)
         return -1;
     /* success carries the balance id */
     if (buf_i32(rb, 0) < 0 || buf_i32(rb, 14) < 0 || buf_i32(rb, 0) < 0 ||
@@ -6359,7 +7010,7 @@ op_claim_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
         if (!add_balance_c(h, &acc, cb.amount, 0))
             return res_inner(rb, 15, -3) < 0 ? -1 : 0;  /* LINE_FULL */
         acc.last_modified = h->ledger_seq;
-        if (eng_put_account(e, &e->tx_delta, &acc) < 0)
+        if (eng_put_account(e, e->cur, &acc) < 0)
             return -1;
     } else if (!is_issuer_asset(src, &cb.asset)) {
         Buf kb = {0};
@@ -6395,7 +7046,7 @@ op_claim_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
         if (release_cb_reserve(e, cb.sponsor, cb.n_claimants) < 0)
             return -1;
     }
-    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+    if (eng_put(e, e->cur, kx, 40, NULL) < 0)
         return -1;
     return res_inner(rb, 15, 0) < 0 ? -1 : 1;
 }
@@ -6427,7 +7078,1765 @@ op_clawback_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
         if (release_cb_reserve(e, cb.sponsor, cb.n_claimants) < 0)
             return -1;
     }
-    if (eng_put(e, &e->tx_delta, kx, 40, NULL) < 0)
+    if (eng_put(e, e->cur, kx, 40, NULL) < 0)
         return -1;
     return res_inner(rb, 20, 0) < 0 ? -1 : 1;
+}
+
+/* ---- CAP-33 sponsorship core (round 12) -------------------------------- *
+ *
+ * Mirrors transactions/sponsorship.py: establish/release move the
+ * sponsor's numSponsoring (sponsor loaded and stored HERE — callers must
+ * not hold a copy of it across the call) and the owner's numSponsored
+ * (mutated in the caller's CAccount, stored by the caller), exactly the
+ * load/update sequencing of the oracle.
+ */
+
+/* materialize the v1+v2 extension chain (mirror _ensure_acc_ext_v2):
+ * signerSponsoringIDs padded to the signer count on v2 materialization */
+static void
+acc_ensure_v2(CAccount *a)
+{
+    if (a->ext_level < 1)
+        a->ext_level = 1;               /* liabilities start zeroed */
+    if (a->ext_level < 2) {
+        a->ext_level = 2;
+        while (a->n_ssids < a->n_signers) {
+            a->ssids[a->n_ssids].present = 0;
+            a->n_ssids++;
+        }
+    }
+}
+
+/* mirror establish_sponsorship: SP_SUCCESS / SP_LOW_RESERVE / SP_TOO_MANY
+ * or -1 on engine error (missing sponsor = corrupt state, like the
+ * oracle's RuntimeError) */
+static int
+establish_sponsorship_c(Engine *e, const uint8_t sponsor_id[32],
+                        CAccount *owner, int mult)
+{
+    CHeader *h = &e->header;
+    CAccount sp;
+    int got = eng_get_account(e, sponsor_id, &sp);
+    if (got <= 0)
+        return -1;
+    if (sp.num_sponsoring > 0xFFFFFFFFu - (uint32_t)mult)
+        return SP_TOO_MANY;
+    i128 need = ((i128)2 + sp.num_sub + sp.num_sponsoring + mult
+                 - sp.num_sponsored) * (i128)h->base_reserve;
+    if ((i128)sp.balance < need + sp.liab_selling)
+        return SP_LOW_RESERVE;
+    if (owner != NULL &&
+        owner->num_sponsored > 0xFFFFFFFFu - (uint32_t)mult)
+        return SP_TOO_MANY;
+    acc_ensure_v2(&sp);
+    sp.num_sponsoring += (uint32_t)mult;
+    sp.last_modified = h->ledger_seq;
+    if (eng_put_account(e, e->cur, &sp) < 0)
+        return -1;
+    if (owner != NULL) {
+        acc_ensure_v2(owner);
+        owner->num_sponsored += (uint32_t)mult;
+    }
+    return SP_SUCCESS;
+}
+
+/* map a SponsorshipResult into the op result stream: 0 = success
+ * (nothing written), 1 = failure result written, -1 = engine error.
+ * TOO_MANY maps to the outer opTOO_MANY_SPONSORING (mirror
+ * OperationFrame.sponsorship_error). */
+static int
+sponsorship_error_c(Buf *rb, int32_t op_type, int32_t low_code, int code)
+{
+    (void)op_type;
+    if (code < 0)
+        return -1;
+    if (code == SP_SUCCESS)
+        return 0;
+    if (code == SP_LOW_RESERVE)
+        return res_inner(rb, op_type, low_code) < 0 ? -1 : 1;
+    return res_outer(rb, -6) < 0 ? -1 : 1;   /* opTOO_MANY_SPONSORING */
+}
+
+/* mirror owner_can_afford: after taking back `mult` reserve units, does
+ * the owner's balance still cover its minimum? */
+static int
+owner_can_afford_c(const CHeader *h, const CAccount *a, int mult)
+{
+    i128 need = ((i128)2 + a->num_sub + a->num_sponsoring
+                 - ((i128)a->num_sponsored - mult)) * (i128)h->base_reserve;
+    return (i128)a->balance >= need + a->liab_selling;
+}
+
+/* ---- Begin/End/RevokeSponsorship op frames ----------------------------- */
+
+/* mirror BeginSponsoringFutureReservesOpFrame (v14+, MED threshold) */
+static int
+op_begin_sponsoring(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                    Buf *rb)
+{
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    rd_skip(&r, 4);                           /* PK type */
+    const uint8_t *sponsored = rd_take(&r, 32);
+    if (!sponsored || r.err)
+        return -1;
+    /* do_check_valid */
+    if (memcmp(sponsored, src, 32) == 0)
+        return res_inner(rb, 16, -1) < 0 ? -1 : 0;      /* MALFORMED */
+    /* do_apply (ctx mutations only on success — no rollback needed) */
+    for (int i = 0; i < e->n_sandwich; i++)
+        if (memcmp(e->sandwich[i].sponsored, sponsored, 32) == 0)
+            return res_inner(rb, 16, -2) < 0 ? -1 : 0;  /* ALREADY_SPONSORED */
+    for (int i = 0; i < e->n_sandwich; i++)
+        if (memcmp(e->sandwich[i].sponsored, src, 32) == 0)
+            return res_inner(rb, 16, -3) < 0 ? -1 : 0;  /* RECURSIVE */
+    for (int i = 0; i < e->n_sandwich; i++)
+        if (memcmp(e->sandwich[i].sponsor, sponsored, 32) == 0)
+            return res_inner(rb, 16, -3) < 0 ? -1 : 0;  /* RECURSIVE */
+    if (e->n_sandwich >= MAX_OPS)
+        return -1;                     /* unreachable: one Begin per op */
+    memcpy(e->sandwich[e->n_sandwich].sponsored, sponsored, 32);
+    memcpy(e->sandwich[e->n_sandwich].sponsor, src, 32);
+    e->n_sandwich++;
+    return res_inner(rb, 16, 0) < 0 ? -1 : 1;
+}
+
+/* mirror EndSponsoringFutureReservesOpFrame (v14+) */
+static int
+op_end_sponsoring(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                  Buf *rb)
+{
+    (void)op;
+    for (int i = 0; i < e->n_sandwich; i++) {
+        if (memcmp(e->sandwich[i].sponsored, src, 32) == 0) {
+            for (int j = i; j + 1 < e->n_sandwich; j++)
+                e->sandwich[j] = e->sandwich[j + 1];
+            e->n_sandwich--;
+            return res_inner(rb, 17, 0) < 0 ? -1 : 1;
+        }
+    }
+    return res_inner(rb, 17, -1) < 0 ? -1 : 0;   /* NOT_SPONSORED */
+}
+
+/* Walk a stored LedgerEntry record to its LedgerEntry-level ext (the
+ * suffix).  Fills ext_off / has_sponsor / sponsor; returns the entry
+ * type, or -1 on malformed bytes (fail-stop: stored state is trusted). */
+static int
+walk_entry_ext(const uint8_t *rec, int len, int *ext_off,
+               int *has_sponsor, uint8_t sponsor[32])
+{
+    Rd r;
+    rd_init(&r, rec, len);
+    rd_skip(&r, 4);                           /* lastModified */
+    int32_t t = rd_i32(&r);
+    if (r.err)
+        return -1;
+    switch (t) {
+    case 0: {                                 /* ACCOUNT */
+        rd_skip(&r, 36 + 8 + 8 + 4);
+        uint32_t hi = rd_u32(&r);
+        if (r.err || hi > 1) return -1;
+        if (hi) rd_skip(&r, 36);
+        rd_skip(&r, 4);                       /* flags */
+        uint32_t hl;
+        if (!rd_varopaque(&r, 32, &hl)) return -1;
+        rd_skip(&r, 4);                       /* thresholds */
+        uint32_t ns = rd_u32(&r);
+        if (r.err || ns > 20) return -1;
+        for (uint32_t i = 0; i < ns; i++) {
+            CSigner sg;
+            if (parse_signer_key(&r, &sg) < 0) return -1;
+            rd_skip(&r, 4);
+        }
+        int32_t ext = rd_i32(&r);
+        if (r.err || (ext != 0 && ext != 1)) return -1;
+        if (ext == 1) {
+            rd_skip(&r, 16);                  /* liabilities */
+            int32_t e1 = rd_i32(&r);
+            if (r.err || (e1 != 0 && e1 != 2)) return -1;
+            if (e1 == 2) {
+                rd_skip(&r, 8);               /* numSponsored/ing */
+                uint32_t nss = rd_u32(&r);
+                if (r.err || nss > 20) return -1;
+                for (uint32_t i = 0; i < nss; i++) {
+                    uint32_t p = rd_u32(&r);
+                    if (r.err || p > 1) return -1;
+                    if (p) rd_skip(&r, 36);
+                }
+                int32_t e2 = rd_i32(&r);
+                if (r.err || (e2 != 0 && e2 != 3)) return -1;
+                if (e2 == 3) rd_skip(&r, 4 + 4 + 8);
+            }
+        }
+        break;
+    }
+    case 1: {                                 /* TRUSTLINE */
+        rd_skip(&r, 36);
+        uint32_t at = rd_u32(&r);
+        if (r.err) return -1;
+        if (at == 1 || at == 2) {
+            rd_skip(&r, at == 1 ? 4 : 12);
+            if (rd_u32(&r) != 0) return -1;
+            rd_skip(&r, 32);
+        } else if (at == 3) {
+            rd_skip(&r, 32);
+        } else
+            return -1;
+        rd_skip(&r, 8 + 8 + 4);
+        int32_t ext = rd_i32(&r);
+        if (r.err || (ext != 0 && ext != 1)) return -1;
+        if (ext == 1) {
+            rd_skip(&r, 16);
+            int32_t e1 = rd_i32(&r);
+            if (r.err || (e1 != 0 && e1 != 2)) return -1;
+            if (e1 == 2) {
+                rd_skip(&r, 4);
+                if (rd_i32(&r) != 0 || r.err) return -1;
+            }
+        }
+        break;
+    }
+    case 2: {                                 /* OFFER */
+        rd_skip(&r, 36 + 8);
+        if (skip_asset(&r) < 0 || skip_asset(&r) < 0) return -1;
+        rd_skip(&r, 8 + 8 + 4);
+        if (rd_i32(&r) != 0 || r.err) return -1;
+        break;
+    }
+    case 3: {                                 /* DATA */
+        rd_skip(&r, 36);
+        uint32_t nl, vl;
+        if (!rd_varopaque(&r, 64, &nl) || !rd_varopaque(&r, 64, &vl))
+            return -1;
+        if (rd_i32(&r) != 0 || r.err) return -1;
+        break;
+    }
+    case 4: {                                 /* CLAIMABLE_BALANCE */
+        if (rd_u32(&r) != 0 || r.err) return -1;   /* bid v0 */
+        rd_skip(&r, 32);
+        uint32_t nc = rd_u32(&r);
+        if (r.err || nc > 10) return -1;
+        for (uint32_t i = 0; i < nc; i++) {
+            if (rd_u32(&r) != 0 || r.err) return -1;
+            if (rd_u32(&r) != 0 || r.err) return -1;
+            rd_skip(&r, 32);
+            if (skip_predicate(&r, 0) < 0) return -1;
+        }
+        if (skip_asset(&r) < 0) return -1;
+        rd_skip(&r, 8);
+        int32_t ext = rd_i32(&r);
+        if (r.err || (ext != 0 && ext != 1)) return -1;
+        if (ext == 1) {
+            if (rd_i32(&r) != 0 || r.err) return -1;
+            rd_skip(&r, 4);                   /* flags */
+        }
+        break;
+    }
+    case 5: {                                 /* LIQUIDITY_POOL */
+        rd_skip(&r, 32);
+        if (rd_u32(&r) != 0 || r.err) return -1;
+        if (skip_asset(&r) < 0 || skip_asset(&r) < 0) return -1;
+        rd_skip(&r, 4 + 8 + 8 + 8 + 8);
+        break;
+    }
+    default:
+        return -1;
+    }
+    *ext_off = r.off;
+    int32_t lext = rd_i32(&r);
+    if (r.err || (lext != 0 && lext != 1)) return -1;
+    *has_sponsor = 0;
+    if (lext == 1) {
+        uint32_t sp = rd_u32(&r);
+        if (r.err || sp > 1) return -1;
+        if (sp) {
+            if (rd_u32(&r) != 0 || r.err) return -1;
+            const uint8_t *q = rd_take(&r, 32);
+            if (!q) return -1;
+            memcpy(sponsor, q, 32);
+            *has_sponsor = 1;
+        }
+        if (rd_i32(&r) != 0 || r.err) return -1;
+    }
+    return r.off == len ? t : -1;
+}
+
+/* store a copy of `rec` with lastModified = seq and the LedgerEntry-level
+ * ext replaced */
+static int
+store_entry_with_ext(Engine *e, const uint8_t *key, int klen,
+                     const RB *rec, int ext_off, int has_sponsor,
+                     const uint8_t sponsor[32])
+{
+    CHeader *h = &e->header;
+    Buf b = {0};
+    if (buf_u32(&b, h->ledger_seq) < 0 ||
+        buf_put(&b, rec->bytes + 4, ext_off - 4) < 0)
+        goto fail;
+    if (has_sponsor) {
+        if (buf_i32(&b, 1) < 0 || buf_u32(&b, 1) < 0 ||
+            write_account_id(&b, sponsor) < 0 || buf_i32(&b, 0) < 0)
+            goto fail;
+    } else if (buf_i32(&b, 0) < 0)
+        goto fail;
+    RB *val = rb_new(b.p, b.len);
+    PyMem_Free(b.p);
+    if (!val || eng_put(e, e->cur, key, klen, val) < 0)
+        return -1;
+    return 0;
+fail:
+    PyMem_Free(b.p);
+    return -1;
+}
+
+/* reserve units a stored entry pins (mirror compute_multiplier): 2 for
+ * an account, #claimants for a claimable balance, 2 for a pool-share
+ * trustline, 1 otherwise */
+static int
+entry_multiplier(const RB *rec, int type)
+{
+    if (type == 0)
+        return 2;
+    if (type == 1) {
+        /* TrustLineAsset tag sits after lastMod(4)+tag(4)+accountID(36) */
+        if (rec->len >= 48 && rec->bytes[47] == 3 && rec->bytes[46] == 0 &&
+            rec->bytes[45] == 0 && rec->bytes[44] == 0)
+            return 2;                         /* pool share */
+        return 1;
+    }
+    if (type == 4) {
+        /* claimant count after lastMod(4)+tag(4)+bidV0(4)+hash(32) */
+        if (rec->len < 48)
+            return 1;
+        return (int)(((uint32_t)rec->bytes[44] << 24) |
+                     ((uint32_t)rec->bytes[45] << 16) |
+                     ((uint32_t)rec->bytes[46] << 8) | rec->bytes[47]);
+    }
+    return 1;
+}
+
+/* mirror RevokeSponsorshipOpFrame (v14+, MED threshold) */
+static int
+op_revoke_sponsorship(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                      Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    uint32_t arm = rd_u32(&r);
+    if (r.err)
+        return -1;
+
+    if (arm == 1) {                           /* SIGNER arm */
+        uint8_t acc_id[32];
+        if (parse_account_id(&r, acc_id) < 0)
+            return -1;
+        CSigner want;
+        if (parse_signer_key(&r, &want) < 0 || r.err)
+            return -1;
+        CAccount acc;
+        int got = eng_get_account(e, acc_id, &acc);
+        if (got < 0)
+            return -1;
+        if (!got)
+            return res_inner(rb, 18, -1) < 0 ? -1 : 0;  /* DOES_NOT_EXIST */
+        uint8_t want_kx[104];
+        int want_klen = signer_key_xdr(&want, want_kx);
+        int idx = -1;
+        for (int i = 0; i < acc.n_signers; i++) {
+            uint8_t kx[104];
+            int klen = signer_key_xdr(&acc.signers[i], kx);
+            if (klen == want_klen && memcmp(kx, want_kx, klen) == 0) {
+                idx = i;
+                break;
+            }
+        }
+        if (idx < 0)
+            return res_inner(rb, 18, -1) < 0 ? -1 : 0;  /* DOES_NOT_EXIST */
+        int old_sp = acc.ext_level >= 2 && idx < acc.n_ssids &&
+                     acc.ssids[idx].present;
+        uint8_t old_sponsor[32];
+        if (old_sp)
+            memcpy(old_sponsor, acc.ssids[idx].id, 32);
+        const uint8_t *new_sp = active_sponsor_c(e, src);
+        if (new_sp != NULL && memcmp(new_sp, acc_id, 32) == 0)
+            new_sp = NULL;          /* owner reclaiming its own reserve */
+        if (old_sp) {
+            if (memcmp(src, old_sponsor, 32) != 0)
+                return res_inner(rb, 18, -2) < 0 ? -1 : 0;  /* NOT_SPONSOR */
+        } else if (memcmp(src, acc_id, 32) != 0) {
+            return res_inner(rb, 18, -2) < 0 ? -1 : 0;      /* NOT_SPONSOR */
+        }
+        if ((old_sp && new_sp != NULL &&
+             memcmp(old_sponsor, new_sp, 32) == 0) ||
+            (!old_sp && new_sp == NULL))
+            return res_inner(rb, 18, 0) < 0 ? -1 : 1;       /* no-op */
+        if (old_sp) {
+            if (new_sp == NULL && !owner_can_afford_c(h, &acc, 1))
+                return res_inner(rb, 18, -3) < 0 ? -1 : 0;  /* LOW_RESERVE */
+            /* release_signer_sponsorship */
+            CAccount sp;
+            int g = eng_get_account(e, old_sponsor, &sp);
+            if (g < 0)
+                return -1;
+            if (g) {
+                if (sp.num_sponsoring < 1)
+                    return -1;
+                acc_ensure_v2(&sp);
+                sp.num_sponsoring -= 1;
+                sp.last_modified = h->ledger_seq;
+                if (eng_put_account(e, e->cur, &sp) < 0)
+                    return -1;
+            }
+            if (acc.num_sponsored < 1)
+                return -1;
+            acc_ensure_v2(&acc);
+            acc.num_sponsored -= 1;
+        }
+        if (new_sp != NULL) {
+            int sc = sponsorship_error_c(rb, 18, -3,
+                establish_sponsorship_c(e, new_sp, &acc, 1));
+            if (sc)
+                return sc < 0 ? -1 : 0;
+        }
+        /* aligned sponsoring-slot write */
+        acc_ensure_v2(&acc);
+        while (acc.n_ssids < acc.n_signers) {
+            acc.ssids[acc.n_ssids].present = 0;
+            acc.n_ssids++;
+        }
+        acc.ssids[idx].present = new_sp != NULL;
+        if (new_sp != NULL)
+            memcpy(acc.ssids[idx].id, new_sp, 32);
+        acc.last_modified = h->ledger_seq;
+        if (eng_put_account(e, e->cur, &acc) < 0)
+            return -1;
+        return res_inner(rb, 18, 0) < 0 ? -1 : 1;
+    }
+    if (arm != 0)
+        return -1;
+
+    /* LEDGER_ENTRY arm: the raw LedgerKey is the body slice after the
+     * arm tag (XDR is canonical, so the slice IS the lookup key) */
+    const uint8_t *key = op->body + r.off;
+    uint32_t kt = rd_u32(&r);
+    if (r.err)
+        return -1;
+    if (kt > 4)
+        return res_inner(rb, 18, -5) < 0 ? -1 : 0;  /* MALFORMED */
+    /* walk the key to find its length (parse_op validated the shape) */
+    switch (kt) {
+    case 0: rd_skip(&r, 36); break;
+    case 1: {
+        rd_skip(&r, 36);
+        uint32_t at = rd_u32(&r);
+        if (at == 1 || at == 2) { rd_skip(&r, at == 1 ? 4 : 12);
+                                  rd_skip(&r, 36); }
+        else if (at == 3) rd_skip(&r, 32);
+        else if (at != 0) { r.err = 1; }
+        break;
+    }
+    case 2: rd_skip(&r, 36 + 8); break;
+    case 3: {
+        rd_skip(&r, 36);
+        uint32_t nl;
+        if (!rd_varopaque(&r, 64, &nl)) return -1;
+        break;
+    }
+    case 4: rd_skip(&r, 4 + 32); break;
+    }
+    if (r.err)
+        return -1;
+    int klen = (int)(op->body + r.off - key);
+    RB *rec = eng_get(e, key, klen);
+    if (!rec)
+        return res_inner(rb, 18, -1) < 0 ? -1 : 0;  /* DOES_NOT_EXIST */
+
+    /* owner of the reserve (NULL for claimable balances) */
+    const uint8_t *owner_id = NULL;
+    if (kt == 0 || kt == 1 || kt == 3)
+        owner_id = key + 8;                   /* tag + PK type, then id */
+    else if (kt == 2)
+        owner_id = key + 8;                   /* sellerID */
+
+    int ext_off, old_sp;
+    uint8_t old_sponsor[32];
+    int etype = walk_entry_ext(rec->bytes, rec->len, &ext_off, &old_sp,
+                               old_sponsor);
+    if (etype < 0)
+        return -1;
+    const uint8_t *new_sp = active_sponsor_c(e, src);
+    if (new_sp != NULL && owner_id != NULL &&
+        memcmp(new_sp, owner_id, 32) == 0)
+        new_sp = NULL;              /* owner reclaiming its own reserve */
+    if (old_sp) {
+        if (memcmp(src, old_sponsor, 32) != 0)
+            return res_inner(rb, 18, -2) < 0 ? -1 : 0;      /* NOT_SPONSOR */
+    } else if (owner_id == NULL || memcmp(src, owner_id, 32) != 0) {
+        return res_inner(rb, 18, -2) < 0 ? -1 : 0;          /* NOT_SPONSOR */
+    }
+    if ((old_sp && new_sp != NULL && memcmp(old_sponsor, new_sp, 32) == 0)
+        || (!old_sp && new_sp == NULL))
+        return res_inner(rb, 18, 0) < 0 ? -1 : 1;           /* no-op */
+    int mult = entry_multiplier(rec, (int)kt);
+    int own_is_entry = kt == 0;
+    CAccount owner;
+    int have_owner = 0;
+    if (own_is_entry) {
+        if (parse_account_entry(rec->bytes, rec->len, &owner) < 0)
+            return -1;
+        have_owner = 1;
+    } else if (owner_id != NULL) {
+        int g = eng_get_account(e, owner_id, &owner);
+        if (g <= 0)
+            return -1;              /* owner must exist: corrupt state */
+        have_owner = 1;
+    }
+    int entry_has_sponsor = old_sp;
+    uint8_t entry_sponsor[32];
+    if (old_sp) {
+        if (new_sp == NULL && owner_id == NULL)
+            return res_inner(rb, 18, -4) < 0 ? -1 : 0;  /* ONLY_TRANSFERABLE */
+        if (new_sp == NULL && have_owner &&
+            !owner_can_afford_c(h, &owner, mult))
+            return res_inner(rb, 18, -3) < 0 ? -1 : 0;  /* LOW_RESERVE */
+        /* release_entry_sponsorship: sponsor side + owner side */
+        CAccount sp;
+        int g = eng_get_account(e, old_sponsor, &sp);
+        if (g < 0)
+            return -1;
+        if (g) {
+            if ((int)sp.num_sponsoring < mult)
+                return -1;
+            acc_ensure_v2(&sp);
+            sp.num_sponsoring -= (uint32_t)mult;
+            sp.last_modified = h->ledger_seq;
+            if (eng_put_account(e, e->cur, &sp) < 0)
+                return -1;
+        }
+        if (have_owner) {
+            if ((int)owner.num_sponsored < mult)
+                return -1;
+            acc_ensure_v2(&owner);
+            owner.num_sponsored -= (uint32_t)mult;
+        }
+        entry_has_sponsor = 0;
+    }
+    if (new_sp != NULL) {
+        int sc = sponsorship_error_c(rb, 18, -3,
+            establish_sponsorship_c(e, new_sp,
+                                    have_owner ? &owner : NULL, mult));
+        if (sc)
+            return sc < 0 ? -1 : 0;
+        entry_has_sponsor = 1;
+        memcpy(entry_sponsor, new_sp, 32);
+    }
+    if (own_is_entry) {
+        /* the entry IS the owner account: one serialize carries both the
+         * counter changes and the rewritten ext */
+        owner.entry_ext_v1 = entry_has_sponsor ? 1 : 0;
+        owner.has_sponsor = entry_has_sponsor;
+        if (entry_has_sponsor)
+            memcpy(owner.sponsor, entry_sponsor, 32);
+        owner.last_modified = h->ledger_seq;
+        if (eng_put_account(e, e->cur, &owner) < 0)
+            return -1;
+    } else {
+        if (store_entry_with_ext(e, key, klen, rec, ext_off,
+                                 entry_has_sponsor, entry_sponsor) < 0)
+            return -1;
+        if (have_owner) {
+            owner.last_modified = h->ledger_seq;
+            if (eng_put_account(e, e->cur, &owner) < 0)
+                return -1;
+        }
+    }
+    return res_inner(rb, 18, 0) < 0 ? -1 : 1;
+}
+
+/* ---- liquidity pools (CAP-38 constant product, round 12) --------------- */
+
+#define POOL_FEE_BPS_C 30
+
+typedef struct {
+    uint32_t last_modified;
+    int entry_ext_v1, has_sponsor;
+    uint8_t sponsor[32];
+    uint8_t pool_id[32];
+    CAssetC asset_a, asset_b;
+    int32_t fee;
+    int64_t reserve_a, reserve_b, total_shares, tl_count;
+} CPoolEntry;
+
+static int
+parse_pool_entry(const uint8_t *data, int len, CPoolEntry *p)
+{
+    memset(p, 0, sizeof(*p));
+    Rd r;
+    rd_init(&r, data, len);
+    p->last_modified = rd_u32(&r);
+    if (rd_u32(&r) != 5 || r.err)       /* data tag LIQUIDITY_POOL */
+        return -1;
+    const uint8_t *pid = rd_take(&r, 32);
+    if (!pid)
+        return -1;
+    memcpy(p->pool_id, pid, 32);
+    if (rd_u32(&r) != 0 || r.err)       /* body tag constantProduct */
+        return -1;
+    if (parse_asset(&r, &p->asset_a) < 0 || parse_asset(&r, &p->asset_b) < 0)
+        return -1;
+    p->fee = rd_i32(&r);
+    p->reserve_a = rd_i64(&r);
+    p->reserve_b = rd_i64(&r);
+    p->total_shares = rd_i64(&r);
+    p->tl_count = rd_i64(&r);
+    int32_t lext = rd_i32(&r);
+    if (r.err || (lext != 0 && lext != 1))
+        return -1;
+    p->entry_ext_v1 = (int)lext;
+    if (lext == 1) {
+        uint32_t sp = rd_u32(&r);
+        if (r.err || sp > 1)
+            return -1;
+        p->has_sponsor = (int)sp;
+        if (sp && parse_account_id(&r, p->sponsor) < 0)
+            return -1;
+        if (rd_i32(&r) != 0 || r.err)
+            return -1;
+    }
+    return (r.err || r.off != r.len) ? -1 : 0;
+}
+
+static int
+serialize_pool_entry(const CPoolEntry *p, Buf *b)
+{
+    if (buf_u32(b, p->last_modified) < 0 || buf_u32(b, 5) < 0 ||
+        buf_put(b, p->pool_id, 32) < 0 ||
+        buf_u32(b, 0) < 0 ||
+        write_asset(b, &p->asset_a) < 0 ||
+        write_asset(b, &p->asset_b) < 0 ||
+        buf_i32(b, p->fee) < 0 ||
+        buf_i64(b, p->reserve_a) < 0 ||
+        buf_i64(b, p->reserve_b) < 0 ||
+        buf_i64(b, p->total_shares) < 0 ||
+        buf_i64(b, p->tl_count) < 0 ||
+        buf_i32(b, p->entry_ext_v1) < 0)
+        return -1;
+    if (p->entry_ext_v1) {
+        if (buf_u32(b, (uint32_t)p->has_sponsor) < 0)
+            return -1;
+        if (p->has_sponsor && write_account_id(b, p->sponsor) < 0)
+            return -1;
+        if (buf_i32(b, 0) < 0)
+            return -1;
+    }
+    return 0;
+}
+
+/* pool LedgerKey XDR: tag LIQUIDITY_POOL(5) + PoolID */
+static void
+pool_key_xdr_c(const uint8_t pool_id[32], uint8_t out[36])
+{
+    memset(out, 0, 4);
+    out[3] = 5;
+    memcpy(out + 4, pool_id, 32);
+}
+
+/* PoolID = SHA256(xdr(LiquidityPoolParameters)) (mirror pool_id_for) */
+static int
+pool_id_for_c(const CAssetC *a, const CAssetC *b, int32_t fee,
+              uint8_t out[32])
+{
+    Buf pb = {0};
+    if (buf_u32(&pb, 0) < 0 || write_asset(&pb, a) < 0 ||
+        write_asset(&pb, b) < 0 || buf_i32(&pb, fee) < 0) {
+        PyMem_Free(pb.p);
+        return -1;
+    }
+    sha256_of(pb.p, pb.len, out);
+    PyMem_Free(pb.p);
+    return 0;
+}
+
+/* canonical asset ordering = lexicographic XDR compare (mirror
+ * asset_order); *err set on allocation failure */
+static int
+asset_order_c(const CAssetC *a, const CAssetC *b, int *err)
+{
+    Buf ba = {0}, bb = {0};
+    int c = 0;
+    *err = 0;
+    if (write_asset(&ba, a) < 0 || write_asset(&bb, b) < 0)
+        *err = 1;
+    else
+        c = bcmp_py(ba.p, ba.len, bb.p, bb.len);
+    PyMem_Free(ba.p);
+    PyMem_Free(bb.p);
+    return c;
+}
+
+/* floor((a * m) / d) without overflowing 128 bits (a <= 2^126, m <= 10^4,
+ * d <= 2^78): decompose a = q*d + r.  rem_nonzero reports whether the
+ * true quotient had a remainder (for ceil). */
+static u128
+muldiv_u128(u128 a, uint64_t m, u128 d, int *rem_nonzero)
+{
+    u128 q = a / d, r = a % d;
+    u128 low = r * (u128)m;
+    if (rem_nonzero)
+        *rem_nonzero = (low % d) != 0;
+    return q * (u128)m + low / d;
+}
+
+/* strict-send disbursement y = floor(Y*x*(1-F) / (X + x*(1-F))) exactly
+ * in basis points (mirror pool_swap_out_given_in) */
+static int64_t
+pool_swap_out_given_in_c(int64_t rin, int64_t rout, int64_t in_amt)
+{
+    u128 den = (u128)rin * 10000 +
+               (u128)in_amt * (10000 - POOL_FEE_BPS_C);
+    if (den == 0)
+        return 0;
+    u128 q = muldiv_u128((u128)rout * (u128)in_amt,
+                         10000 - POOL_FEE_BPS_C, den, NULL);
+    return (int64_t)q;
+}
+
+/* strict-receive charge x = ceil(X*y / ((Y-y)*(1-F))); -1 = the pool
+ * cannot disburse amount_out (mirror pool_swap_in_given_out's None) */
+static int64_t
+pool_swap_in_given_out_c(int64_t rin, int64_t rout, int64_t out_amt)
+{
+    if (out_amt >= rout)
+        return -1;
+    u128 den = (u128)(rout - out_amt) * (10000 - POOL_FEE_BPS_C);
+    int rem;
+    u128 q = muldiv_u128((u128)rin * (u128)out_amt, 10000, den, &rem);
+    if (rem)
+        q += 1;
+    if (q > (u128)INT64_MAXV)
+        return -1;
+    return (int64_t)q;
+}
+
+/* floor(sqrt(n)) by integer Newton iteration */
+static u128
+isqrt_u128(u128 n)
+{
+    if (n == 0)
+        return 0;
+    u128 x = n, y = (x + 1) / 2;
+    while (y < x) {
+        x = y;
+        y = (x + n / x) / 2;
+    }
+    return x;
+}
+
+/* one side of a pool deposit (mirror LiquidityPoolDepositOpFrame._spend):
+ * 1 ok / 0 failed / -1 engine error */
+static int
+pool_spend_c(Engine *e, const uint8_t src[32], const CAssetC *asset,
+             int64_t amount)
+{
+    if (asset->type == 0) {
+        CAccount a;
+        int got = eng_get_account(e, src, &a);
+        if (got < 0)
+            return -1;
+        if (!got || !add_balance_c(&e->header, &a, -amount, 1))
+            return 0;
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 1;
+    }
+    if (is_issuer_asset(src, asset))
+        return 1;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(src, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int rc = 0;
+    CTrustLine tl;
+    if (rec != NULL &&
+        parse_trustline_entry(rec->bytes, rec->len, &tl) == 0 &&
+        (tl.flags & 1) && add_tl_balance_c(&tl, -amount)) {
+        Buf eb = {0};
+        rc = -1;
+        if (serialize_trustline_entry(&tl, &eb) == 0) {
+            RB *val = rb_new(eb.p, eb.len);
+            rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
+                 ? 1 : -1;
+        }
+        PyMem_Free(eb.p);
+    } else if (rec != NULL &&
+               parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        rc = -1;
+    }
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* mirror LiquidityPoolWithdrawOpFrame._receive (no auth check) */
+static int
+pool_receive_c(Engine *e, const uint8_t src[32], const CAssetC *asset,
+               int64_t amount)
+{
+    if (asset->type == 0) {
+        CAccount a;
+        int got = eng_get_account(e, src, &a);
+        if (got < 0)
+            return -1;
+        if (!got || !add_balance_c(&e->header, &a, amount, 1))
+            return 0;
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 1;
+    }
+    if (is_issuer_asset(src, asset))
+        return 1;
+    Buf kb = {0};
+    if (trustline_key_xdr_c(src, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int rc = 0;
+    CTrustLine tl;
+    if (rec != NULL) {
+        if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0)
+            rc = -1;
+        else if (add_tl_balance_c(&tl, amount)) {
+            Buf eb = {0};
+            rc = -1;
+            if (serialize_trustline_entry(&tl, &eb) == 0) {
+                RB *val = rb_new(eb.p, eb.len);
+                rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
+                     ? 1 : -1;
+            }
+            PyMem_Free(eb.p);
+        }
+    }
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* mirror LiquidityPoolDepositOpFrame (v18+, MED threshold) */
+static int
+op_pool_deposit(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    const uint8_t *pid = rd_take(&r, 32);
+    int64_t max_a = rd_i64(&r);
+    int64_t max_b = rd_i64(&r);
+    int32_t min_n = rd_i32(&r), min_d = rd_i32(&r);
+    int32_t max_n = rd_i32(&r), max_d = rd_i32(&r);
+    if (!pid || r.err)
+        return -1;
+
+    /* do_check_valid */
+    if (max_a <= 0 || max_b <= 0 || min_n <= 0 || min_d <= 0 ||
+        max_n <= 0 || max_d <= 0 ||
+        (i128)min_n * max_d > (i128)max_n * min_d)
+        return res_inner(rb, 22, -1) < 0 ? -1 : 0;   /* MALFORMED */
+
+    Buf kb = {0};
+    if (pool_trustline_key_xdr_c(src, pid, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *tl_rec = eng_get(e, kb.p, kb.len);
+    if (!tl_rec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 22, -2) < 0 ? -1 : 0;   /* NO_TRUST */
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(tl_rec->bytes, tl_rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    uint8_t pk[36];
+    pool_key_xdr_c(pid, pk);
+    RB *prec = eng_get(e, pk, 36);
+    if (!prec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 22, -2) < 0 ? -1 : 0;   /* NO_TRUST */
+    }
+    CPoolEntry pool;
+    if (parse_pool_entry(prec->bytes, prec->len, &pool) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+
+#define PD_FAIL(code_) do { \
+        int rr = res_inner(rb, 22, (code_)); \
+        PyMem_Free(kb.p); \
+        return rr < 0 ? -1 : 0; \
+    } while (0)
+
+    i128 amount_a, amount_b, shares;
+    if (pool.total_shares == 0) {
+        amount_a = max_a;
+        amount_b = max_b;
+        /* deposit price a/b must lie within [minPrice, maxPrice] */
+        if (amount_a * min_d < amount_b * min_n ||
+            amount_a * max_d > amount_b * max_n)
+            PD_FAIL(-6);                             /* BAD_PRICE */
+        shares = (i128)isqrt_u128((u128)amount_a * (u128)amount_b);
+    } else {
+        i128 shares_a = (i128)pool.total_shares * max_a / pool.reserve_a;
+        i128 shares_b = (i128)pool.total_shares * max_b / pool.reserve_b;
+        shares = shares_a < shares_b ? shares_a : shares_b;
+        amount_a = (shares * pool.reserve_a + pool.total_shares - 1)
+                   / pool.total_shares;
+        amount_b = (shares * pool.reserve_b + pool.total_shares - 1)
+                   / pool.total_shares;
+        if (amount_a > max_a || amount_b > max_b) {
+            shares -= 1;
+            amount_a = (shares * pool.reserve_a + pool.total_shares - 1)
+                       / pool.total_shares;
+            amount_b = (shares * pool.reserve_b + pool.total_shares - 1)
+                       / pool.total_shares;
+        }
+        if (shares <= 0 || amount_a <= 0 || amount_b <= 0)
+            PD_FAIL(-4);                             /* UNDERFUNDED */
+        /* pool price must lie within bounds */
+        if ((i128)pool.reserve_a * min_d < (i128)pool.reserve_b * min_n ||
+            (i128)pool.reserve_a * max_d > (i128)pool.reserve_b * max_n)
+            PD_FAIL(-6);                             /* BAD_PRICE */
+    }
+    if (pool.total_shares > (i128)INT64_MAXV - shares ||
+        pool.reserve_a > (i128)INT64_MAXV - amount_a ||
+        pool.reserve_b > (i128)INT64_MAXV - amount_b)
+        PD_FAIL(-7);                                 /* POOL_FULL */
+    int rc = pool_spend_c(e, src, &pool.asset_a, (int64_t)amount_a);
+    if (rc < 0) { PyMem_Free(kb.p); return -1; }
+    if (rc == 0)
+        PD_FAIL(-4);                                 /* UNDERFUNDED */
+    rc = pool_spend_c(e, src, &pool.asset_b, (int64_t)amount_b);
+    if (rc < 0) { PyMem_Free(kb.p); return -1; }
+    if (rc == 0)
+        PD_FAIL(-4);                                 /* UNDERFUNDED */
+    if (!add_tl_balance_c(&tl, (int64_t)shares))
+        PD_FAIL(-5);                                 /* LINE_FULL */
+#undef PD_FAIL
+    tl.last_modified = h->ledger_seq;
+    Buf eb = {0};
+    if (serialize_trustline_entry(&tl, &eb) < 0) {
+        PyMem_Free(eb.p); PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    int st = val ? eng_put(e, e->cur, kb.p, kb.len, val) : -1;
+    PyMem_Free(kb.p);
+    if (st < 0)
+        return -1;
+    pool.reserve_a += (int64_t)amount_a;
+    pool.reserve_b += (int64_t)amount_b;
+    pool.total_shares += (int64_t)shares;
+    pool.last_modified = h->ledger_seq;
+    Buf pb = {0};
+    if (serialize_pool_entry(&pool, &pb) < 0) {
+        PyMem_Free(pb.p);
+        return -1;
+    }
+    RB *pval = rb_new(pb.p, pb.len);
+    PyMem_Free(pb.p);
+    if (!pval || eng_put(e, e->cur, pk, 36, pval) < 0)
+        return -1;
+    return res_inner(rb, 22, 0) < 0 ? -1 : 1;
+}
+
+/* mirror LiquidityPoolWithdrawOpFrame (v18+, MED threshold) */
+static int
+op_pool_withdraw(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                 Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    const uint8_t *pid = rd_take(&r, 32);
+    int64_t amount = rd_i64(&r);
+    int64_t min_a = rd_i64(&r);
+    int64_t min_b = rd_i64(&r);
+    if (!pid || r.err)
+        return -1;
+
+    if (amount <= 0 || min_a < 0 || min_b < 0)
+        return res_inner(rb, 23, -1) < 0 ? -1 : 0;   /* MALFORMED */
+
+    Buf kb = {0};
+    if (pool_trustline_key_xdr_c(src, pid, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *tl_rec = eng_get(e, kb.p, kb.len);
+    if (!tl_rec) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 23, -2) < 0 ? -1 : 0;   /* NO_TRUST */
+    }
+    CTrustLine tl;
+    if (parse_trustline_entry(tl_rec->bytes, tl_rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (tl.balance < amount) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 23, -3) < 0 ? -1 : 0;   /* UNDERFUNDED */
+    }
+    uint8_t pk[36];
+    pool_key_xdr_c(pid, pk);
+    RB *prec = eng_get(e, pk, 36);
+    CPoolEntry pool;
+    if (!prec || parse_pool_entry(prec->bytes, prec->len, &pool) < 0) {
+        PyMem_Free(kb.p);
+        return -1;              /* pool missing under a live share: corrupt */
+    }
+    int64_t amount_a = (int64_t)((i128)amount * pool.reserve_a
+                                 / pool.total_shares);
+    int64_t amount_b = (int64_t)((i128)amount * pool.reserve_b
+                                 / pool.total_shares);
+    if (amount_a < min_a || amount_b < min_b) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 23, -5) < 0 ? -1 : 0;   /* UNDER_MINIMUM */
+    }
+    int rc = pool_receive_c(e, src, &pool.asset_a, amount_a);
+    if (rc < 0) { PyMem_Free(kb.p); return -1; }
+    if (rc == 0) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 23, -4) < 0 ? -1 : 0;   /* LINE_FULL */
+    }
+    rc = pool_receive_c(e, src, &pool.asset_b, amount_b);
+    if (rc < 0) { PyMem_Free(kb.p); return -1; }
+    if (rc == 0) {
+        PyMem_Free(kb.p);
+        return res_inner(rb, 23, -4) < 0 ? -1 : 0;   /* LINE_FULL */
+    }
+    if (!add_tl_balance_c(&tl, -amount)) {
+        PyMem_Free(kb.p);
+        return -1;              /* oracle asserts this succeeds */
+    }
+    tl.last_modified = h->ledger_seq;
+    Buf eb = {0};
+    if (serialize_trustline_entry(&tl, &eb) < 0) {
+        PyMem_Free(eb.p); PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    int st = val ? eng_put(e, e->cur, kb.p, kb.len, val) : -1;
+    PyMem_Free(kb.p);
+    if (st < 0)
+        return -1;
+    pool.reserve_a -= amount_a;
+    pool.reserve_b -= amount_b;
+    pool.total_shares -= amount;
+    pool.last_modified = h->ledger_seq;
+    Buf pb = {0};
+    if (serialize_pool_entry(&pool, &pb) < 0) {
+        PyMem_Free(pb.p);
+        return -1;
+    }
+    RB *pval = rb_new(pb.p, pb.len);
+    PyMem_Free(pb.p);
+    if (!pval || eng_put(e, e->cur, pk, 36, pval) < 0)
+        return -1;
+    return res_inner(rb, 23, 0) < 0 ? -1 : 1;
+}
+
+/* adjust a constituent trustline's liquidityPoolUseCount (mirror
+ * ChangeTrustOpFrame._bump_pool_use) and store it */
+static int
+bump_pool_use_c(Engine *e, const uint8_t *key, int klen, CTrustLine *tl,
+                int delta)
+{
+    if (tl->ext_level < 1)
+        tl->ext_level = 1;
+    if (tl->ext_level < 2) {
+        tl->ext_level = 2;
+        tl->pool_use_count = 0;
+    }
+    tl->pool_use_count += delta;
+    Buf eb = {0};
+    if (serialize_trustline_entry(tl, &eb) < 0) {
+        PyMem_Free(eb.p);
+        return -1;
+    }
+    RB *val = rb_new(eb.p, eb.len);
+    PyMem_Free(eb.p);
+    return (!val || eng_put(e, e->cur, key, klen, val) < 0) ? -1 : 0;
+}
+
+/* CAP-38 pool-share ChangeTrust arm (mirror _apply_pool_share) */
+static int
+apply_pool_share_ct(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
+                    Buf *rb)
+{
+    CHeader *h = &e->header;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    if (rd_u32(&r) != 3 || r.err)             /* ChangeTrustAsset tag */
+        return -1;
+    if (rd_u32(&r) != 0 || r.err)             /* params: constantProduct */
+        return -1;
+    CAssetC asset_a, asset_b;
+    if (parse_asset(&r, &asset_a) < 0 || parse_asset(&r, &asset_b) < 0)
+        return -1;
+    int32_t fee = rd_i32(&r);
+    int64_t limit = rd_i64(&r);
+    if (r.err)
+        return -1;
+
+    /* do_check_valid */
+    int err = 0;
+    int ord = asset_order_c(&asset_a, &asset_b, &err);
+    if (err)
+        return -1;
+    if (!asset_valid_c(&asset_a) || !asset_valid_c(&asset_b) ||
+        ord >= 0 || fee != POOL_FEE_BPS_C || limit < 0)
+        return res_inner(rb, 6, -1) < 0 ? -1 : 0;    /* MALFORMED */
+
+    uint8_t pool_id[32];
+    if (pool_id_for_c(&asset_a, &asset_b, fee, pool_id) < 0)
+        return -1;
+    Buf kb = {0};
+    if (pool_trustline_key_xdr_c(src, pool_id, &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    CAccount srca;
+    if (eng_get_account(e, src, &srca) <= 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    uint8_t pk[36];
+    pool_key_xdr_c(pool_id, pk);
+
+#define PS_FAIL(code_) do { \
+        int rr = res_inner(rb, 6, (code_)); \
+        PyMem_Free(kb.p); \
+        return rr < 0 ? -1 : 0; \
+    } while (0)
+
+    if (rec == NULL) {                        /* create */
+        if (limit == 0)
+            PS_FAIL(-3);                             /* INVALID_LIMIT */
+        /* constituents: credit assets need an authorized-enough line,
+         * whose pool-use count is bumped */
+        const CAssetC *consts[2] = { &asset_a, &asset_b };
+        for (int ci = 0; ci < 2; ci++) {
+            const CAssetC *as = consts[ci];
+            if (as->type == 0 || is_issuer_asset(src, as))
+                continue;
+            Buf ck = {0};
+            if (trustline_key_xdr_c(src, as->type, as->code, as->issuer,
+                                    &ck) < 0) {
+                PyMem_Free(ck.p); PyMem_Free(kb.p);
+                return -1;
+            }
+            RB *crec = eng_get(e, ck.p, ck.len);
+            if (!crec) {
+                PyMem_Free(ck.p);
+                PS_FAIL(-6);                         /* TRUST_LINE_MISSING */
+            }
+            CTrustLine ctl;
+            if (parse_trustline_entry(crec->bytes, crec->len, &ctl) < 0) {
+                PyMem_Free(ck.p); PyMem_Free(kb.p);
+                return -1;
+            }
+            if (!(ctl.flags & 3u)) {                 /* maintain-liab OK */
+                PyMem_Free(ck.p);
+                PS_FAIL(-8);            /* NOT_AUTH_MAINTAIN_LIABILITIES */
+            }
+            int brc = bump_pool_use_c(e, ck.p, ck.len, &ctl, 1);
+            PyMem_Free(ck.p);
+            if (brc < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+        }
+        CTrustLine ntl;
+        memset(&ntl, 0, sizeof(ntl));
+        memcpy(ntl.account_id, src, 32);
+        ntl.asset_type = 3;
+        memcpy(ntl.pool_id, pool_id, 32);
+        ntl.limit = limit;
+        ntl.flags = 1;                               /* AUTHORIZED */
+        /* pool-share lines pin 2 reserve units (CAP-38 double subentry) */
+        const uint8_t *sp_id = h->ledger_version >= 18
+            ? active_sponsor_c(e, src) : NULL;
+        if (sp_id != NULL) {
+            int sc = sponsorship_error_c(rb, 6, -4,
+                establish_sponsorship_c(e, sp_id, &srca, 2));
+            if (sc) {
+                PyMem_Free(kb.p);
+                return sc < 0 ? -1 : 0;
+            }
+            ntl.entry_ext_v1 = 1;
+            ntl.has_sponsor = 1;
+            memcpy(ntl.sponsor, sp_id, 32);
+            srca.num_sub += 2;
+        } else if (!add_num_entries_c(h, &srca, 2)) {
+            PS_FAIL(-4);                             /* LOW_RESERVE */
+        }
+        if (eng_put_account(e, e->cur, &srca) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        /* pool entry: create on first trustline, else count up */
+        RB *prec = eng_get(e, pk, 36);
+        CPoolEntry pool;
+        if (prec == NULL) {
+            memset(&pool, 0, sizeof(pool));
+            memcpy(pool.pool_id, pool_id, 32);
+            pool.asset_a = asset_a;
+            pool.asset_b = asset_b;
+            pool.fee = fee;
+            pool.tl_count = 1;
+        } else {
+            if (parse_pool_entry(prec->bytes, prec->len, &pool) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+            pool.tl_count += 1;
+        }
+        pool.last_modified = h->ledger_seq;
+        Buf pb = {0};
+        if (serialize_pool_entry(&pool, &pb) < 0) {
+            PyMem_Free(pb.p); PyMem_Free(kb.p);
+            return -1;
+        }
+        RB *pval = rb_new(pb.p, pb.len);
+        PyMem_Free(pb.p);
+        if (!pval || eng_put(e, e->cur, pk, 36, pval) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        return store_trustline(e, &kb, &ntl, rb, 6);
+    }
+
+    CTrustLine tl;
+    if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    if (limit == 0) {                         /* delete */
+        if (tl.balance != 0)
+            PS_FAIL(-3);                             /* INVALID_LIMIT */
+        if (eng_put(e, e->cur, kb.p, kb.len, NULL) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        if (tl.entry_ext_v1 && tl.has_sponsor) {
+            if (release_entry_sponsor(e, tl.sponsor, 2, &srca) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+            srca.num_sub -= 2;
+        } else {
+            add_num_entries_c(h, &srca, -2);
+        }
+        if (eng_put_account(e, e->cur, &srca) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        RB *prec = eng_get(e, pk, 36);
+        CPoolEntry pool;
+        if (!prec || parse_pool_entry(prec->bytes, prec->len, &pool) < 0) {
+            PyMem_Free(kb.p);
+            return -1;
+        }
+        pool.tl_count -= 1;
+        if (pool.tl_count == 0) {
+            if (eng_put(e, e->cur, pk, 36, NULL) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+        } else {
+            pool.last_modified = h->ledger_seq;
+            Buf pb = {0};
+            if (serialize_pool_entry(&pool, &pb) < 0) {
+                PyMem_Free(pb.p); PyMem_Free(kb.p);
+                return -1;
+            }
+            RB *pval = rb_new(pb.p, pb.len);
+            PyMem_Free(pb.p);
+            if (!pval || eng_put(e, e->cur, pk, 36, pval) < 0) {
+                PyMem_Free(kb.p);
+                return -1;
+            }
+        }
+        const CAssetC *consts[2] = { &asset_a, &asset_b };
+        for (int ci = 0; ci < 2; ci++) {
+            const CAssetC *as = consts[ci];
+            if (as->type == 0 || is_issuer_asset(src, as))
+                continue;
+            Buf ck = {0};
+            if (trustline_key_xdr_c(src, as->type, as->code, as->issuer,
+                                    &ck) < 0) {
+                PyMem_Free(ck.p); PyMem_Free(kb.p);
+                return -1;
+            }
+            RB *crec = eng_get(e, ck.p, ck.len);
+            if (crec != NULL) {
+                CTrustLine ctl;
+                if (parse_trustline_entry(crec->bytes, crec->len,
+                                          &ctl) < 0 ||
+                    bump_pool_use_c(e, ck.p, ck.len, &ctl, -1) < 0) {
+                    PyMem_Free(ck.p); PyMem_Free(kb.p);
+                    return -1;
+                }
+            }
+            PyMem_Free(ck.p);
+        }
+        PyMem_Free(kb.p);
+        return res_inner(rb, 6, 0) < 0 ? -1 : 1;
+    }
+    if (limit < tl.balance)
+        PS_FAIL(-3);                                 /* INVALID_LIMIT */
+#undef PS_FAIL
+    tl.limit = limit;
+    return store_trustline(e, &kb, &tl, rb, 6);
+}
+
+/* ---- path payments (round 12) ------------------------------------------ *
+ *
+ * Mirrors offer_ops._PathPaymentBase: each hop crosses the order book
+ * (in a child overlay, rolled back if the pool wins) or the CAP-38
+ * constant-product pool — whichever converts at the better rate.
+ */
+
+typedef struct {
+    uint8_t pool_id[32];
+    int64_t amount_in, amount_out;
+    int flip;
+    int usable;
+} CPoolQuote;
+
+/* mirror _pool_quote; returns -1 on engine error, else 0 (pq->usable) */
+static int
+pool_quote_c(Engine *e, const CAssetC *from, const CAssetC *to,
+             int64_t wheat_target, int64_t sheep_budget, int rounding,
+             CPoolQuote *pq)
+{
+    memset(pq, 0, sizeof(*pq));
+    int err = 0;
+    int ord = asset_order_c(from, to, &err);
+    if (err)
+        return -1;
+    const CAssetC *a = ord < 0 ? from : to;
+    const CAssetC *b = ord < 0 ? to : from;
+    if (pool_id_for_c(a, b, POOL_FEE_BPS_C, pq->pool_id) < 0)
+        return -1;
+    uint8_t pk[36];
+    pool_key_xdr_c(pq->pool_id, pk);
+    RB *rec = eng_get(e, pk, 36);
+    if (!rec)
+        return 0;
+    CPoolEntry pool;
+    if (parse_pool_entry(rec->bytes, rec->len, &pool) < 0)
+        return -1;
+    pq->flip = asset_eq(from, &pool.asset_b);
+    int64_t r_in = pq->flip ? pool.reserve_b : pool.reserve_a;
+    int64_t r_out = pq->flip ? pool.reserve_a : pool.reserve_b;
+    if (r_in <= 0 || r_out <= 0)
+        return 0;
+    if (rounding == RND_PATH_STRICT_RECEIVE) {
+        pq->amount_out = wheat_target;
+        pq->amount_in = pool_swap_in_given_out_c(r_in, r_out, wheat_target);
+        if (pq->amount_in < 0)
+            return 0;
+    } else {
+        pq->amount_in = sheep_budget;
+        pq->amount_out = pool_swap_out_given_in_c(r_in, r_out, sheep_budget);
+        if (pq->amount_out <= 0)
+            return 0;
+    }
+    /* skip the pool rather than overflow its post-swap reserve */
+    if ((u128)r_in + (u128)pq->amount_in > (u128)INT64_MAXV)
+        return 0;
+    pq->usable = 1;
+    return 0;
+}
+
+/* one hop (mirror _convert_hop): 0 ok (amounts + claims filled), 1 op
+ * failure (result written to rb), -1 engine error.  Claims append to
+ * claims_out as raw ClaimAtom XDR. */
+static int
+convert_hop_c(Engine *e, int32_t op_type, const uint8_t taker[32],
+              const CAssetC *from, const CAssetC *to,
+              int64_t wheat_target, int64_t sheep_budget, int rounding,
+              int64_t *wheat_out, int64_t *sheep_out, Buf *claims_out,
+              int *n_claims_out, Buf *rb)
+{
+    /* order-book attempt in the hop overlay (child LedgerTxn) */
+    map_clear(&e->hop_delta);
+    e->hop_active = 1;
+    e->cur = &e->hop_delta;
+    CCross book;
+    int rc = convert_with_offers_c(e, from, to, wheat_target, sheep_budget,
+                                   taker, rounding, -1, -1, 0, &book);
+    if (rc < 0) {
+        e->hop_active = 0;
+        map_clear(&e->hop_delta);
+        e->cur = &e->op_delta;
+        return -1;
+    }
+    if (book.self_cross) {
+        map_clear(&e->hop_delta);
+        e->hop_active = 0;
+        e->cur = &e->op_delta;
+        PyMem_Free(book.claims.p);
+        return res_inner(rb, op_type, -11) < 0 ? -1 : 1; /* OFFER_CROSS_SELF */
+    }
+    /* pool quote: book crossing cannot touch pool entries, so reading
+     * through the hop overlay sees the oracle's outer-ltx values */
+    CPoolQuote pq;
+    if (pool_quote_c(e, from, to, wheat_target, sheep_budget, rounding,
+                     &pq) < 0) {
+        e->hop_active = 0;
+        map_clear(&e->hop_delta);
+        e->cur = &e->op_delta;
+        PyMem_Free(book.claims.p);
+        return -1;
+    }
+    int book_filled =
+        (rounding == RND_PATH_STRICT_RECEIVE &&
+         book.wheat_received >= wheat_target) ||
+        (rounding == RND_PATH_STRICT_SEND &&
+         book.sheep_sent >= sheep_budget);
+    int use_pool = 0;
+    if (pq.usable) {
+        if (rounding == RND_PATH_STRICT_RECEIVE)
+            /* pool can deliver the full target; better price == less in */
+            use_pool = pq.amount_out >= wheat_target &&
+                       (!book_filled || pq.amount_in < book.sheep_sent);
+        else
+            use_pool = pq.amount_in <= sheep_budget &&
+                       pq.amount_out > book.wheat_received;
+    }
+    if (use_pool) {
+        /* roll the book attempt back; swap through the pool */
+        map_clear(&e->hop_delta);
+        e->hop_active = 0;
+        e->cur = &e->op_delta;
+        PyMem_Free(book.claims.p);
+        uint8_t pk[36];
+        pool_key_xdr_c(pq.pool_id, pk);
+        RB *rec = eng_get(e, pk, 36);
+        CPoolEntry pool;
+        if (!rec || parse_pool_entry(rec->bytes, rec->len, &pool) < 0)
+            return -1;
+        if (pq.flip) {
+            pool.reserve_b += pq.amount_in;
+            pool.reserve_a -= pq.amount_out;
+        } else {
+            pool.reserve_a += pq.amount_in;
+            pool.reserve_b -= pq.amount_out;
+        }
+        pool.last_modified = e->header.ledger_seq;
+        Buf pb = {0};
+        if (serialize_pool_entry(&pool, &pb) < 0) {
+            PyMem_Free(pb.p);
+            return -1;
+        }
+        RB *pval = rb_new(pb.p, pb.len);
+        PyMem_Free(pb.p);
+        if (!pval || eng_put(e, e->cur, pk, 36, pval) < 0)
+            return -1;
+        /* ClaimAtom.liquidityPool */
+        if (buf_u32(claims_out, 2) < 0 ||
+            buf_put(claims_out, pq.pool_id, 32) < 0 ||
+            write_asset(claims_out, to) < 0 ||
+            buf_i64(claims_out, pq.amount_out) < 0 ||
+            write_asset(claims_out, from) < 0 ||
+            buf_i64(claims_out, pq.amount_in) < 0)
+            return -1;
+        *n_claims_out = 1;
+        *wheat_out = pq.amount_out;
+        *sheep_out = pq.amount_in;
+        return 0;
+    }
+    /* commit the book attempt into the op overlay */
+    e->hop_active = 0;
+    if (eng_fold_overlay(&e->hop_delta, &e->op_delta) < 0) {
+        e->cur = &e->op_delta;
+        PyMem_Free(book.claims.p);
+        return -1;
+    }
+    e->cur = &e->op_delta;
+    if ((rounding == RND_PATH_STRICT_RECEIVE &&
+         book.wheat_received < wheat_target) ||
+        (rounding == RND_PATH_STRICT_SEND &&
+         book.sheep_sent < sheep_budget)) {
+        PyMem_Free(book.claims.p);
+        return res_inner(rb, op_type, -10) < 0 ? -1 : 1; /* TOO_FEW_OFFERS */
+    }
+    if (buf_put(claims_out, book.claims.p, book.claims.len) < 0) {
+        PyMem_Free(book.claims.p);
+        return -1;
+    }
+    PyMem_Free(book.claims.p);
+    *n_claims_out = book.n_claims;
+    *wheat_out = book.wheat_received;
+    *sheep_out = book.sheep_sent;
+    return 0;
+}
+
+/* credit destAsset to the destination (mirror _credit_dest): 0 ok,
+ * 1 failure written, -1 engine error */
+static int
+pp_credit_dest(Engine *e, int32_t ot, const uint8_t dest[32],
+               const CAssetC *asset, int64_t amount, Buf *rb)
+{
+    CHeader *h = &e->header;
+    if (asset->type == 0) {
+        CAccount a;
+        int got = eng_get_account(e, dest, &a);
+        if (got < 0)
+            return -1;
+        if (!got)
+            return res_inner(rb, ot, -5) < 0 ? -1 : 1;  /* NO_DESTINATION */
+        if (!add_balance_c(h, &a, amount, 1))
+            return res_inner(rb, ot, -8) < 0 ? -1 : 1;  /* LINE_FULL */
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 0;
+    }
+    uint8_t dk[40];
+    account_key_xdr_c(dest, dk);
+    if (eng_get(e, dk, 40) == NULL)
+        return res_inner(rb, ot, -5) < 0 ? -1 : 1;      /* NO_DESTINATION */
+    if (is_issuer_asset(dest, asset))
+        return 0;                                       /* burn at issuer */
+    Buf kb = {0};
+    if (trustline_key_xdr_c(dest, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int rc;
+    CTrustLine tl;
+    if (!rec) {
+        rc = res_inner(rb, ot, -6) < 0 ? -1 : 1;        /* NO_TRUST */
+    } else if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        rc = -1;
+    } else if (!(tl.flags & 1)) {
+        rc = res_inner(rb, ot, -7) < 0 ? -1 : 1;        /* NOT_AUTHORIZED */
+    } else if (!add_tl_balance_c(&tl, amount)) {
+        rc = res_inner(rb, ot, -8) < 0 ? -1 : 1;        /* LINE_FULL */
+    } else {
+        tl.last_modified = h->ledger_seq;
+        Buf eb = {0};
+        rc = -1;
+        if (serialize_trustline_entry(&tl, &eb) == 0) {
+            RB *val = rb_new(eb.p, eb.len);
+            rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
+                 ? 0 : -1;
+        }
+        PyMem_Free(eb.p);
+    }
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* debit sendAsset from the source (mirror _debit_source) */
+static int
+pp_debit_source(Engine *e, int32_t ot, const uint8_t src[32],
+                const CAssetC *asset, int64_t amount, Buf *rb)
+{
+    CHeader *h = &e->header;
+    if (asset->type == 0) {
+        CAccount a;
+        if (eng_get_account(e, src, &a) <= 0)
+            return -1;
+        if (!add_balance_c(h, &a, -amount, 1))
+            return res_inner(rb, ot, -2) < 0 ? -1 : 1;  /* UNDERFUNDED */
+        return eng_put_account(e, e->cur, &a) < 0 ? -1 : 0;
+    }
+    if (is_issuer_asset(src, asset))
+        return 0;                                       /* mint at issuer */
+    Buf kb = {0};
+    if (trustline_key_xdr_c(src, asset->type, asset->code, asset->issuer,
+                            &kb) < 0) {
+        PyMem_Free(kb.p);
+        return -1;
+    }
+    RB *rec = eng_get(e, kb.p, kb.len);
+    int rc;
+    CTrustLine tl;
+    if (!rec) {
+        rc = res_inner(rb, ot, -3) < 0 ? -1 : 1;        /* SRC_NO_TRUST */
+    } else if (parse_trustline_entry(rec->bytes, rec->len, &tl) < 0) {
+        rc = -1;
+    } else if (!(tl.flags & 1)) {
+        rc = res_inner(rb, ot, -4) < 0 ? -1 : 1;        /* SRC_NOT_AUTH */
+    } else if (!add_tl_balance_c(&tl, -amount)) {
+        rc = res_inner(rb, ot, -2) < 0 ? -1 : 1;        /* UNDERFUNDED */
+    } else {
+        tl.last_modified = h->ledger_seq;
+        Buf eb = {0};
+        rc = -1;
+        if (serialize_trustline_entry(&tl, &eb) == 0) {
+            RB *val = rb_new(eb.p, eb.len);
+            rc = (val && eng_put(e, e->cur, kb.p, kb.len, val) == 0)
+                 ? 0 : -1;
+        }
+        PyMem_Free(eb.p);
+    }
+    PyMem_Free(kb.p);
+    return rc;
+}
+
+/* mirror PathPaymentStrictReceiveOpFrame (op 2) and
+ * PathPaymentStrictSendOpFrame (op 13, v12+) */
+static int
+op_path_payment(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
+{
+    int strict_send = op->op_type == 13;
+    int32_t ot = op->op_type;
+    Rd r;
+    rd_init(&r, op->body, op->body_len);
+    CAssetC chain[7];
+    if (parse_asset(&r, &chain[0]) < 0)               /* sendAsset */
+        return -1;
+    int64_t amt1 = rd_i64(&r);              /* sendMax / sendAmount */
+    uint32_t mt = rd_u32(&r);
+    if (mt == 0x100)
+        rd_skip(&r, 8);
+    else if (mt != 0)
+        return -1;
+    const uint8_t *dest = rd_take(&r, 32);
+    if (!dest)
+        return -1;
+    CAssetC dest_asset;
+    if (parse_asset(&r, &dest_asset) < 0)
+        return -1;
+    int64_t amt2 = rd_i64(&r);              /* destAmount / destMin */
+    uint32_t np = rd_u32(&r);
+    if (r.err || np > 5)
+        return -1;
+    for (uint32_t i = 0; i < np; i++)
+        if (parse_asset(&r, &chain[1 + i]) < 0)
+            return -1;
+    if (r.err)
+        return -1;
+    int n_chain = (int)np + 2;
+    chain[n_chain - 1] = dest_asset;
+
+    /* do_check_valid */
+    int bad = strict_send ? (amt1 <= 0 || amt2 <= 0)
+                          : (amt2 <= 0 || amt1 <= 0);
+    for (int i = 0; !bad && i < n_chain; i++)
+        if (!asset_valid_c(&chain[i]))
+            bad = 1;
+    if (bad)
+        return res_inner(rb, ot, -1) < 0 ? -1 : 0;    /* MALFORMED */
+
+    Buf claims = {0};
+    int n_claims = 0;
+    int64_t wheat = 0, sheep = 0;
+    int64_t last_amount;
+    int rc;
+    if (!strict_send) {
+        int64_t dest_amount = amt2, send_max = amt1;
+        rc = pp_credit_dest(e, ot, dest, &dest_asset, dest_amount, rb);
+        if (rc) {
+            PyMem_Free(claims.p);
+            return rc < 0 ? -1 : 0;
+        }
+        int64_t need = dest_amount;
+        /* walk back from the destination: each hop buys `need` of the
+         * next asset with the previous one */
+        for (int i = n_chain - 1; i >= 1; i--) {
+            if (asset_eq(&chain[i], &chain[i - 1]))
+                continue;
+            Buf hop = {0};
+            int hn = 0;
+            rc = convert_hop_c(e, ot, src, &chain[i - 1], &chain[i], need,
+                               INT64_MAXV, RND_PATH_STRICT_RECEIVE,
+                               &wheat, &sheep, &hop, &hn, rb);
+            if (rc) {
+                PyMem_Free(hop.p);
+                PyMem_Free(claims.p);
+                return rc < 0 ? -1 : 0;
+            }
+            /* claims = hop_claims + claims (prepend) */
+            if (buf_put(&hop, claims.p, claims.len) < 0) {
+                PyMem_Free(hop.p);
+                PyMem_Free(claims.p);
+                return -1;
+            }
+            PyMem_Free(claims.p);
+            claims = hop;
+            n_claims += hn;
+            need = sheep;
+        }
+        if (need > send_max) {
+            PyMem_Free(claims.p);
+            return res_inner(rb, ot, -12) < 0 ? -1 : 0; /* OVER_SENDMAX */
+        }
+        rc = pp_debit_source(e, ot, src, &chain[0], need, rb);
+        if (rc) {
+            PyMem_Free(claims.p);
+            return rc < 0 ? -1 : 0;
+        }
+        last_amount = dest_amount;
+    } else {
+        int64_t send_amount = amt1, dest_min = amt2;
+        rc = pp_debit_source(e, ot, src, &chain[0], send_amount, rb);
+        if (rc) {
+            PyMem_Free(claims.p);
+            return rc < 0 ? -1 : 0;
+        }
+        int64_t have = send_amount;
+        for (int i = 0; i + 1 < n_chain; i++) {
+            if (asset_eq(&chain[i], &chain[i + 1]))
+                continue;
+            Buf hop = {0};
+            int hn = 0;
+            rc = convert_hop_c(e, ot, src, &chain[i], &chain[i + 1],
+                               INT64_MAXV, have, RND_PATH_STRICT_SEND,
+                               &wheat, &sheep, &hop, &hn, rb);
+            if (rc) {
+                PyMem_Free(hop.p);
+                PyMem_Free(claims.p);
+                return rc < 0 ? -1 : 0;
+            }
+            if (buf_put(&claims, hop.p, hop.len) < 0) {
+                PyMem_Free(hop.p);
+                PyMem_Free(claims.p);
+                return -1;
+            }
+            PyMem_Free(hop.p);
+            n_claims += hn;
+            have = wheat;
+        }
+        if (have < dest_min) {
+            PyMem_Free(claims.p);
+            return res_inner(rb, ot, -12) < 0 ? -1 : 0; /* UNDER_DESTMIN */
+        }
+        rc = pp_credit_dest(e, ot, dest, &dest_asset, have, rb);
+        if (rc) {
+            PyMem_Free(claims.p);
+            return rc < 0 ? -1 : 0;
+        }
+        last_amount = have;
+    }
+    /* success arm: claims vec + SimplePaymentResult */
+    if (buf_i32(rb, 0) < 0 || buf_i32(rb, ot) < 0 || buf_i32(rb, 0) < 0 ||
+        buf_u32(rb, (uint32_t)n_claims) < 0 ||
+        buf_put(rb, claims.p, claims.len) < 0 ||
+        write_account_id(rb, dest) < 0 ||
+        write_asset(rb, &dest_asset) < 0 ||
+        buf_i64(rb, last_amount) < 0) {
+        PyMem_Free(claims.p);
+        return -1;
+    }
+    PyMem_Free(claims.p);
+    return 1;
 }

@@ -55,3 +55,32 @@ def ensure_native(quiet=True):
         if not quiet:
             sys.stderr.write(f"native build failed: {e}\n")
     return not _stale()
+
+
+class StaleNativeExtensionError(RuntimeError):
+    """A shipped .so is older than its C source and could not be rebuilt —
+    importing it would silently run stale code past the differential
+    tests that are supposed to validate it."""
+
+
+def require_fresh(mod):
+    """Staleness guard for import sites that load `mod` directly (the
+    native bridge, bench): a MISSING .so degrades to Python as before,
+    but a PRESENT-and-stale one must either rebuild or fail-stop —
+    silently loading it would pin every differential guarantee to bytes
+    that no longer match native/*.c.  No-op when the module has no
+    shipped .so at all."""
+    if mod not in _EXTENSIONS:
+        raise ValueError(f"unknown native extension {mod!r}")
+    if not glob.glob(os.path.join(_PKG, mod + ".*.so")):
+        return False            # nothing shipped: caller's fallback rules
+    if mod not in _stale():
+        return True
+    ensure_native()
+    if mod in _stale():
+        src = _EXTENSIONS[mod]
+        raise StaleNativeExtensionError(
+            f"{mod} is older than {src} and the in-place rebuild failed; "
+            f"run `make native` (or set STELLAR_TPU_NO_CAPPLY=1 to force "
+            f"the Python engine)")
+    return True

@@ -789,6 +789,14 @@ class CatchupManager:
                     bridge.export_to_manager(mgr)
                 self.stats.update(
                     {f"native_{k}": v for k, v in bridge.stats().items()})
+                # checkpoint outcome split (bench catchup column): how
+                # many checkpoints ran native vs fell back to Python
+                self.stats["native_checkpoints"] = \
+                    self.stats.get("native_checkpoints", 0) \
+                    + bridge.native_checkpoints
+                self.stats["native_fallback_checkpoints"] = \
+                    self.stats.get("native_fallback_checkpoints", 0) \
+                    + bridge.fallback_checkpoints
         if not work.succeeded:
             detail = work.error_detail or "unknown failure"
             raise CatchupError(

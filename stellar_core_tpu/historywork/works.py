@@ -234,6 +234,11 @@ class ApplyCheckpointWork(BasicWork):
             return State.SUCCESS
         tx_recs = [raw_txs.get(e.header.ledgerSeq) for e, _ in rows]
         if not bridge.probe(tx_recs):
+            # fallback forfeit accounting: every checkpoint that leaves
+            # the native engine gives up its ~3x apply rate — make a
+            # silent regression visible in stats + the bench trajectory
+            bridge.fallback_checkpoints += 1
+            _registry().meter("catchup.native.fallback").mark()
             if bridge.active:
                 bridge.export_to_manager(mgr)
             try:
@@ -258,6 +263,8 @@ class ApplyCheckpointWork(BasicWork):
                                     self.target)
         except Exception as e:
             return self._fail(f"native apply failed: {e}")
+        bridge.native_checkpoints += 1
+        _registry().meter("catchup.native.checkpoint").mark()
         _registry().meter("catchup.apply.ledger").mark(len(rows))
         # bookkeeping: the manager's LCL view advances with the engine
         # (full state stays in C until export); the engine verified these

@@ -173,6 +173,12 @@ class LedgerManager:
         self.bucket_dir = None   # bucket.manager.BucketDir
         # observability (reference: METADATA_OUTPUT_STREAM + medida timers)
         self.meta_stream = None  # callable(LedgerCloseMeta) or file-like
+        # catchup's native bridge (historywork probes it per checkpoint)
+        self.native_bridge = None
+        # native live close (ledger/native_close.py): when attached,
+        # close_ledger routes through the C engine with differential
+        # spot-checks; None = classic Python close
+        self.native_closer = None
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -315,11 +321,49 @@ class LedgerManager:
         LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
         release_assert(self.root is not None,
                        "start_new_ledger/load first")
+        if self.native_closer is not None and expected_ledger_hash is None:
+            # live close through the C engine (catchup replay keeps its
+            # own bridge: expected_ledger_hash marks that path).  The
+            # closer owns the ledger.close span — its fallback paths run
+            # _close_ledger_python, which opens its own
+            return self.native_closer.close_ledger(
+                frames, close_time, tx_set, stellar_value)
+        return self._close_ledger_python(frames, close_time, tx_set,
+                                         expected_ledger_hash, stellar_value)
+
+    def _close_ledger_python(self, frames: Sequence[TransactionFrame],
+                             close_time: int,
+                             tx_set: Optional[X.TransactionSet] = None,
+                             expected_ledger_hash: Optional[bytes] = None,
+                             stellar_value: Optional[X.StellarValue] = None
+                             ) -> ClosedLedgerArtifacts:
+        """The classic pure-Python close (the oracle the native close
+        differentially checks against, and its fallback)."""
+        release_assert(self.root is not None,
+                       "start_new_ledger/load first")
         with tracing.span("ledger.close",
                           seq=self.lcl_header.ledgerSeq + 1,
                           txs=len(frames)):
             return self._close_ledger(frames, close_time, tx_set,
                                       expected_ledger_hash, stellar_value)
+
+    # -- native live close ---------------------------------------------------
+    def attach_native_close(self, differential: Optional[int] = None
+                            ) -> bool:
+        """Route live closes through the C engine (ledger/native_close.py).
+        Returns False (and stays on Python) when unavailable."""
+        from .native_close import NativeLedgerCloser, native_close_available
+        if not native_close_available(self):
+            return False
+        self.native_closer = NativeLedgerCloser(self, differential)
+        self.native_closer.activate()
+        return True
+
+    def detach_native_close(self) -> None:
+        """Move authority back to Python and drop the native closer."""
+        if self.native_closer is not None:
+            self.native_closer.deactivate()
+            self.native_closer = None
 
     def _close_ledger(self, frames: Sequence[TransactionFrame],
                       close_time: int,
@@ -499,6 +543,16 @@ class LedgerManager:
         a real close of an empty tx set over the mutated bucket list."""
         release_assert(self.root is not None,
                        "start_new_ledger/load first")
+        # synthetic closes mutate the Python state directly: round-trip
+        # the engine state so the two views cannot diverge.  Only when
+        # the engine actually HOLDS authority — a degraded or
+        # mid-catchup-deactivated closer must not overwrite newer Python
+        # state with its stale export (nor be silently re-armed below)
+        nc = self.native_closer
+        nc_roundtrip = (nc is not None and nc.bridge.active
+                        and nc.degraded is None)
+        if nc_roundtrip:
+            nc.bridge.export_to_manager(self)
         seq = self.lcl_header.ledgerSeq + 1
         entries = list(init_entries)
         for e in entries:
@@ -529,6 +583,8 @@ class LedgerManager:
         self.lcl_hash = sha256(self.lcl_header.to_xdr())
         if self.db is not None:
             self._persist_lcl()
+        if nc_roundtrip:
+            nc.bridge.import_from(self)
 
     def _emit_close_meta(self, header_entry, tx_set, result_pairs) -> None:
         """Emit LedgerCloseMeta v0 (reference: METADATA_OUTPUT_STREAM —

@@ -26,6 +26,12 @@ from ..bucket.future import FutureBucket
 try:
     if os.environ.get("STELLAR_TPU_NO_CAPPLY"):
         raise ImportError("capply disabled by STELLAR_TPU_NO_CAPPLY")
+    # staleness guard: a shipped .so older than native/capply.c is
+    # rebuilt here, or the import FAIL-STOPS (StaleNativeExtensionError)
+    # — running stale native code would invalidate every differential
+    # guarantee without tripping a single hash check
+    from .._native_build import require_fresh
+    require_fresh("_capply")
     from stellar_core_tpu import _capply  # built via `make native`
 except ImportError:
     _capply = None
@@ -51,6 +57,11 @@ class NativeApplyBridge:
             raise RuntimeError("native apply engine not built")
         self.engine = _capply.Engine(network_id)
         self.active = False
+        # per-checkpoint outcome accounting (historywork fills these;
+        # bench's catchup section reports native vs fallback so a silent
+        # fallback regression shows in the BENCH trajectory)
+        self.native_checkpoints = 0
+        self.fallback_checkpoints = 0
 
     # -- state transfer ----------------------------------------------------
     def import_from(self, mgr) -> None:
@@ -71,11 +82,17 @@ class NativeApplyBridge:
         self.active = True
 
     def export_to_manager(self, mgr) -> None:
-        """Engine -> Python manager (authoritative state moves back).
-        The bucket list is rebuilt first and hash-verified; only then is
-        the root rebound — a BucketListDB root is rebuilt OVER that list
-        (ignoring the exported entry pairs, no decode), a dict root
-        materializes them."""
+        """Engine -> Python manager (authoritative state moves back)."""
+        self._export_into(mgr)
+        self.active = False
+
+    def _export_into(self, mgr) -> None:
+        """Copy the engine state into `mgr` WITHOUT transferring authority
+        (the differential spot-checks of native live close build scratch
+        managers this way).  The bucket list is rebuilt first and
+        hash-verified; only then is the root rebound — a BucketListDB
+        root is rebuilt OVER that list (ignoring the exported entry
+        pairs, no decode), a dict root materializes them."""
         hdr, lcl_hash, entries, bucket_streams, next_streams = \
             self.engine.export_state()
         header = X.LedgerHeader.from_xdr(hdr)
@@ -91,7 +108,34 @@ class NativeApplyBridge:
         mgr.root = mgr.build_root(header, entries)
         mgr.lcl_header = header
         mgr.lcl_hash = lcl_hash
-        self.active = False
+
+    def sync_buckets_to(self, mgr) -> None:
+        """Rebuild `mgr`'s PYTHON bucket list from the engine (authority
+        stays in C) — the live-close checkpoint-boundary seam: history
+        publishing and persistence read `mgr.bucket_list` directly.
+        Uses the entries-free export: boundaries must not pay an
+        O(all-entries) Python materialization every 64 ledgers."""
+        hdr, bucket_streams, next_streams = self.engine.export_buckets()
+        header = X.LedgerHeader.from_xdr(hdr)
+        for i, lvl in enumerate(mgr.bucket_list.levels):
+            lvl.curr = Bucket.deserialize(bucket_streams[2 * i])
+            lvl.snap = Bucket.deserialize(bucket_streams[2 * i + 1])
+            ns = next_streams[i]
+            lvl.next = (None if ns is None
+                        else FutureBucket.from_output(Bucket.deserialize(ns)))
+        if mgr.bucket_list.hash() != header.bucketListHash:
+            raise RuntimeError(
+                "native bucket sync diverged from the bucket list hash")
+
+    # -- live close ---------------------------------------------------------
+    def close_ledger(self, tx_rec: Optional[bytes], scp_value_xdr: bytes):
+        """Drive one live ledger close in C.  Returns (seq, lcl_hash,
+        header_xdr, result_set_xdr, delta) — delta is the ledger's entry
+        changes as (key XDR, entry XDR | None) pairs for the Python
+        read-mirror.  Raises _capply.Error on probe misses / divergence;
+        the engine rolls back cleanly unless `poisoned` reports
+        otherwise."""
+        return self.engine.close_ledger(tx_rec, scp_value_xdr)
 
     # -- replay ------------------------------------------------------------
     def probe(self, tx_recs: Sequence[Optional[bytes]]) -> bool:

@@ -201,6 +201,22 @@ class Application:
         if config.METADATA_OUTPUT_STREAM:
             self.lm.meta_stream = open(config.METADATA_OUTPUT_STREAM, "ab")
         self.herder.ledger_closed_hook = self._on_ledger_closed
+        # native live close (ledger/native_close.py): the C apply engine
+        # drives LedgerManager.close with NATIVE_CLOSE_DIFFERENTIAL
+        # spot-checks; "auto" attaches when available, "on" warns loudly
+        # when it cannot be honored
+        if config.NATIVE_CLOSE != "off":
+            attached = self.lm.attach_native_close(
+                differential=config.NATIVE_CLOSE_DIFFERENTIAL or None)
+            if attached:
+                self.lm.native_closer.on_degrade = \
+                    lambda reason: self.status.set_status("ledger", reason)
+            elif config.NATIVE_CLOSE == "on":
+                log.warning(
+                    "NATIVE_CLOSE=on but the native close path is "
+                    "unavailable (extension not built, BucketListDB root, "
+                    "or INVARIANT_CHECKS enabled) — live close runs on the "
+                    "~3x slower Python engine")
         # a node that falls behind pulls recent SCP state from its peers
         # (reference: HerderImpl out-of-sync recovery → getMoreSCPState);
         # beyond the peers' slot memory, archive catchup takes over
@@ -264,6 +280,11 @@ class Application:
         from ..historywork.works import CatchupWork
         log.info("starting in-place archive catchup: lcl=%d archive=%d",
                  self.lm.last_closed_ledger_seq, has.current_ledger)
+        if self.lm.native_closer is not None:
+            # the replay needs Python authority over the manager state;
+            # closes during the gap run on the Python engine and the
+            # native closer re-imports once the replay lands (_watch)
+            self.lm.native_closer.deactivate()
         self.status.set_status(
             "history-catchup",
             f"catching up from archive: lcl={self.lm.last_closed_ledger_seq}"
@@ -299,6 +320,10 @@ class Application:
                 f"archive catchup FAILED at "
                 f"lcl={self.lm.last_closed_ledger_seq}")
         self._catchup_work = None
+        closer = self.lm.native_closer
+        if closer is not None and closer.degraded is None \
+                and not closer.bridge.active:
+            closer.activate()       # resume native close post-catchup
         self.herder._drain_buffered()
 
     def start(self) -> None:
@@ -361,6 +386,10 @@ class Application:
 
     def stop(self) -> None:
         self._stopped = True
+        if self.lm.native_closer is not None:
+            # move ledger authority back to Python (rebuilds buckets and,
+            # with a database attached, persists the final LCL durably)
+            self.lm.detach_native_close()
         if self.herder.admission is not None:
             self.herder.admission.close()
         if self.lm.meta_stream is not None \

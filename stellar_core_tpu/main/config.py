@@ -77,6 +77,18 @@ class Config:
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
+    # Native live close (ledger/native_close.py): "auto" routes
+    # LedgerManager.close through the C apply engine when the extension
+    # is built, the root is in-memory and no invariants are configured;
+    # "on" additionally warns loudly when that cannot be honored; "off"
+    # keeps the pure-Python close.
+    NATIVE_CLOSE: str = "auto"
+    # Differential spot-check cadence: every Nth close also runs the
+    # Python engine on a scratch copy and fail-stops with a crash bundle
+    # on any divergence (results, fees, header hash, bucket hashes).
+    # 0 = defer to the NATIVE_CLOSE_DIFFERENTIAL environment variable
+    # (unset -> no spot-checks).  N=1 is the differential test tier.
+    NATIVE_CLOSE_DIFFERENTIAL: int = 0
     # Range-parallel catchup (catchup/parallel.py): `catchup` splits a
     # complete replay into this many concurrent checkpoint ranges, each a
     # subprocess worker seeding itself via assume-state; every boundary's
@@ -165,6 +177,7 @@ class Config:
             "METADATA_OUTPUT_STREAM",
             "ACCEL_CHUNK_SIZE", "CATCHUP_PARALLEL_WORKERS",
             "CHECKPOINT_FREQUENCY",
+            "NATIVE_CLOSE", "NATIVE_CLOSE_DIFFERENTIAL",
             "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
             "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
             "ADMISSION_MAX_BACKLOG",

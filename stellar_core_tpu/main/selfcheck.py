@@ -34,7 +34,11 @@ def self_check(lm, database=None, bucket_dir=None,
           f"stored {lm.lcl_hash.hex()[:16]} recomputed "
           f"{header_hash.hex()[:16]}")
 
-    # 2. live bucket list matches the header
+    # 2. live bucket list matches the header.  With native live close
+    # the authoritative buckets live in the C engine between checkpoint
+    # boundaries — rebuild the Python view first (hash-verified inside)
+    if lm.native_closer is not None and lm.native_closer.bridge.active:
+        lm.native_closer.bridge.sync_buckets_to(lm)
     check("bucket-list-hash",
           lm.bucket_list.hash() == lm.lcl_header.bucketListHash)
 

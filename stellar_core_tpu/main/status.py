@@ -24,6 +24,7 @@ STATUS_CATEGORIES = (
     "scp",
     "overlay",
     "bucket",
+    "ledger",
     "requires-upgrades",
 )
 

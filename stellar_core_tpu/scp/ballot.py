@@ -464,10 +464,11 @@ class BallotProtocol:
         counters = self.index.node_counter   # read-only view, no rebuild
         ahead = sorted({c for c in counters.values() if c > target})
         # v-blocking-ness is monotone in the node set, so only the smallest
-        # ahead counter (largest node set) can qualify
+        # ahead counter (largest node set) can qualify; the verdict runs
+        # over compiled qsets and LATCHES through the StatementIndex
+        # (counters only grow — a regression drops the latches)
         for n in ahead:
-            nodes = {nid for nid, c in counters.items() if c >= n}
-            if ln.is_v_blocking(nodes):
+            if Q.v_blocking_ahead(ln.qset, ln.qset_hash, self.index, n):
                 # abandon_ballot owns the value selection (z, then the
                 # nomination composite, then the current ballot's value)
                 return self.abandon_ballot(n)

@@ -62,7 +62,8 @@ class LocalNode:
             if got is not None:
                 return got
         accepted_nodes = {n for n, st in stmt_map.items() if accepted(st)}
-        if Q.is_v_blocking(self.qset, accepted_nodes):
+        if Q.is_v_blocking_compiled(Q.compile_qset_cached(self.qset),
+                                    accepted_nodes):
             res = True
         else:
             res = Q.is_quorum(self.qset, stmt_map, qset_of,
@@ -89,7 +90,8 @@ class LocalNode:
         return res
 
     def is_v_blocking(self, nodes: Set[bytes]) -> bool:
-        return Q.is_v_blocking(self.qset, nodes)
+        return Q.is_v_blocking_compiled(Q.compile_qset_cached(self.qset),
+                                        nodes)
 
     # --- set-based fast paths ---------------------------------------------
     # Callers that maintain per-value voter registries incrementally
@@ -105,7 +107,8 @@ class LocalNode:
         got = index.lookup(k)
         if got is not None:
             return got
-        if Q.is_v_blocking(self.qset, accepted_nodes):
+        if Q.is_v_blocking_compiled(Q.compile_qset_cached(self.qset),
+                                    accepted_nodes):
             res = True
         else:
             res = Q.quorum_contains(self.qset,

@@ -16,6 +16,20 @@ from .driver import NOMINATION_TIMER, ValidationLevel
 StType = SX.SCPStatementType
 
 
+def _newer_by_summary(votes_f: frozenset, accepted_f: frozenset,
+                      new_total: int, old_summary: tuple,
+                      old_total: int) -> bool:
+    """Registry form of _is_newer: the old statement's frozensets come
+    from the per-node summary map instead of a fresh XDR walk + set()
+    build per envelope.  Growth is measured on the RAW vote-list lengths
+    (a hostile statement may carry duplicates; collapsing them here would
+    change which replays get rejected)."""
+    old_votes_f, old_accepted_f = old_summary
+    if not (old_votes_f <= votes_f and old_accepted_f <= accepted_f):
+        return False
+    return new_total > old_total
+
+
 class NominationProtocol:
     def __init__(self, slot):
         self.slot = slot
@@ -27,6 +41,10 @@ class NominationProtocol:
         # node -> (votes frozenset, accepted frozenset), in lockstep with
         # latest_nominations
         self._summaries: Dict[bytes, tuple] = {}
+        # node -> len(votes) + len(accepted) of the RAW lists (the
+        # _is_newer growth measure; kept separately so the summary tuple
+        # shape stays (votes, accepted) for every existing consumer)
+        self._summary_sizes: Dict[bytes, int] = {}
         # per-value voter registries, updated with each statement's DELTA
         # (sound because _is_newer guarantees vote sets only grow): the
         # federated accept/ratify calls below take these materialized
@@ -218,14 +236,24 @@ class NominationProtocol:
         if not self._sane(st):
             return False
         old = self.latest_nominations.get(nid)
-        if old is not None and not self._is_newer(st, old.statement):
-            return False
-        self.latest_nominations[nid] = env
         nom_st = self._nom(st)
-        old_summary = self._summaries.get(nid)
         votes_f = frozenset(nom_st.votes)
         accepted_f = frozenset(nom_st.accepted)
+        new_total = len(nom_st.votes) + len(nom_st.accepted)
+        old_summary = self._summaries.get(nid)
+        if old is not None:
+            # newer-statement check against the compiled-frozenset
+            # registry — no XDR re-walk of the superseded statement
+            if old_summary is not None:
+                if not _newer_by_summary(votes_f, accepted_f, new_total,
+                                         old_summary,
+                                         self._summary_sizes[nid]):
+                    return False
+            elif not self._is_newer(st, old.statement):
+                return False
+        self.latest_nominations[nid] = env
         self._summaries[nid] = (votes_f, accepted_f)
+        self._summary_sizes[nid] = new_total
         for v in (votes_f if old_summary is None
                   else votes_f - old_summary[0]):
             self._voted_nom.setdefault(v, set()).add(nid)

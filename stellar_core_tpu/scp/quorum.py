@@ -83,6 +83,28 @@ def is_v_blocking(qset, nodes: Set[NodeIDb]) -> bool:
     return False
 
 
+def is_v_blocking_compiled(cq: tuple, nodes: Set[NodeIDb]) -> bool:
+    """is_v_blocking over a compile_qset form — the v-blocking arm of
+    every federated_accept runs per envelope, and the XDR descriptor walk
+    was the last per-envelope qset traversal left after the round-11
+    slice compilation (same move as _compiled_slice_ok)."""
+    threshold, validators, inners = cq
+    if threshold == 0:
+        return False
+    left = len(validators) + len(inners) - threshold + 1
+    for v in validators:
+        if v in nodes:
+            left -= 1
+            if left <= 0:
+                return True
+    for inner in inners:
+        if is_v_blocking_compiled(inner, nodes):
+            left -= 1
+            if left <= 0:
+                return True
+    return False
+
+
 def compile_qset(qset) -> tuple:
     """Flatten a qset into plain nested tuples ``(threshold,
     (validator_bytes, ...), (inner, ...))`` — slice checks over the
@@ -270,6 +292,24 @@ def heard_from_quorum(local_qset, local_qset_hash: bytes,
     voted = {n for n, c in index.node_counter.items() if c >= min_counter}
     res = _compiled_slice_ok(compile_qset_cached(local_qset),
                              quorum_survivors(voted, index.node_cq))
+    index.store(key, res, latch=True)
+    return res
+
+
+def v_blocking_ahead(local_qset, local_qset_hash: bytes,
+                     index: StatementIndex, counter: int) -> bool:
+    """Latched counter catch-up check (BallotProtocol::_attempt_bump): is
+    a v-blocking set announcing ballot counters >= `counter`?  The
+    voting-node set only grows and counters are non-decreasing (a
+    regression bumps qset_epoch and drops every latch — see
+    StatementIndex), so a True verdict is monotone for the slot and
+    latches exactly like heard_from_quorum."""
+    key = ("vba", counter, local_qset_hash)
+    got = index.lookup(key)
+    if got is not None:
+        return got
+    nodes = {n for n, c in index.node_counter.items() if c >= counter}
+    res = is_v_blocking_compiled(compile_qset_cached(local_qset), nodes)
     index.store(key, res, latch=True)
     return res
 

@@ -42,6 +42,12 @@ CANONICAL_METRICS = frozenset({
     "ledger.ledger.close",
     "ledger.transaction.apply",
     "ledger.fee.process",
+    # native live close (ledger/native_close.py): closes through the C
+    # engine, per-close Python fallbacks/degrades, differential
+    # spot-checks run — a silent fallback regression shows here
+    "ledger.native.closes",
+    "ledger.native.fallbacks",
+    "ledger.native.differential-checks",
     # scp / herder
     "scp.envelope.receive",
     "scp.envelope.nominate",
@@ -81,6 +87,10 @@ CANONICAL_METRICS = frozenset({
     "catchup.preverify.sigs-total",
     "catchup.preverify.sigs-shipped",
     "catchup.preverify.fallback",
+    # native-engine checkpoint outcomes (works.py): applied in C vs
+    # probe-rejected to the Python oracle
+    "catchup.native.checkpoint",
+    "catchup.native.fallback",
     # range-parallel catchup (catchup/parallel.py)
     "catchup.parallel.ranges-inflight",
     "catchup.parallel.range-retry",

@@ -450,3 +450,105 @@ def test_normalize_removal_decrements_threshold():
     q2 = make_qset([nid(1)], 2, inner=[make_qset([nid(0)], 1)])
     n2 = S.normalize_qset(q2, remove=nid(0))
     assert n2.threshold == 1 and len(n2.validators) == 1 and not n2.innerSets
+
+
+class TestVBlockingFastPaths:
+    """Round-12 latching (ROADMAP 4c): the compiled/latched v-blocking
+    checks must answer EXACTLY what the from-scratch walks answer —
+    differential style, like the heard-from-quorum suite above."""
+
+    def test_compiled_v_blocking_matches_raw_randomized(self):
+        import random
+        from stellar_core_tpu.scp import quorum as Q
+        rng = random.Random(13)
+        ids = [nid(i) for i in range(12)]
+        for _ in range(200):
+            n = 2 + rng.randrange(6)
+            members = rng.sample(ids, n)
+            inner = []
+            if rng.random() < 0.5:
+                im = rng.sample(ids, 2 + rng.randrange(3))
+                inner = [make_qset(im, 1 + rng.randrange(len(im)))]
+            q = make_qset(members, 1 + rng.randrange(n + len(inner)), inner)
+            nodes = {i for i in ids if rng.random() < 0.4}
+            assert Q.is_v_blocking_compiled(Q.compile_qset_cached(q),
+                                            nodes) \
+                == Q.is_v_blocking(q, nodes)
+        # threshold-0 edge: never v-blocking, both forms
+        q0 = make_qset([nid(1)], 0)
+        assert Q.is_v_blocking(q0, {nid(1)}) is False
+        assert Q.is_v_blocking_compiled(Q.compile_qset_cached(q0),
+                                        {nid(1)}) is False
+
+    @staticmethod
+    def _scratch_ahead(qset, index, counter):
+        """The pre-latch implementation: fresh node-set build + raw
+        is_v_blocking walk per call."""
+        from stellar_core_tpu.scp import quorum as Q
+        nodes = {n for n, c in index.node_counter.items() if c >= counter}
+        return Q.is_v_blocking(qset, nodes)
+
+    def test_v_blocking_ahead_latches_and_matches_scratch(self):
+        import random
+        from stellar_core_tpu.scp import quorum as Q
+        rng = random.Random(29)
+        q = make_qset([nid(i) for i in range(5)], 3)
+        qh = S.qset_hash(q)
+        holder = make_qset([nid(9)], 1)
+        idx = Q.StatementIndex()
+        for step in range(120):
+            node = nid(rng.randrange(5))
+            counter = 1 + rng.randrange(6)
+            idx.note_statement(node, counter, holder, b"h")
+            for probe in (1, 2, 3, 4, 5, 6):
+                assert Q.v_blocking_ahead(q, qh, idx, probe) \
+                    == self._scratch_ahead(q, idx, probe), \
+                    f"diverged at step {step} probe {probe}"
+
+    def test_v_blocking_ahead_latch_drops_on_regression(self):
+        from stellar_core_tpu.scp import quorum as Q
+        q = make_qset([nid(1), nid(2)], 2)   # any single node v-blocks
+        qh = S.qset_hash(q)
+        holder = make_qset([nid(9)], 1)
+        idx = Q.StatementIndex()
+        idx.note_statement(nid(1), 5, holder, b"h")
+        assert Q.v_blocking_ahead(q, qh, idx, 4) is True
+        assert idx.lookup(("vba", 4, qh)) is True       # latched
+        idx.note_statement(nid(1), 2, holder, b"h")     # counter regression
+        assert idx.lookup(("vba", 4, qh)) is None       # latch dropped
+        assert Q.v_blocking_ahead(q, qh, idx, 4) \
+            == self._scratch_ahead(q, idx, 4) is False
+
+    def test_nomination_newer_registry_matches_xdr_walk(self):
+        """_newer_by_summary (frozenset registries) vs the original
+        XDR-walking _is_newer over randomized vote sets — including
+        duplicate entries a hostile statement may carry, where raw-list
+        totals and set sizes diverge."""
+        import random
+        from stellar_core_tpu.scp.nomination import _newer_by_summary
+
+        def reference(new_votes, new_acc, old_votes, old_acc):
+            # nomination.py's original _is_newer, verbatim semantics
+            if not (set(old_votes) <= set(new_votes)):
+                return False
+            if not (set(old_acc) <= set(new_acc)):
+                return False
+            return (len(new_votes) + len(new_acc)
+                    > len(old_votes) + len(old_acc))
+
+        rng = random.Random(31)
+        vals = [b"%d" % i for i in range(6)]
+        for _ in range(500):
+            old_votes = [rng.choice(vals)
+                         for _ in range(rng.randrange(5))]
+            old_acc = [rng.choice(vals) for _ in range(rng.randrange(4))]
+            new_votes = [rng.choice(vals)
+                         for _ in range(rng.randrange(5))]
+            new_acc = [rng.choice(vals) for _ in range(rng.randrange(4))]
+            got = _newer_by_summary(
+                frozenset(new_votes), frozenset(new_acc),
+                len(new_votes) + len(new_acc),
+                (frozenset(old_votes), frozenset(old_acc)),
+                len(old_votes) + len(old_acc))
+            assert got == reference(new_votes, new_acc,
+                                    old_votes, old_acc)
