@@ -1,6 +1,6 @@
 .PHONY: native native-live test lint race metrics obs bucketdb \
 	bucketdb-slow chaos chaos-byz chaos-soak loadgen loadgen-slow \
-	catchup-par fleet fleet-soak clean
+	catchup-par catchup-mesh fleet fleet-soak clean
 
 native:
 	python setup.py build_ext --inplace
@@ -111,6 +111,18 @@ loadgen-slow:
 # crash-bundle and leave the authoritative ledger dir untouched.
 catchup-par:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_catchup_parallel.py \
+		-q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# mesh + work-stealing suite (ISSUE 14): steal-plan units, the
+# limit/ack handshake, forged-steal-seam fail-stop, the straggler-
+# injected e2e (steal beats no-steal in wall clock), and the
+# device-pinning path over the CPU-SIMULATED 8-device mesh
+# (--xla_force_host_platform_device_count) — so per-worker visible-
+# device threading runs in every verify, not only on-chip.
+catchup-mesh:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -m pytest tests/test_catchup_mesh.py \
 		-q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # fleet harness suite (ISSUE 11): provisioning/schedule/SLO units plus

@@ -1,0 +1,90 @@
+"""Device-per-range mesh assignment for parallel catchup.
+
+MULTICHIP dryruns prove the 8-device data-parallel kernels (sharded
+verify, psum, e2e hash-identical) but a single process drives them; the
+production path that actually multiplies throughput is N `catchup-range`
+subprocess workers, each pinned to ONE device so ranges never contend
+for chip 0 (ROADMAP item 2: "assign one device per range worker").
+
+Pinning happens entirely through the worker's environment, threaded into
+the subprocess command line by catchup/parallel.py exactly like the
+existing PYTHONPATH pin — the variables are in place before the worker's
+Python starts, so JAX sees only its assigned device at import, with no
+in-process re-initialization gymnastics:
+
+* ``tpu``  — ``TPU_VISIBLE_DEVICES=<k>`` plus single-chip process bounds
+  (the libtpu runtime maps the one visible chip to logical device 0).
+* ``cuda`` — ``CUDA_VISIBLE_DEVICES=<k>``.
+* ``cpu``  — the CPU-simulated mesh (`make catchup-mesh`,
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=8``): each worker
+  gets a rewritten ``XLA_FLAGS`` forcing exactly ONE host device, so the
+  pinning path runs in every tier-1 verify, not only on-chip.
+
+``STPU_DEVICE_INDEX`` / ``STPU_DEVICE_COUNT`` always ride along so the
+worker can report its assignment in the stitch record.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+ENV_DEVICE_INDEX = "STPU_DEVICE_INDEX"
+ENV_DEVICE_COUNT = "STPU_DEVICE_COUNT"
+
+_HOST_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def resolve_platform(explicit: str = "auto") -> str:
+    """The platform the mesh env should pin for.  An explicit choice wins;
+    "auto" reads JAX_PLATFORMS from the environment (set on every CPU
+    verify/bench invocation) and only falls back to importing jax — the
+    expensive probe — when nothing names the platform."""
+    if explicit and explicit != "auto":
+        return explicit
+    env = os.environ.get("JAX_PLATFORMS", "")
+    if env.strip():
+        return env.split(",")[0].strip()
+    try:
+        import jax
+        return jax.default_backend()
+    except (ImportError, RuntimeError):
+        # jax-less rigs (or a backend that fails to initialize) pin the
+        # cpu path — the worker env is then a harmless host-count force
+        return "cpu"
+
+
+def worker_device_env(index: int, total: int,
+                      platform: str = "auto") -> Dict[str, str]:
+    """Environment additions pinning one range worker to one device
+    (round-robin callers pass index = spec.index % total)."""
+    platform = resolve_platform(platform)
+    env = {ENV_DEVICE_INDEX: str(index), ENV_DEVICE_COUNT: str(total)}
+    if platform == "tpu":
+        env["TPU_VISIBLE_DEVICES"] = str(index)
+        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+    elif platform in ("cuda", "gpu", "rocm"):
+        env["CUDA_VISIBLE_DEVICES"] = str(index)
+    else:
+        # CPU-simulated mesh: strip any inherited force-N flag (the
+        # orchestrator's own 8-device mesh) and force exactly one host
+        # device in the worker
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith(_HOST_COUNT_FLAG)]
+        flags.append(f"{_HOST_COUNT_FLAG}=1")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def assigned_device_index() -> Optional[int]:
+    """The worker side: the device index this process was pinned to by
+    worker_device_env, or None when unpinned."""
+    raw = os.environ.get(ENV_DEVICE_INDEX)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None

@@ -79,10 +79,30 @@ class PreverifyPipeline:
 
     ``dispatch(groups, ledger_state)`` pairs every hint-pairable signature
     of one or more checkpoints and enqueues the device kernels WITHOUT
-    syncing (accel verify_async); ``collect(checkpoint)`` blocks on the
-    verdicts of the group containing that checkpoint and seeds the process
-    verify cache.  Between the two calls the device computes while the host
-    applies earlier ledgers.
+    syncing (accel verify_async); ``collect(checkpoint)`` seeds the
+    verdicts of the group containing that checkpoint into the process
+    verify cache.  Between the two calls the device computes while the
+    host applies earlier ledgers.
+
+    Offload profiles (ISSUE 14: the device may only ever ADD throughput):
+
+    * ``poll`` (the default) — collect() is a NON-BLOCKING poll: every
+      materialized group's verdicts are harvested and seeded on the spot,
+      and a group whose verdicts have not materialized yet is simply
+      skipped (the apply verifies those signatures on CPU via libsodium —
+      verdicts identical, the device is never waited on).  A group that
+      ripens later is still seeded at the next checkpoint's collect, so
+      its remaining checkpoints hit the cache (``sigs_late_seeded``).
+      The consumer's worst case is the CPU replay rate minus the (small,
+      measured) pairing cost — the device cannot drag it below that.
+    * ``race`` (opt-in; the pre-ISSUE-14 behavior) — collect() waits,
+      bounded by what libsodium would charge for the group, and repeated
+      losses/wedges disable the pipeline.  The admission pipeline keeps
+      this profile: it needs the batch's verdicts to answer the submitter.
+    * ``sig-only`` — like ``poll``, but the pipeline never disables
+      itself: the device ships signature verdicts opportunistically for
+      as long as the catchup runs and is never on the ledger-close
+      critical path, however slow it is.
 
     Pairing candidates per signature: the tx/fee-bump/op source accounts'
     master keys, every ed25519 signer of those accounts in `ledger_state`
@@ -97,12 +117,26 @@ class PreverifyPipeline:
     verification — verdicts never differ, only where they're computed.
     """
 
+    # profile names (see class docstring); DEFAULT_PROFILE is a class
+    # attribute so tests that need deterministic blocking collects can pin
+    # the legacy profile process-wide
+    PROFILE_POLL = "poll"
+    PROFILE_RACE = "race"
+    PROFILE_SIG_ONLY = "sig-only"
+    DEFAULT_PROFILE = "poll"
+    PROFILES = (PROFILE_POLL, PROFILE_RACE, PROFILE_SIG_ONLY)
+
     def __init__(self, network_id: bytes, chunk_size: int = 2048,
                  stats: Optional[Dict[str, int]] = None,
                  hot_threshold: int = 1 << 62,
-                 verdict_sink=None, pair_extractor=None):
+                 verdict_sink=None, pair_extractor=None,
+                 profile: Optional[str] = None):
         self.network_id = network_id
         self.chunk_size = chunk_size
+        self.profile = profile if profile is not None else self.DEFAULT_PROFILE
+        if self.profile not in self.PROFILES:
+            raise ValueError(f"unknown offload profile {self.profile!r} "
+                             f"(know: {self.PROFILES})")
         # optional second consumer of collected verdicts (the native apply
         # engine's verify cache) alongside the process verify cache
         self.verdict_sink = verdict_sink
@@ -144,6 +178,18 @@ class PreverifyPipeline:
         self._harvested_hint: Dict[bytes, List[bytes]] = {}
         self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
         self._counted_sigs: Dict[int, int] = {}  # raw-path per-cp totals
+        # poll-profile machinery: dispatched-but-unseeded groups in
+        # dispatch order, harvested (non-blocking) at every collect
+        self._live_groups: List[dict] = []
+        self._collects_since_harvest = 0
+        self._harvested_once = False
+        # auto-tuned dispatch-ahead depth (recommended_coalesce): EWMAs of
+        # the measured consumer rate (host apply seconds per checkpoint)
+        # vs the measured device rate (seconds per paired signature)
+        self._last_collect_t: Optional[float] = None
+        self._apply_s_per_cp: Optional[float] = None
+        self._device_s_per_pair: Optional[float] = None
+        self._pairs_per_cp: Optional[float] = None
         # per-pipeline rate-limit key, unique for process lifetime (an
         # id(self) key would recycle addresses after GC and inherit a
         # dead pipeline's count); discarded in close()
@@ -180,6 +226,17 @@ class PreverifyPipeline:
     # for i >= 1 instead of hoping 0.25s of wall clock beats the device
     # (the old sleep-race test flaked whenever CPU-jax finished first).
     DEVICE_GATE = None
+    # poll profile: a device that NEVER ripens a group still costs pairing
+    # host-prep per dispatch — after this many consecutive checkpoint
+    # collects without a single harvest the pipeline stands down (the
+    # sig-only profile never does).  Before the first harvest ever, the
+    # budget is much larger: the first group absorbs the kernel compile
+    # (~60s observed), which can span many checkpoints of misses.
+    MAX_POLL_MISS_COLLECTS = 8
+    MAX_POLL_MISS_COLLECTS_COLD = 64
+    # auto-tuned dispatch-ahead depth bounds (recommended_coalesce)
+    MIN_COALESCE = 1
+    MAX_COALESCE = 8
 
     def dispatched(self, checkpoint: int) -> bool:
         return checkpoint in self._groups
@@ -190,7 +247,7 @@ class PreverifyPipeline:
         admission pipeline polls this to keep kernel warmup off the
         submission critical path."""
         group = self._groups.get(checkpoint)
-        if group is None or group.get("collected"):
+        if group is None or group.get("collected") or group.get("seeded"):
             return True
         job = group["job"]
         if job is None:
@@ -208,6 +265,19 @@ class PreverifyPipeline:
         self.stats["sigs_total"] = self.stats.get("sigs_total", 0) + n
         if n > 0:
             _registry().counter("catchup.preverify.sigs-total").inc(n)
+
+    def _note_not_dispatched(self, n: int) -> None:
+        """Watermark accounting (ISSUE 14 satellite): signatures that never
+        reached the device at all — unpairable hints, parser-rejected
+        records, a disabled pipeline.  Distinct from 'device lost the
+        race' (dispatched but not materialized in time), which
+        _collect_poll/_collect_race count on the race-lost meter; the two
+        causes used to share one opaque hit-rate gap."""
+        if n <= 0:
+            return
+        self.stats["sigs_not_dispatched"] = \
+            self.stats.get("sigs_not_dispatched", 0) + n
+        _registry().counter("catchup.preverify.not-dispatched").inc(n)
 
     def _submit(self, fn):
         """Run fn on the single daemon device-worker; returns (box, event).
@@ -273,6 +343,7 @@ class PreverifyPipeline:
                 for frame in frames_by_checkpoint[cp]:
                     total += len(frame.signatures)
             self._add_sigs_total(total)
+            self._note_not_dispatched(total)
             cps = sorted(frames_by_checkpoint)
             group = {"job": None, "pks": [], "sigs": [], "msgs": [],
                      "checkpoints": cps, "collected": True}
@@ -324,38 +395,44 @@ class PreverifyPipeline:
         pks: List[bytes] = []
         sigs: List[bytes] = []
         msgs: List[bytes] = []
-        total = 0
-        for frame in frames:
-            h = frame.content_hash()
-            account_ids = [frame.source_account_id().value]
-            if hasattr(frame, "inner"):
-                account_ids.append(frame.inner.source_account_id().value)
-            for op in frame.operations:
-                if op.sourceAccount is not None:
-                    account_ids.append(
-                        X.muxed_to_account_id(op.sourceAccount).value)
-            candidates = list(account_ids)
-            for aid in account_ids:
-                candidates.extend(signers_of(aid))
-            total += len(frame.signatures)
-            for dsig in frame.signatures:
-                seen = set()
-                for pk in candidates:
-                    if dsig.hint == pk[28:32] and pk not in seen:
-                        seen.add(pk)
-                        pks.append(pk)
-                        sigs.append(dsig.signature)
-                        msgs.append(h)
-                for pk in harvested.get(dsig.hint, ()):
-                    if pk not in seen:
-                        seen.add(pk)
-                        pks.append(pk)
-                        sigs.append(dsig.signature)
-                        msgs.append(h)
-        self._add_sigs_total(total)
+        pairs_by_cp: Dict[int, int] = {}
+        for cp in cps:
+            total = paired = 0
+            for frame in frames_by_checkpoint[cp]:
+                h = frame.content_hash()
+                account_ids = [frame.source_account_id().value]
+                if hasattr(frame, "inner"):
+                    account_ids.append(frame.inner.source_account_id().value)
+                for op in frame.operations:
+                    if op.sourceAccount is not None:
+                        account_ids.append(
+                            X.muxed_to_account_id(op.sourceAccount).value)
+                candidates = list(account_ids)
+                for aid in account_ids:
+                    candidates.extend(signers_of(aid))
+                total += len(frame.signatures)
+                for dsig in frame.signatures:
+                    seen = set()
+                    for pk in candidates:
+                        if dsig.hint == pk[28:32] and pk not in seen:
+                            seen.add(pk)
+                            pks.append(pk)
+                            sigs.append(dsig.signature)
+                            msgs.append(h)
+                    for pk in harvested.get(dsig.hint, ()):
+                        if pk not in seen:
+                            seen.add(pk)
+                            pks.append(pk)
+                            sigs.append(dsig.signature)
+                            msgs.append(h)
+                    if seen:
+                        paired += 1
+            pairs_by_cp[cp] = paired
+            self._add_sigs_total(total)
+            self._note_not_dispatched(total - paired)
         # sigs_shipped is counted at COLLECT time (successful seeding
         # only): a group that wedges and falls back to CPU never shipped
-        self._enqueue_group(cps, pks, sigs, msgs, t0)
+        self._enqueue_group(cps, pks, sigs, msgs, t0, pairs_by_cp)
 
     def dispatch_raw(self, recs_by_checkpoint: Dict[int, Sequence[bytes]]
                      ) -> None:
@@ -366,8 +443,9 @@ class PreverifyPipeline:
             # count signatures per checkpoint (honest hit rate denominator)
             # without materializing pairs, then register a no-op group
             for cp in cps:
-                self._add_sigs_total(
-                    self._count_and_record(cp, recs_by_checkpoint[cp]))
+                n = self._count_and_record(cp, recs_by_checkpoint[cp])
+                self._add_sigs_total(n)
+                self._note_not_dispatched(n)
             group = {"job": None, "pks": [], "sigs": [], "msgs": [],
                      "checkpoints": cps, "collected": True}
             for cp in cps:
@@ -376,6 +454,7 @@ class PreverifyPipeline:
         import time as _time
         t0 = _time.perf_counter()
         pks, sigs, msgs = [], [], []
+        pairs_by_cp: Dict[int, int] = {}
         for cp in cps:
             # per-checkpoint extraction: records each checkpoint's counted
             # total so the Python-fallback apply can correct the
@@ -385,9 +464,14 @@ class PreverifyPipeline:
             pks.extend(p_)
             sigs.extend(s_)
             msgs.extend(m_)
+            # distinct signatures paired (hint collisions pair one sig
+            # against several candidates — count the sig once)
+            paired = len({bytes(s) for s in s_})
+            pairs_by_cp[cp] = paired
             self._counted_sigs[cp] = total
             self._add_sigs_total(total)
-        self._enqueue_group(cps, pks, sigs, msgs, t0)
+            self._note_not_dispatched(total - paired)
+        self._enqueue_group(cps, pks, sigs, msgs, t0, pairs_by_cp)
 
     def _count_and_record(self, cp, recs) -> int:
         from stellar_core_tpu import _capply
@@ -410,8 +494,12 @@ class PreverifyPipeline:
         if counted is None:
             return
         self._add_sigs_total(python_total - counted)
+        # records the C parser rejected were never paired either — they
+        # belong to the never-dispatched bucket, not the race-lost one
+        self._note_not_dispatched(python_total - counted)
 
-    def _enqueue_group(self, cps, pks, sigs, msgs, t0) -> None:
+    def _enqueue_group(self, cps, pks, sigs, msgs, t0,
+                       pairs_by_cp: Optional[Dict[int, int]] = None) -> None:
         import time as _time
 
         from ..accel.ed25519 import verify_batch_async
@@ -429,13 +517,20 @@ class PreverifyPipeline:
             def device_job(pks=pks, sigs=sigs, msgs=msgs):
                 if gate is not None:
                     gate(group_idx)
-                return verify_batch_async(
+                tj = _time.perf_counter()
+                verdicts = verify_batch_async(
                     pks, sigs, msgs, chunk_size=chunk,
                     tail_floor=chunk, hot_threshold=hot)()
+                # device wall rides along for the dispatch-depth auto-tune
+                return verdicts, _time.perf_counter() - tj
 
             job = self._submit(device_job)
         group = {"job": job, "pks": pks, "sigs": sigs,
-                 "msgs": msgs, "checkpoints": cps}
+                 "msgs": msgs, "checkpoints": cps,
+                 "pairs_by_cp": pairs_by_cp or {},
+                 "collected_cps": set()}
+        if job is not None:
+            self._live_groups.append(group)
         for cp in cps:
             self._groups[cp] = group
         # phase accounting (bench per-phase breakdown): host prep + enqueue
@@ -446,17 +541,155 @@ class PreverifyPipeline:
         _registry().timer("catchup.preverify.dispatch").update(dt)
 
     def collect(self, checkpoint: int) -> None:
-        """Sync the verdicts of the group containing `checkpoint` (no-op if
-        never dispatched or already collected) and seed the verify cache.
-        Later checkpoints of an already-collected group stay registered in
-        `_groups` so dispatched() keeps answering True for them — popping
-        them all at first collect made the apply path re-dispatch each one
+        """Make `checkpoint`'s verdicts available to the apply (no-op if
+        never dispatched or already collected) by seeding the verify
+        cache.  Poll/sig-only profiles NEVER wait: ready groups are
+        harvested on the spot, unripe ones fall back to on-demand CPU
+        verification (race-lost accounting) and may still seed later
+        checkpoints when they ripen.  The race profile keeps the
+        pre-ISSUE-14 bounded wait.  Later checkpoints of an
+        already-collected group stay registered in `_groups` so
+        dispatched() keeps answering True for them — popping them all at
+        first collect made the apply path re-dispatch each one
         synchronously (measured: every coalesced group was followed by N-1
         redundant singleton dispatches)."""
+        if self.profile == self.PROFILE_RACE:
+            self._collect_race(checkpoint)
+        else:
+            self._collect_poll(checkpoint)
+
+    def _seed_group(self, group: dict, verdicts) -> None:
+        """Push one materialized group's verdicts into the process verify
+        cache (and the native engine's, via verdict_sink) — main thread
+        only: the sink touches C engine state."""
+        pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
+        keys.seed_verify_cache(
+            (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
+            for i in range(len(pks)))
+        if self.verdict_sink is not None:
+            self.verdict_sink(pks, sigs, msgs, verdicts)
+        self.stats["sigs_shipped"] = \
+            self.stats.get("sigs_shipped", 0) + len(pks)
+        _registry().counter("catchup.preverify.sigs-shipped").inc(len(pks))
+
+    def _count_fallback(self, group: dict, why: str) -> None:
+        n_fallbacks = self.stats.get("collect_fallbacks", 0) + 1
+        self.stats["collect_fallbacks"] = n_fallbacks
+        _registry().counter("catchup.preverify.fallback").inc()
+        emit, _n = rate_limited(log, self._fallback_warn_key,
+                                self.FALLBACK_WARN_EVERY_N)
+        emit("preverify group %s for checkpoints %s — falling back to "
+             "on-demand CPU verification (occurrence %d)",
+             why, group["checkpoints"], n_fallbacks)
+        if emit is not log.warning:
+            eventlog.record("History", "WARNING",
+                            "preverify collect fallback", why=why,
+                            checkpoints=str(group["checkpoints"]),
+                            occurrence=n_fallbacks)
+
+    def _harvest_ready(self) -> None:
+        """Seed every dispatched group whose device verdicts have
+        materialized — a non-blocking sweep run at each collect.  A group
+        that ripens after its own checkpoints started applying still seeds
+        here: the group's LATER checkpoints (coalesced dispatch) then hit
+        the cache instead of recomputing (counted as sigs_late_seeded)."""
+        if not self._live_groups:
+            return
+        harvested = False
+        for group in list(self._live_groups):
+            box, ev, q = group["job"]
+            if not ev.is_set():
+                if q is not self._jobs:
+                    # stale worker generation (dropped at disable): these
+                    # verdicts are never coming
+                    self._live_groups.remove(group)
+                    group["seeded"] = True
+                    self._count_fallback(group, "stranded on a dropped "
+                                         "worker generation")
+                continue
+            self._live_groups.remove(group)
+            group["seeded"] = True
+            if "error" in box:
+                self._count_fallback(group, f"failed: {box['error']}")
+                continue
+            verdicts, dur_s = box["result"]
+            self._seed_group(group, verdicts)
+            n_pairs = max(1, len(group["pks"]))
+            self._device_s_per_pair = self._ewma(
+                self._device_s_per_pair, dur_s / n_pairs)
+            self._pairs_per_cp = self._ewma(
+                self._pairs_per_cp,
+                len(group["pks"]) / max(1, len(group["checkpoints"])))
+            late = sum(group.get("pairs_by_cp", {}).get(c, 0)
+                       for c in group.get("collected_cps", ()))
+            if late:
+                # seeded after those checkpoints' applies already began:
+                # their earlier ledgers recomputed on CPU, the rest hit
+                self.stats["sigs_late_seeded"] = \
+                    self.stats.get("sigs_late_seeded", 0) + late
+                _registry().counter("catchup.preverify.late-seeded") \
+                    .inc(late)
+            harvested = True
+        if harvested:
+            self._harvested_once = True
+            self._collects_since_harvest = 0
+
+    def _collect_poll(self, checkpoint: int) -> None:
+        """Never-wait collect: harvest whatever has ripened; a miss for
+        THIS checkpoint degrades to on-demand CPU verification (verdicts
+        identical — only where they're computed differs) and is metered
+        as a race loss.  The device can only ever ADD throughput."""
+        import time as _time
+        now = _time.perf_counter()
+        if self._last_collect_t is not None:
+            dt = now - self._last_collect_t
+            if 0.0 < dt < 30.0:   # ignore boot/compile outliers
+                self._apply_s_per_cp = self._ewma(self._apply_s_per_cp, dt)
+        self._last_collect_t = now
+        group = self._groups.pop(checkpoint, None)
+        self._harvest_ready()
+        if group is None or group.get("collected") or group["job"] is None:
+            return
+        if group.get("seeded"):
+            group.setdefault("collected_cps", set()).add(checkpoint)
+            return
+        # the device lost the race for this checkpoint: its signatures
+        # verify on CPU during the apply; the group stays live and may
+        # still seed the later checkpoints it covers
+        group.setdefault("collected_cps", set()).add(checkpoint)
+        paired = group.get("pairs_by_cp", {}).get(checkpoint, 0)
+        self.stats["sigs_race_lost"] = \
+            self.stats.get("sigs_race_lost", 0) + paired
+        self.stats["collect_race_misses"] = \
+            self.stats.get("collect_race_misses", 0) + 1
+        if paired:
+            _registry().counter("catchup.preverify.race-lost").inc(paired)
+        self._collects_since_harvest += 1
+        budget = (self.MAX_POLL_MISS_COLLECTS if self._harvested_once
+                  else self.MAX_POLL_MISS_COLLECTS_COLD)
+        if self.profile != self.PROFILE_SIG_ONLY \
+                and self._collects_since_harvest >= budget:
+            # the device has not produced one verdict across `budget`
+            # checkpoints: stop paying pairing prep for it.  The worker
+            # generation is abandoned (daemon; dies with the process).
+            self._disabled = True
+            self._worker = None
+            self._jobs = None
+            log.warning(
+                "preverify pipeline DISABLED after %d checkpoint collects "
+                "without a single materialized device group — remaining "
+                "catchup verifies on CPU", self._collects_since_harvest)
+
+    def _collect_race(self, checkpoint: int) -> None:
+        """The opt-in pre-ISSUE-14 behavior: a bounded wait for the
+        group's verdicts (the admission pipeline needs them to answer the
+        submitter), with wedge/race-loss disable."""
         group = self._groups.pop(checkpoint, None)
         if group is None or group.get("collected"):
             return
         group["collected"] = True
+        if group in self._live_groups:
+            self._live_groups.remove(group)
         job = group["job"]
         if job is None:
             return
@@ -532,6 +765,12 @@ class PreverifyPipeline:
                 self._consecutive_losses += 1
                 self.stats["race_losses"] = \
                     self.stats.get("race_losses", 0) + 1
+                lost = sum(group.get("pairs_by_cp", {}).values())
+                self.stats["sigs_race_lost"] = \
+                    self.stats.get("sigs_race_lost", 0) + lost
+                if lost:
+                    _registry().counter("catchup.preverify.race-lost") \
+                        .inc(lost)
                 if self._consecutive_losses >= self.MAX_CONSECUTIVE_LOSSES:
                     self._disabled = True
                     log.warning(
@@ -557,16 +796,34 @@ class PreverifyPipeline:
             return
         self._consecutive_wedges = 0
         self._consecutive_losses = 0
-        verdicts = box["result"]
-        pks, sigs, msgs = group["pks"], group["sigs"], group["msgs"]
-        keys.seed_verify_cache(
-            (pks[i], sigs[i], msgs[i], bool(verdicts[i]))
-            for i in range(len(pks)))
-        if self.verdict_sink is not None:
-            self.verdict_sink(pks, sigs, msgs, verdicts)
-        self.stats["sigs_shipped"] = \
-            self.stats.get("sigs_shipped", 0) + len(pks)
-        _registry().counter("catchup.preverify.sigs-shipped").inc(len(pks))
+        verdicts, _dur_s = box["result"]
+        self._seed_group(group, verdicts)
+
+    @staticmethod
+    def _ewma(prev: Optional[float], x: float,
+              alpha: float = 0.3) -> float:
+        return x if prev is None else prev + alpha * (x - prev)
+
+    def recommended_coalesce(self, current: int) -> int:
+        """Dispatch-ahead depth auto-tuned against the measured consumer
+        rate (poll/sig-only profiles; CatchupWork consults this before
+        every dispatch sweep).  When the device's measured per-checkpoint
+        verify time exceeds the host's per-checkpoint apply time the depth
+        GROWS — bigger coalesced groups amortize the per-dispatch tunnel
+        overhead, and in poll mode a late group costs nothing.  When the
+        device is comfortably ahead the depth shrinks so seeds stay fresh
+        (smaller groups materialize sooner)."""
+        if self._disabled:
+            return current
+        if self._apply_s_per_cp is None or self._device_s_per_pair is None \
+                or self._pairs_per_cp is None:
+            return current
+        device_s_per_cp = self._device_s_per_pair * self._pairs_per_cp
+        if device_s_per_cp > self._apply_s_per_cp:
+            return min(self.MAX_COALESCE, current * 2)
+        if device_s_per_cp < 0.5 * self._apply_s_per_cp:
+            return max(self.MIN_COALESCE, current - 1)
+        return current
 
     def close(self) -> None:
         """Release the device worker (a pipeline is per-catchup; a node
@@ -577,6 +834,7 @@ class PreverifyPipeline:
             self._jobs.put(None)
         self._worker = None
         self._jobs = None
+        self._live_groups = []
         discard_rate_limit(self._fallback_warn_key)
 
 
@@ -648,7 +906,8 @@ class CatchupManager:
                  native: Optional[bool] = None,
                  bucket_store=None,
                  entry_cache_size: Optional[int] = None,
-                 resident_levels: Optional[int] = None):
+                 resident_levels: Optional[int] = None,
+                 accel_profile: Optional[str] = None):
         """invariant_manager: None (default — the bench/hot replay path;
         the hash chain is the corruption *detector*) or an
         InvariantManager to also *localize* faults during replay and
@@ -676,6 +935,9 @@ class CatchupManager:
         self.accel = accel
         self.accel_chunk = accel_chunk
         self.accel_hot_threshold = accel_hot_threshold
+        # offload profile (PreverifyPipeline docstring): None = the
+        # pipeline default ("poll" — the device can only add throughput)
+        self.accel_profile = accel_profile
         self.invariant_manager = invariant_manager
         self.bucket_store = bucket_store
         self.entry_cache_size = entry_cache_size
@@ -720,7 +982,8 @@ class CatchupManager:
     # -- complete replay (from genesis) ------------------------------------
     def catchup_complete(self, archive: FileHistoryArchive,
                          to_ledger: Optional[int] = None,
-                         clock=None, lookahead: int = 2) -> LedgerManager:
+                         clock=None, lookahead: int = 2,
+                         checkpoint_hook=None) -> LedgerManager:
         """Replay every ledger from genesis to the target, built from the
         historywork DAG: per-checkpoint download/verify units run
         `lookahead` ahead of the sequential cooperative apply, with retry
@@ -740,14 +1003,21 @@ class CatchupManager:
                             entry_cache_size=self.entry_cache_size,
                             resident_levels=self.resident_levels)
         mgr.start_new_ledger()
-        self._run_catchup_work(mgr, archive, target, clock, lookahead)
+        self._run_catchup_work(mgr, archive, target, clock, lookahead,
+                               checkpoint_hook)
         return mgr
 
     def _run_catchup_work(self, mgr: LedgerManager,
                           archive: FileHistoryArchive, target: int,
-                          clock=None, lookahead: int = 2) -> None:
+                          clock=None, lookahead: int = 2,
+                          checkpoint_hook=None) -> int:
         """Crank a CatchupWork DAG from mgr's current LCL to `target`
-        (shared by complete and recent modes)."""
+        (shared by complete and recent modes).  `checkpoint_hook(lcl)`
+        runs after every applied checkpoint; returning a lower ledger
+        (a published boundary >= lcl) TRUNCATES the target — the
+        work-stealing seam (catchup.parallel): a range worker that
+        accepted a steal limit stops at the split boundary.  Returns the
+        effective target actually replayed to."""
         from ..historywork.works import CatchupWork
         from ..util.clock import ClockMode, VirtualClock
 
@@ -768,6 +1038,8 @@ class CatchupManager:
                            # decode happens only on fallback checkpoints
                            decode_txs=not self.native,
                            keep_raw=self.native,
+                           accel_profile=self.accel_profile,
+                           checkpoint_hook=checkpoint_hook,
                            verdict_sink=(bridge.seed_verdicts
                                          if bridge is not None and self.accel
                                          else None),
@@ -802,10 +1074,13 @@ class CatchupManager:
             raise CatchupError(
                 f"catchup ended at {mgr.last_closed_ledger_seq}, "
                 f"target {target}: {detail}")
-        if mgr.last_closed_ledger_seq != target:
+        # the hook may have truncated the target (work stealing): the
+        # WORK's target is the authoritative one the replay must reach
+        if mgr.last_closed_ledger_seq != work.target:
             raise CatchupError(
                 f"catchup ended at {mgr.last_closed_ledger_seq}, "
-                f"target {target}")
+                f"target {work.target}")
+        return work.target
 
     # -- recent (assume buckets at a boundary, replay the tail) -------------
     def catchup_recent(self, archive: FileHistoryArchive, count: int,
@@ -834,21 +1109,26 @@ class CatchupManager:
     # -- one range of a parallel catchup ------------------------------------
     def catchup_range(self, archive: FileHistoryArchive,
                       seed_checkpoint: Optional[int], to_ledger: int,
-                      clock=None, lookahead: int = 2):
+                      clock=None, lookahead: int = 2,
+                      checkpoint_hook=None):
         """Replay one contiguous checkpoint range: assume the hash-verified
         bucket snapshot at `seed_checkpoint` (None = replay from genesis),
         then replay through `to_ledger` with full verification.  Returns
         (manager, seed_header_hash) — the seed hash is the stitch evidence
         a parallel orchestrator checks against the previous range's final
-        ledger hash (catchup.parallel.verify_stitches)."""
+        ledger hash (catchup.parallel.verify_stitches).  `checkpoint_hook`
+        (see _run_catchup_work) lets a work-stealing orchestrator truncate
+        the range at a later boundary mid-replay."""
         if seed_checkpoint is None:
             return (self.catchup_complete(archive, to_ledger=to_ledger,
-                                          clock=clock, lookahead=lookahead),
+                                          clock=clock, lookahead=lookahead,
+                                          checkpoint_hook=checkpoint_hook),
                     None)
         mgr = self.catchup_minimal(archive, checkpoint=seed_checkpoint)
         seed_hash = mgr.lcl_hash
         if mgr.last_closed_ledger_seq < to_ledger:
-            self._run_catchup_work(mgr, archive, to_ledger, clock, lookahead)
+            self._run_catchup_work(mgr, archive, to_ledger, clock,
+                                   lookahead, checkpoint_hook)
         return mgr, seed_hash
 
     # -- minimal (assume state from buckets, no replay) ---------------------

@@ -29,6 +29,26 @@ Reference sequencing: src/catchup/CatchupWork.cpp runs ApplyBucketsWork
 once, then ApplyCheckpointWork strictly sequentially; this module runs N
 CatchupWork-shaped pipelines whose ApplyBuckets seeds are interior
 checkpoints, then proves the seams.
+
+ISSUE 14 additions:
+
+* **Device-per-range mesh** — with ``mesh_devices=N`` each worker's env
+  pins it to one JAX device round-robin (accel/mesh.py), threaded through
+  the subprocess cmdline like the PYTHONPATH pin, so N ranges × N devices
+  multiply instead of contending for chip 0.
+* **Checkpoint-granular work stealing** — the PROFILE round 9 curve is
+  capped by the straggler range.  Each worker heartbeats its LCL into a
+  control dir (``ctl-XX/progress.json``, survives retry wipes of the
+  range dir); when a worker finishes, the orchestrator picks the slowest
+  running range, splits its REMAINING checkpoints at a published boundary
+  (plan_steal: the thief adopts the later half), and negotiates via a
+  limit/ack handshake: the victim's CatchupWork truncates its target at
+  the split boundary ONLY after writing an explicit accept ack, and the
+  thief subprocess (seeded at the split via assume-state, like any range)
+  is spawned only after that ack — so the seam is deterministic even
+  though progress races the negotiation.  verify_stitches proves the
+  dynamically-split seams exactly like the planned ones; a forged steal
+  seam fail-stops the whole catchup with a crash bundle.
 """
 
 from __future__ import annotations
@@ -80,6 +100,120 @@ class RangeSpec:
         return self.replay_to - self.replay_from + 1
 
 
+def remaining_checkpoint_units(progress: int, replay_to: int) -> int:
+    """How many checkpoint-granular work units are left in (progress,
+    replay_to]: one per published boundary plus the partial tail (when
+    replay_to is not itself a boundary)."""
+    if replay_to <= progress:
+        return 0
+    freq = checkpoint_frequency()
+    boundaries = [b for b in range(freq - 1, replay_to + 1, freq)
+                  if b > progress]
+    tail = 0 if boundaries and boundaries[-1] == replay_to else 1
+    return len(boundaries) + tail
+
+
+def plan_steal(progress: int, replay_to: int) -> Optional[int]:
+    """Split the remaining (progress, replay_to] work of a straggler range
+    at a published checkpoint boundary.  Returns the boundary the victim
+    stops at — the thief seeds there via assume-state and replays
+    (boundary, replay_to] — or None when fewer than two units remain.
+    The thief adopts HALF the remaining checkpoints (rounded down), the
+    LATER half, so the victim never rewinds; seams stay
+    checkpoint-aligned because only published boundaries are split
+    points."""
+    freq = checkpoint_frequency()
+    candidates = [b for b in range(freq - 1, replay_to, freq)
+                  if b > progress]
+    if not candidates:
+        return None
+    total_units = remaining_checkpoint_units(progress, replay_to)
+    steal_units = total_units // 2
+    if steal_units < 1:
+        return None
+    keep_units = total_units - steal_units
+    # the victim keeps units 1..keep: its new end is the keep-th boundary
+    return candidates[keep_units - 1]
+
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    """Both halves of the steal handshake write through here — a torn
+    limit/ack would desynchronize the seam negotiation."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class RangeControl:
+    """The worker-side half of the stealing protocol, rooted in a control
+    dir that OUTLIVES retry wipes of the range dir:
+
+    * ``progress.json`` — heartbeat: the LCL after every applied
+      checkpoint (and the throttle seam for straggler-injection tests:
+      STPU_CATCHUP_THROTTLE_S sleeps that long per checkpoint).
+    * ``limit.json`` — orchestrator-written steal limit: a published
+      boundary this range should stop at.
+    * ``limit-ack.json`` — the worker's verdict.  ``accepted`` means the
+      worker WILL stop exactly at the limit (its CatchupWork target is
+      truncated); ``rejected`` means progress already passed it.  The
+      orchestrator spawns the thief only on an accept, so a lost race
+      never tears a seam.
+    """
+
+    PROGRESS = "progress.json"
+    LIMIT = "limit.json"
+    ACK = "limit-ack.json"
+
+    def __init__(self, ctl_dir: str, throttle_s: Optional[float] = None):
+        os.makedirs(ctl_dir, exist_ok=True)
+        self.dir = ctl_dir
+        self.accepted: Optional[int] = None
+        self._rejected = False
+        if throttle_s is None:
+            throttle_s = float(
+                os.environ.get("STPU_CATCHUP_THROTTLE_S", "0") or 0.0)
+        self.throttle_s = throttle_s
+
+    def _write(self, name: str, doc: dict) -> None:
+        _write_json_atomic(os.path.join(self.dir, name), doc)
+
+    def _read(self, name: str) -> Optional[dict]:
+        return _read_json(os.path.join(self.dir, name))
+
+    def checkpoint_hook(self, lcl: int) -> Optional[int]:
+        """CatchupWork hook: heartbeat + honor at most one steal limit.
+        Returns the accepted boundary (the truncated target) or None."""
+        if self.throttle_s:
+            _time.sleep(self.throttle_s)
+        self._write(self.PROGRESS, {"lcl": lcl})
+        if self.accepted is not None or self._rejected:
+            return self.accepted
+        lim = self._read(self.LIMIT)
+        if lim is None:
+            return None
+        boundary = int(lim["replay_to"])
+        if boundary >= lcl:
+            self.accepted = boundary
+            self._write(self.ACK, {"accepted": boundary})
+            eventlog.record("History", "INFO", "steal limit accepted",
+                            boundary=boundary, lcl=lcl)
+            return boundary
+        self._rejected = True
+        self._write(self.ACK, {"rejected": lcl})
+        eventlog.record("History", "INFO", "steal limit rejected",
+                        boundary=boundary, lcl=lcl)
+        return None
+
+
 def plan_parallel_ranges(target: int, workers: int) -> List[RangeSpec]:
     """Split the checkpoints covering (genesis, target] into up to
     `workers` contiguous ranges.  Every interior seam sits on a published
@@ -120,6 +254,9 @@ def run_range(archive, spec: RangeSpec, network_id: bytes, passphrase: str,
               entry_cache_size: Optional[int] = None,
               resident_levels: Optional[int] = None,
               persist_dir: Optional[str] = None,
+              persist_target: Optional[int] = None,
+              ctl_dir: Optional[str] = None,
+              accel_profile: Optional[str] = None,
               clock=None, lookahead: int = 2) -> dict:
     """Seed + replay one range and return its stitch record.  This is the
     in-process body of the `catchup-range` worker subcommand; tests drive
@@ -128,7 +265,12 @@ def run_range(archive, spec: RangeSpec, network_id: bytes, passphrase: str,
     With `bucket_dir`, the range's assumed/replayed state lives in its own
     BucketListDB store there (throwaway for interior ranges).  With
     `persist_dir`, the final state is durably persisted (Database +
-    BucketDir) so the orchestrator can adopt the last range's ledger."""
+    BucketDir) so the orchestrator can adopt the last range's ledger —
+    gated on `persist_target` when given: under work stealing whichever
+    worker actually ENDS at the catchup target owns the adoptable state,
+    and a truncated victim must not burn time persisting a mid-chain
+    snapshot.  With `ctl_dir`, the worker heartbeats progress and honors
+    steal limits (RangeControl)."""
     from ..catchup.catchup import CatchupManager
 
     store = None
@@ -140,13 +282,19 @@ def run_range(archive, spec: RangeSpec, network_id: bytes, passphrase: str,
                         invariant_manager=invariant_manager,
                         bucket_store=store,
                         entry_cache_size=entry_cache_size,
-                        resident_levels=resident_levels)
+                        resident_levels=resident_levels,
+                        accel_profile=accel_profile)
+    control = RangeControl(ctl_dir) if ctl_dir is not None else None
     t0 = _time.perf_counter()
-    mgr, seed_hash = cm.catchup_range(archive, spec.seed_checkpoint,
-                                      spec.replay_to, clock=clock,
-                                      lookahead=lookahead)
+    mgr, seed_hash = cm.catchup_range(
+        archive, spec.seed_checkpoint, spec.replay_to, clock=clock,
+        lookahead=lookahead,
+        checkpoint_hook=control.checkpoint_hook if control else None)
     wall = _time.perf_counter() - t0
-    if persist_dir is not None:
+    final_seq = mgr.last_closed_ledger_seq
+    persisted = persist_dir is not None and (
+        persist_target is None or final_seq == persist_target)
+    if persisted:
         from ..bucket.manager import BucketDir
         from ..database import Database
         os.makedirs(persist_dir, exist_ok=True)
@@ -154,20 +302,34 @@ def run_range(archive, spec: RangeSpec, network_id: bytes, passphrase: str,
         mgr.enable_persistence(db, BucketDir(
             os.path.join(persist_dir, "buckets")))
         db.close()
-    n = spec.n_ledgers
-    return {
+    n = final_seq - spec.replay_from + 1
+    result = {
         "index": spec.index,
         "seed_checkpoint": spec.seed_checkpoint,
         "seed_header_hash": seed_hash.hex() if seed_hash is not None else None,
         "replay_to": spec.replay_to,
-        "final_ledger_seq": mgr.last_closed_ledger_seq,
+        "final_ledger_seq": final_seq,
         "final_hash": mgr.lcl_hash.hex(),
         "ledgers_replayed": n,
         "wall_s": round(wall, 3),
         "ledgers_per_s": round(n / wall, 1) if wall > 0 else 0.0,
         "sig_offload_hit_rate": round(cm.offload_hit_rate(), 3),
-        "persisted": persist_dir is not None,
+        "persisted": persisted,
     }
+    if final_seq < spec.replay_to:
+        result["truncated_to"] = final_seq   # a thief adopted the tail
+    # read the pin straight from the env: importing accel.mesh would drag
+    # the whole accel package (and its eager jax import) into every
+    # CPU-only worker
+    dev = os.environ.get("STPU_DEVICE_INDEX")
+    if dev is not None and dev.isdigit():
+        result["device_index"] = int(dev)
+        if accel:
+            # pinned accel worker: record what JAX actually sees (the
+            # mesh env must have reduced it to exactly one device)
+            import jax
+            result["visible_devices"] = len(jax.devices())
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -339,6 +501,12 @@ class ParallelCatchup:
                  keep_range_dirs: bool = False,
                  crash_dir: Optional[str] = None,
                  clock: Optional[VirtualClock] = None,
+                 steal: bool = True,
+                 steal_min_checkpoints: int = 4,
+                 mesh_devices: int = 0,
+                 mesh_platform: str = "auto",
+                 accel_profile: Optional[str] = None,
+                 extra_env: Optional[Dict[int, Dict[str, str]]] = None,
                  python: str = sys.executable):
         from ..crypto.sha import sha256
         self.archive_spec = archive_spec
@@ -353,6 +521,20 @@ class ParallelCatchup:
         self.accel = accel
         self.accel_chunk = accel_chunk
         self.native = native
+        # checkpoint-granular work stealing (module docstring): finished
+        # workers adopt the later half of the slowest range's remaining
+        # checkpoints; only remainders >= steal_min_checkpoints units are
+        # worth a worker spawn + assume-state
+        self.steal = steal
+        self.steal_min_checkpoints = max(2, steal_min_checkpoints)
+        # device-per-range mesh: > 0 pins worker k to device k % N via
+        # env (accel/mesh.py), threaded through the subprocess cmdline
+        self.mesh_devices = max(0, mesh_devices)
+        self.mesh_platform = mesh_platform
+        self.accel_profile = accel_profile
+        # per-range-index env additions (tests inject stragglers with
+        # STPU_CATCHUP_THROTTLE_S; the mesh pin composes on top)
+        self.extra_env = dict(extra_env or {})
         # INVARIANT_CHECKS patterns travel to every worker — a parallel
         # catchup must honor exactly what the single-stream path would;
         # same for the node's storage knobs (IN_MEMORY_LEDGER + the
@@ -369,10 +551,17 @@ class ParallelCatchup:
         self.python = python
         self.report: Optional[dict] = None
         self._final_dir: Optional[str] = None
+        self._target: Optional[int] = None
 
     # -- worker command ----------------------------------------------------
     def _range_dir(self, index: int) -> str:
         return os.path.join(self.workdir, f"range-{index:02d}")
+
+    def _ctl_dir(self, index: int) -> str:
+        # OUTSIDE the range dir: RangeWork wipes the range dir on retry,
+        # and an accepted steal limit must survive the wipe or the fresh
+        # attempt would replay past the split boundary and tear the seam
+        return os.path.join(self.workdir, f"ctl-{index:02d}")
 
     def _worker_cmdline(self, spec: RangeSpec) -> str:
         d = self._range_dir(spec.index)
@@ -396,11 +585,21 @@ class ParallelCatchup:
                 "--workdir", d,
                 "--result", os.path.join(d, "result.json")]
         args += ["--index", str(spec.index)]
-        if spec.index == len(self._specs) - 1:
-            args.append("--persist")
+        # whichever worker ends at the catchup target owns the adoptable
+        # state (under stealing that may be a thief, not the planned last
+        # range) — every worker gets the target and self-selects
+        if self._target is not None:
+            args += ["--persist-target", str(self._target)]
+        # the control dir rides along even with stealing off: the
+        # progress heartbeat is the orchestrator's visibility into a
+        # straggling range (and the throttle seam must behave identically
+        # in steal-on vs steal-off comparisons)
+        args += ["--ctl-dir", self._ctl_dir(spec.index)]
         if self.accel:
             args += ["--accel", "tpu", "--accel-chunk",
                      str(self.accel_chunk)]
+        if self.accel_profile is not None:
+            args += ["--accel-profile", self.accel_profile]
         if self.native is not None:
             args += ["--native", "on" if self.native else "off"]
         for pattern in self.invariant_checks:
@@ -416,9 +615,121 @@ class ParallelCatchup:
             # worker process or its range plan/seam math disagrees with ours
             args += ["--checkpoint-frequency", str(checkpoint_frequency())]
         # ProcessManager runs shell-less (shlex.split + Popen), so the
-        # assignment travels through `env`
-        args = ["env", f"PYTHONPATH={pythonpath}"] + args
+        # assignments travel through `env`: PYTHONPATH, the per-worker
+        # device pin (mesh), and any per-range test env
+        env_pairs = {"PYTHONPATH": pythonpath}
+        if self.mesh_devices > 0:
+            from ..accel import mesh as _mesh
+            env_pairs.update(_mesh.worker_device_env(
+                spec.index % self.mesh_devices, self.mesh_devices,
+                self.mesh_platform))
+        env_pairs.update(self.extra_env.get(spec.index, {}))
+        args = ["env"] + [f"{k}={v}" for k, v in env_pairs.items()] + args
         return " ".join(shlex.quote(a) for a in args)
+
+    # -- work stealing -----------------------------------------------------
+    def _make_work(self, pm: ProcessManager, spec: RangeSpec) -> RangeWork:
+        d = self._range_dir(spec.index)
+        os.makedirs(d, exist_ok=True)
+        # a reused workdir may hold a PREVIOUS run's steal artifacts
+        # (limit/ack from an interrupted catchup): a worker honoring a
+        # stale limit would truncate with no thief to cover the tail.
+        # Control state is strictly per-run; only the RETRY path within a
+        # run must preserve it (RangeWork wipes the range dir, not this).
+        shutil.rmtree(self._ctl_dir(spec.index), ignore_errors=True)
+        return RangeWork(
+            self.clock, pm, self._worker_cmdline(spec),
+            os.path.join(d, "result.json"), spec,
+            log_path=os.path.join(d, "worker.log"),
+            workdir=d,
+            max_retries=self.max_retries)
+
+    def _read_ctl(self, index: int, name: str) -> Optional[dict]:
+        return _read_json(os.path.join(self._ctl_dir(index), name))
+
+    def _progress_of(self, w: RangeWork) -> int:
+        """The victim candidate's last heartbeat LCL (its seed when no
+        checkpoint has completed yet — stealable from the start)."""
+        doc = self._read_ctl(w.spec.index, RangeControl.PROGRESS)
+        if doc is not None and isinstance(doc.get("lcl"), int):
+            return max(doc["lcl"], w.spec.seed_checkpoint or 1)
+        return w.spec.seed_checkpoint or 1
+
+    def _spawn_thief(self, pm, works, victim: RangeWork,
+                     boundary: int) -> None:
+        """The accepted half of the handshake: the victim WILL stop at
+        `boundary`; seed a thief there covering the abandoned tail."""
+        spec = RangeSpec(index=self._next_index,
+                         seed_checkpoint=boundary,
+                         replay_to=self._expected_to[victim])
+        self._next_index += 1
+        self._expected_to[victim] = boundary
+        thief = self._make_work(pm, spec)
+        self._expected_to[thief] = spec.replay_to
+        works.append(thief)
+        thief.start()
+        adopted = remaining_checkpoint_units(boundary, spec.replay_to)
+        self._steal_events.append({
+            "victim": victim.spec.index, "thief": spec.index,
+            "boundary": boundary, "checkpoints_adopted": adopted})
+        _registry().counter("catchup.parallel.steal").inc()
+        eventlog.record("History", "INFO", "checkpoint steal",
+                        victim=victim.spec.index, thief=spec.index,
+                        boundary=boundary, adopted=adopted)
+        log.info("work steal: range %d adopts %d checkpoint(s) of range "
+                 "%d past boundary %d", spec.index, adopted,
+                 victim.spec.index, boundary)
+
+    def _maybe_steal(self, pm, works: List[RangeWork]) -> None:
+        """One crank of the steal state machine: settle the outstanding
+        negotiation (spawn the thief on an accept), then — with spare
+        worker capacity and no negotiation in flight — pick the slowest
+        running range and write it a limit at the plan_steal boundary."""
+        # settle the in-flight negotiation first (at most one at a time:
+        # seams are serialized so the stitch chain stays a chain)
+        if self._negotiation is not None:
+            victim, boundary = self._negotiation
+            ack = self._read_ctl(victim.spec.index, RangeControl.ACK)
+            if ack is not None and ack.get("accepted") == boundary:
+                self._negotiation = None
+                self._spawn_thief(pm, works, victim, boundary)
+            elif ack is not None:
+                self._negotiation = None   # progress won the race
+            elif victim.done:
+                self._negotiation = None
+                if victim.succeeded and victim.result is not None \
+                        and victim.result["final_ledger_seq"] == boundary:
+                    # it honored the limit but the ack read raced its exit
+                    self._spawn_thief(pm, works, victim, boundary)
+            return
+        active = [w for w in works if not w.done]
+        if not active or len(active) >= self.workers \
+                or not any(w.done and w.succeeded for w in works):
+            return
+        candidates = []
+        for w in active:
+            if w in self._victimized:
+                continue
+            progress = self._progress_of(w)
+            units = remaining_checkpoint_units(progress,
+                                               self._expected_to[w])
+            if units >= self.steal_min_checkpoints:
+                candidates.append((units, progress, w))
+        if not candidates:
+            return
+        units, progress, victim = max(candidates, key=lambda c: c[0])
+        boundary = plan_steal(progress, self._expected_to[victim])
+        if boundary is None:
+            return
+        ctl = self._ctl_dir(victim.spec.index)
+        os.makedirs(ctl, exist_ok=True)
+        _write_json_atomic(os.path.join(ctl, RangeControl.LIMIT),
+                           {"replay_to": boundary})
+        self._victimized.add(victim)
+        self._negotiation = (victim, boundary)
+        eventlog.record("History", "INFO", "steal limit offered",
+                        victim=victim.spec.index, boundary=boundary,
+                        remaining_units=units)
 
     # -- driving -----------------------------------------------------------
     def run(self, target: Optional[int] = None) -> dict:
@@ -428,31 +739,45 @@ class ParallelCatchup:
             raise CatchupError("archive has no HAS")
         if target is None:
             target = has.current_ledger
+        self._target = target
         self._specs = plan_parallel_ranges(target, self.workers)
         if len(self._specs) == 1:
             log.info("parallel catchup degenerates to a single range "
                      "(target %d)", target)
         pm = ProcessManager(self.clock, max_concurrent=self.workers)
-        works: List[RangeWork] = []
-        for spec in self._specs:
-            d = self._range_dir(spec.index)
-            os.makedirs(d, exist_ok=True)
-            works.append(RangeWork(
-                self.clock, pm, self._worker_cmdline(spec),
-                os.path.join(d, "result.json"), spec,
-                log_path=os.path.join(d, "worker.log"),
-                workdir=d,
-                max_retries=self.max_retries))
+        works: List[RangeWork] = [self._make_work(pm, spec)
+                                  for spec in self._specs]
+        # steal bookkeeping: each work's CURRENT end (shrinks when stolen
+        # from), the outstanding limit negotiation, spawned thieves
+        self._expected_to = {w: w.spec.replay_to for w in works}
+        self._victimized: set = set()
+        self._negotiation = None
+        self._steal_events: List[dict] = []
+        self._next_index = len(self._specs)
         inflight = _registry().gauge("catchup.parallel.ranges-inflight")
         inflight.set_source(lambda: sum(1 for w in works if not w.done))
         eventlog.record("History", "INFO", "parallel catchup started",
                         target=target, ranges=len(self._specs),
-                        workers=self.workers)
+                        workers=self.workers, steal=self.steal)
         t0 = _time.perf_counter()
+        last_steal_check = 0.0
         for w in works:
             w.start()
         try:
-            while not all(w.done for w in works):
+            while True:
+                if self.steal and len(works) > 1:
+                    now = _time.perf_counter()
+                    # an outstanding negotiation is settled EVERY
+                    # iteration: a victim that accepts and exits right
+                    # before the run drains must still get its thief
+                    # spawned, or the stolen tail is replayed by nobody
+                    if self._negotiation is not None \
+                            or now - last_steal_check >= 0.1:
+                        last_steal_check = now
+                        self._maybe_steal(pm, works)
+                if all(w.done for w in works) \
+                        and self._negotiation is None:
+                    break
                 if self.clock.crank() == 0:
                     # REAL_TIME + subprocesses still running: yield the
                     # host instead of spinning the poll pump
@@ -476,43 +801,63 @@ class ParallelCatchup:
                 f"parallel catchup range failure: {detail}",
                 crash_dir=self.crash_dir)
             raise CatchupError(detail)
-        results = [w.result for w in works]
+        # chain order by seed: steals splice thieves into the middle of
+        # the plan, and verify_stitches proves consecutive seams
+        works_by_seed = sorted(
+            works, key=lambda w: (w.result["seed_checkpoint"]
+                                  if w.result["seed_checkpoint"] is not None
+                                  else -1))
+        results = [w.result for w in works_by_seed]
         stitches = verify_stitches(results, crash_dir=self.crash_dir)
         final = results[-1]
         if final["final_ledger_seq"] != target:
             raise CatchupError(
                 f"parallel catchup ended at {final['final_ledger_seq']}, "
                 f"target {target}")
-        self._final_dir = self._range_dir(self._specs[-1].index)
-        self._gc_range_dirs()
+        if not final.get("persisted"):
+            raise CatchupError(
+                f"range {final['index']} reached the target but did not "
+                "persist its state")
+        self._final_dir = self._range_dir(final["index"])
+        self._gc_range_dirs(keep_index=final["index"])
         total = sum(r["ledgers_replayed"] for r in results)
         self.report = {
             "target": target,
             "workers": self.workers,
             "ranges": results,
             "stitches_verified": stitches,
+            "steals": len(self._steal_events),
+            "steal_events": self._steal_events,
             "final_ledger_seq": final["final_ledger_seq"],
             "final_hash": final["final_hash"],
             "ledgers_replayed": total,
             "wall_s": round(wall, 3),
             "ledgers_per_s": round(total / wall, 1) if wall > 0 else 0.0,
         }
+        if self.mesh_devices:
+            self.report["mesh_devices"] = self.mesh_devices
+            self.report["device_assignments"] = {
+                r["index"]: r.get("device_index") for r in results}
         eventlog.record("History", "INFO", "parallel catchup finished",
                         target=target, stitches=stitches,
+                        steals=len(self._steal_events),
                         wall_s=round(wall, 1))
         log.info("parallel catchup: %d ledgers over %d ranges in %.1fs "
-                 "(%.0f ledgers/s), %d stitches verified", total,
-                 len(results), wall, self.report["ledgers_per_s"], stitches)
+                 "(%.0f ledgers/s), %d stitches verified, %d steal(s)",
+                 total, len(results), wall, self.report["ledgers_per_s"],
+                 stitches, len(self._steal_events))
         return self.report
 
-    def _gc_range_dirs(self) -> None:
+    def _gc_range_dirs(self, keep_index: int) -> None:
         """Interior ranges' state was only ever evidence for the stitch
-        proof; reclaim the disk (the final range's dir holds the adopted
-        ledger and survives)."""
+        proof; reclaim the disk (the dir holding the ledger that reached
+        the target survives for adoption)."""
         if self.keep_range_dirs:
             return
-        for spec in self._specs[:-1]:
-            shutil.rmtree(self._range_dir(spec.index), ignore_errors=True)
+        for i in range(self._next_index):
+            shutil.rmtree(self._ctl_dir(i), ignore_errors=True)
+            if i != keep_index:
+                shutil.rmtree(self._range_dir(i), ignore_errors=True)
 
     # -- adoption ----------------------------------------------------------
     def load_manager(self, bucket_store=None,

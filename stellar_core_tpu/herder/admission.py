@@ -156,8 +156,14 @@ class AdmissionPipeline:
         self._warmed = False
         if accel:
             from ..catchup.catchup import PreverifyPipeline
+            # EXPLICIT race profile: unlike catchup replay (which can fall
+            # back to verifying during the apply), admission must hold the
+            # batch's verdicts in hand to answer each submitter — the
+            # bounded race-wait is the right contract here even though
+            # catchup's default moved to the never-wait poll profile
             self._preverify = PreverifyPipeline(
-                lm.network_id, chunk_size=accel_chunk, stats=self.stats)
+                lm.network_id, chunk_size=accel_chunk, stats=self.stats,
+                profile=PreverifyPipeline.PROFILE_RACE)
             self._dispatch_warmup()
 
         _registry().weak_gauge("herder.admission.depth", self,

@@ -395,7 +395,9 @@ class CatchupWork(Work):
                  stats: Optional[dict] = None, coalesce: int = 4,
                  accel_hot_threshold: int = 1 << 62,
                  decode_txs: bool = True, keep_raw: bool = False,
-                 verdict_sink=None, pair_extractor=None):
+                 verdict_sink=None, pair_extractor=None,
+                 accel_profile: Optional[str] = None,
+                 checkpoint_hook=None):
         super().__init__(clock, "catchup", max_retries=RETRY_NEVER)
         self.mgr = mgr
         self.archive = archive
@@ -407,17 +409,32 @@ class CatchupWork(Work):
         self.verdict_sink = verdict_sink
         self.accel_chunk = accel_chunk
         self.coalesce = max(1, coalesce)
-        # the download window must run ahead of the dispatch groups for
-        # coalescing to ever trigger
-        self.lookahead = max(1, lookahead,
-                             2 * self.coalesce if accel else 0)
-        self.stats = stats if stats is not None else {}
+        # after every applied checkpoint: checkpoint_hook(lcl) may return
+        # a LOWER published boundary to truncate the target mid-replay —
+        # the work-stealing seam (a range worker that accepted a steal
+        # limit stops at the split boundary; catchup/parallel.py)
+        self.checkpoint_hook = checkpoint_hook
         self.pipeline = (PreverifyPipeline(network_id, accel_chunk,
-                                           self.stats,
+                                           stats if stats is not None
+                                           else {},
                                            hot_threshold=accel_hot_threshold,
                                            verdict_sink=verdict_sink,
-                                           pair_extractor=pair_extractor)
+                                           pair_extractor=pair_extractor,
+                                           profile=accel_profile)
                          if accel else None)
+        # poll/sig-only profiles auto-tune the coalesce depth against the
+        # measured consumer rate (PreverifyPipeline.recommended_coalesce)
+        self.auto_coalesce = (self.pipeline is not None
+                              and self.pipeline.profile
+                              != PreverifyPipeline.PROFILE_RACE)
+        # the download window must run ahead of the dispatch groups for
+        # coalescing to ever trigger (sized for the auto-tune's ceiling)
+        max_coalesce = (PreverifyPipeline.MAX_COALESCE if self.auto_coalesce
+                        else self.coalesce)
+        self.lookahead = max(1, lookahead,
+                             2 * max_coalesce if accel else 0)
+        self.stats = self.pipeline.stats if self.pipeline is not None \
+            else (stats if stats is not None else {})
         self._downloads: Dict[int, GetAndVerifyCheckpointWork] = {}
         self._apply: Optional[ApplyCheckpointWork] = None
         self._apply_checkpoint = 0
@@ -495,6 +512,8 @@ class CatchupWork(Work):
         if self.mgr.last_closed_ledger_seq >= self.target:
             self._close_pipeline()
             return State.SUCCESS
+        if self.auto_coalesce:
+            self.coalesce = self.pipeline.recommended_coalesce(self.coalesce)
         # keep the download window full (never past the target checkpoint)
         cp = self._apply_checkpoint
         last_cp = checkpoint_containing(self.target)
@@ -545,6 +564,18 @@ class CatchupWork(Work):
         del self._downloads[cp]
         self._apply = None
         self._apply_checkpoint = cp + checkpoint_frequency()
+        if self.checkpoint_hook is not None:
+            # work-stealing seam: the hook reports progress and may hand
+            # back a lower published boundary (>= the LCL we just reached)
+            # that truncates this replay — the stolen tail is someone
+            # else's range now
+            new_target = self.checkpoint_hook(self.mgr.last_closed_ledger_seq)
+            if new_target is not None \
+                    and self.mgr.last_closed_ledger_seq <= new_target \
+                    < self.target:
+                log.info("catchup target truncated %d -> %d (checkpoint "
+                         "hook)", self.target, new_target)
+                self.target = new_target
         if self.mgr.last_closed_ledger_seq >= self.target:
             self._close_pipeline()
             return State.SUCCESS

@@ -148,7 +148,8 @@ def cmd_catchup(args) -> int:
                         invariant_manager=inv,
                         bucket_store=store,
                         entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE,
-                        resident_levels=cfg.BUCKET_RESIDENT_LEVELS)
+                        resident_levels=cfg.BUCKET_RESIDENT_LEVELS,
+                        accel_profile=cfg.ACCEL_OFFLOAD_PROFILE or None)
     err, at = _resolve_catchup_target(args)
     if err:
         print(err, file=sys.stderr)
@@ -195,6 +196,8 @@ def _cmd_catchup_parallel(args, cfg, archive_spec: str, workers: int) -> int:
     if err:
         print(err, file=sys.stderr)
         return 1
+    mesh_devices = (args.mesh_devices if args.mesh_devices >= 0
+                    else cfg.CATCHUP_MESH_DEVICES)
     pc = ParallelCatchup(archive_spec, cfg.NETWORK_PASSPHRASE,
                          workers=workers,
                          accel=cfg.ACCEL == "tpu",
@@ -202,7 +205,11 @@ def _cmd_catchup_parallel(args, cfg, archive_spec: str, workers: int) -> int:
                          invariant_checks=cfg.INVARIANT_CHECKS,
                          in_memory=cfg.IN_MEMORY_LEDGER,
                          entry_cache_size=cfg.BUCKETLISTDB_ENTRY_CACHE_SIZE,
-                         resident_levels=cfg.BUCKET_RESIDENT_LEVELS)
+                         resident_levels=cfg.BUCKET_RESIDENT_LEVELS,
+                         steal=(cfg.CATCHUP_WORK_STEALING
+                                and not args.no_steal),
+                         mesh_devices=mesh_devices,
+                         accel_profile=cfg.ACCEL_OFFLOAD_PROFILE or None)
     try:
         report = pc.run(target=target)
     except CatchupError as e:
@@ -269,7 +276,11 @@ def cmd_catchup_range(args) -> int:
             entry_cache_size=args.entry_cache_size or None,
             resident_levels=(args.resident_levels
                              if args.resident_levels >= 0 else None),
-            persist_dir=args.workdir if args.persist else None)
+            persist_dir=(args.workdir
+                         if args.persist or args.persist_target else None),
+            persist_target=args.persist_target or None,
+            ctl_dir=args.ctl_dir or None,
+            accel_profile=args.accel_profile or None)
     except (CatchupError, RuntimeError, ValueError, OSError) as e:
         write({"index": spec.index, "error": str(e)})
         print(f"range {spec.index} FAILED: {e}", file=sys.stderr)
@@ -716,6 +727,7 @@ def cmd_fleet(args) -> int:
     report = run_fleet_soak(
         workdir, n_nodes=args.nodes, schedule=schedule,
         traffic_rate=args.traffic, n_accounts=args.accounts, slos=slos,
+        native_close_differential=args.native_differential,
         timeout_s=args.timeout)
     print(json.dumps(report, indent=1))
     return 0 if report["passed"] else 2
@@ -759,6 +771,13 @@ def main(argv=None) -> int:
                    help="replay as N concurrent checkpoint ranges stitched "
                         "by assume-state (0 = config "
                         "CATCHUP_PARALLEL_WORKERS)")
+    s.add_argument("--mesh-devices", type=int, default=-1, metavar="D",
+                   help="pin range workers round-robin to D accelerator "
+                        "devices via per-worker visible-device env "
+                        "(-1 = config CATCHUP_MESH_DEVICES; 0 = off)")
+    s.add_argument("--no-steal", action="store_true",
+                   help="disable checkpoint-granular work stealing "
+                        "between range workers")
     s.set_defaults(fn=cmd_catchup)
 
     s = sub.add_parser("catchup-range",
@@ -779,8 +798,21 @@ def main(argv=None) -> int:
     s.add_argument("--index", type=int, default=0)
     s.add_argument("--persist", action="store_true",
                    help="durably persist the final state into --workdir")
+    s.add_argument("--persist-target", type=int, default=0,
+                   help="persist only when the replay actually ends at "
+                        "this ledger (work stealing: whichever worker "
+                        "reaches the catchup target owns the adoptable "
+                        "state)")
+    s.add_argument("--ctl-dir", default="",
+                   help="control dir for progress heartbeats + steal "
+                        "limit/ack handshake (survives retry wipes of "
+                        "--workdir)")
     s.add_argument("--accel", choices=["tpu", "none"], default="none")
     s.add_argument("--accel-chunk", type=int, default=8192)
+    s.add_argument("--accel-profile",
+                   choices=["poll", "race", "sig-only"], default="",
+                   help="preverify offload profile (default: poll — the "
+                        "device is never waited on)")
     s.add_argument("--native", choices=["auto", "on", "off"],
                    default="auto")
     s.add_argument("--invariant", action="append", default=[],
@@ -926,6 +958,11 @@ def main(argv=None) -> int:
                    help="hard wall-clock bound for the schedule")
     s.add_argument("--max-retracking-s", type=float, default=None,
                    help="SLO: kill -> tracking-again budget")
+    s.add_argument("--native-differential", type=int, default=8,
+                   help="NATIVE_CLOSE_DIFFERENTIAL cadence provisioned "
+                        "into every node: each Nth live close is "
+                        "spot-checked against the Python oracle "
+                        "(0 = off)")
     s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser("test", help="run the test suite (pytest)")

@@ -77,6 +77,12 @@ class Config:
 
     ACCEL: str = "none"                      # "tpu" routes batch crypto
     ACCEL_CHUNK_SIZE: int = 8192
+    # Preverify offload profile (catchup.PreverifyPipeline): "poll" (the
+    # default — collect never waits on the device; a miss degrades to
+    # on-demand CPU verification, so the accelerator can only ever ADD
+    # throughput), "race" (the legacy bounded wait) or "sig-only" (poll
+    # that never self-disables).  "" = the pipeline default.
+    ACCEL_OFFLOAD_PROFILE: str = "poll"
     # Native live close (ledger/native_close.py): "auto" routes
     # LedgerManager.close through the C apply engine when the extension
     # is built, the root is in-memory and no invariants are configured;
@@ -95,6 +101,16 @@ class Config:
     # stitch (final hash == next seed header hash) is proven before the
     # node adopts the last range's state.  1 = classic single stream.
     CATCHUP_PARALLEL_WORKERS: int = 1
+    # Device-per-range mesh (catchup/parallel.py + accel/mesh.py): > 0
+    # pins each range worker to one accelerator device round-robin via
+    # per-worker visible-device env, so N ranges × N devices multiply
+    # instead of contending for chip 0.  0 = no pinning.
+    CATCHUP_MESH_DEVICES: int = 0
+    # Checkpoint-granular work stealing: a finished range worker re-seeds
+    # via assume-state at a later boundary and adopts half the slowest
+    # range's remaining checkpoints (the stitch proof covers the dynamic
+    # seam).  false = static ranges only.
+    CATCHUP_WORK_STEALING: bool = True
     # Batched admission (herder/admission.py): /tx + overlay TRANSACTION
     # intake accumulates into accel-sized verification batches with
     # back-pressure wired to overlay flow control and surge pricing.
@@ -175,7 +191,9 @@ class Config:
             "INVARIANT_CHECKS", "ACCEL",
             "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
             "METADATA_OUTPUT_STREAM",
-            "ACCEL_CHUNK_SIZE", "CATCHUP_PARALLEL_WORKERS",
+            "ACCEL_CHUNK_SIZE", "ACCEL_OFFLOAD_PROFILE",
+            "CATCHUP_PARALLEL_WORKERS", "CATCHUP_MESH_DEVICES",
+            "CATCHUP_WORK_STEALING",
             "CHECKPOINT_FREQUENCY",
             "NATIVE_CLOSE", "NATIVE_CLOSE_DIFFERENTIAL",
             "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",

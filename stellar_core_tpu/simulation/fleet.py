@@ -667,6 +667,7 @@ class Fleet:
                  threshold: Optional[int] = None,
                  n_accounts: int = 60,
                  slos: Optional[FleetSLOs] = None,
+                 native_close_differential: int = 8,
                  python: str = sys.executable):
         self.workdir = os.path.abspath(workdir)
         self.n_nodes = n_nodes
@@ -676,6 +677,12 @@ class Fleet:
         # simple majority: any two quorums intersect (t + t > n) while a
         # minority partition side stalls instead of forking
         self.threshold = threshold or (n_nodes // 2 + 1)
+        # every soak carries native-live-close differential spot-checks
+        # (ROADMAP 1c): each node's Nth close also runs the Python oracle
+        # on a scratch copy and fail-stops with a crash bundle on any
+        # divergence — a silent C-engine regression cannot survive a soak.
+        # 0 disables (pure-Python closes keep the cadence key harmless).
+        self.native_close_differential = max(0, native_close_differential)
         self.archive_dir = os.path.join(self.workdir, "archive")
         self.crash_dir = os.path.join(self.workdir, "crash-bundles")
         self.clock = VirtualClock(ClockMode.REAL_TIME)
@@ -740,6 +747,9 @@ class Fleet:
                 "BUCKET_DIR_PATH": node.bucket_dir,
                 "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING": True,
                 "CHECKPOINT_FREQUENCY": self.checkpoint_frequency,
+                # live-close differential cadence rides in every node
+                # config, so every soak spot-checks the native engine
+                "NATIVE_CLOSE_DIFFERENTIAL": self.native_close_differential,
                 "LOG_LEVEL": "INFO",
                 "QUORUM_SET": {"THRESHOLD": self.threshold,
                                "VALIDATORS": validators},
@@ -1033,10 +1043,36 @@ class Fleet:
                 worst = max(worst or 0.0, row["p99_s"])
         return worst
 
+    def native_close_counters(self) -> Dict[str, int]:
+        """Fleet-wide native live-close evidence from /metrics: closes
+        driven by the C engine, differential spot-checks actually run
+        (the NATIVE_CLOSE_DIFFERENTIAL cadence provisioned into every
+        node), and per-close Python fallbacks.  A divergence never shows
+        up here — the node fail-stops with a crash bundle and the soak's
+        crash-dir/violation machinery reports it."""
+        out = {"native_closes": 0, "native_differential_checks": 0,
+               "native_fallbacks": 0}
+        for node in self.live_nodes():
+            doc = node.http_json("/metrics", timeout=5.0)
+            if not doc:
+                continue
+            reg = doc.get("metrics", {}).get("registry", {})
+            for key, name in (("native_closes", "ledger.native.closes"),
+                              ("native_differential_checks",
+                               "ledger.native.differential-checks"),
+                              ("native_fallbacks",
+                               "ledger.native.fallbacks")):
+                row = reg.get(name)
+                if row and isinstance(row.get("count"), int):
+                    out[key] += row["count"]
+        return out
+
     def finalize(self) -> dict:
         compared = self.check_divergence()
         slo = self.slos
         p99 = self.p99_close_s()
+        if self.native_close_differential:
+            self.metrics.update(self.native_close_counters())
         shed = self.client.shed_rate()
         if slo.max_p99_close_s is not None and p99 is not None \
                 and p99 > slo.max_p99_close_s:
@@ -1102,16 +1138,20 @@ def run_fleet_soak(workdir: str, n_nodes: int = 5,
                    traffic_rate: float = 25.0,
                    n_accounts: int = 60,
                    slos: Optional[FleetSLOs] = None,
+                   native_close_differential: int = 8,
                    timeout_s: float = 600.0) -> dict:
     """Provision, boot, fund, run the schedule, tear down.  Returns the
-    fleet report (never leaks processes — teardown escalates)."""
+    fleet report (never leaks processes — teardown escalates).  Every
+    soak provisions NATIVE_CLOSE_DIFFERENTIAL into the node configs so
+    live closes carry C-vs-Python spot-checks (0 disables)."""
     if schedule is None:
         schedule = standard_schedule(n_nodes=n_nodes,
                                      traffic_rate=traffic_rate)
     # validate user input (incl. node indices) BEFORE booting anything
     parse_schedule(schedule, n_nodes=n_nodes)
     fleet = Fleet(workdir, n_nodes=n_nodes, n_accounts=n_accounts,
-                  slos=slos)
+                  slos=slos,
+                  native_close_differential=native_close_differential)
     fleet.provision()
     try:
         fleet.start()

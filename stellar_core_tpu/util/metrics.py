@@ -87,6 +87,12 @@ CANONICAL_METRICS = frozenset({
     "catchup.preverify.sigs-total",
     "catchup.preverify.sigs-shipped",
     "catchup.preverify.fallback",
+    # offload-miss watermark split (ISSUE 14): dispatched-but-late vs
+    # never-dispatched — the two causes that used to share one counter —
+    # plus groups whose verdicts ripened after their first checkpoint
+    "catchup.preverify.race-lost",
+    "catchup.preverify.not-dispatched",
+    "catchup.preverify.late-seeded",
     # native-engine checkpoint outcomes (works.py): applied in C vs
     # probe-rejected to the Python oracle
     "catchup.native.checkpoint",
@@ -96,6 +102,8 @@ CANONICAL_METRICS = frozenset({
     "catchup.parallel.range-retry",
     "catchup.parallel.range-rate",
     "catchup.parallel.stitch-verified",
+    # checkpoint-granular work stealing (ISSUE 14): accepted steals
+    "catchup.parallel.steal",
     # bucket
     "bucket.merge.time",
     "bucket.merge.stream",

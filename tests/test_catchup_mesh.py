@@ -1,0 +1,471 @@
+"""ISSUE 14: un-inverted accel replay + mesh catchup + work stealing.
+
+Covers the three tentpole layers from the outside in:
+
+* the never-wait preverify profiles (poll default / race opt-in /
+  sig-only) and the watermark accounting that splits "device lost the
+  race" from "never dispatched";
+* device-per-range mesh pinning — per-worker visible-device env threaded
+  through the subprocess cmdline, proven to actually reduce a worker's
+  JAX device count to 1 on the CPU-simulated mesh;
+* checkpoint-granular work stealing — the steal plan (fairness, boundary
+  alignment, no overlap), the limit/ack handshake, the forged-steal-seam
+  fail-stop, and the straggler-injected e2e proving stealing beats the
+  no-steal wall clock with bit-identical hashes.
+
+`make catchup-mesh` runs this file under the explicit 8-device
+CPU-simulated mesh; plain tier-1 runs it too (conftest forces the same
+mesh), so the pinning path runs in every verify, not only on-chip.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from stellar_core_tpu.accel.mesh import (ENV_DEVICE_COUNT,
+                                         ENV_DEVICE_INDEX,
+                                         assigned_device_index,
+                                         worker_device_env)
+from stellar_core_tpu.catchup.catchup import (CatchupError, CatchupManager,
+                                              PreverifyPipeline)
+from stellar_core_tpu.catchup.parallel import (ParallelCatchup, RangeControl,
+                                               RangeSpec, plan_parallel_ranges,
+                                               plan_steal,
+                                               remaining_checkpoint_units,
+                                               verify_stitches)
+from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
+                                              FileHistoryArchive)
+from stellar_core_tpu.history.manager import HistoryManager
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.simulation.loadgen import LoadGenerator
+from stellar_core_tpu.testutils import network_id
+from stellar_core_tpu.util.metrics import registry
+
+PASSPHRASE = "mesh catchup test network"
+NID = network_id(PASSPHRASE)
+
+
+@pytest.fixture(scope="module")
+def published(tmp_path_factory):
+    """A 6-checkpoint archive with payment traffic in every checkpoint —
+    enough checkpoints that a 2-worker plan leaves a stealable tail."""
+    archive_dir = tmp_path_factory.mktemp("mesh-archive")
+    mgr = LedgerManager(NID)
+    mgr.start_new_ledger()
+    archive = FileHistoryArchive(str(archive_dir))
+    history = HistoryManager(mgr, PASSPHRASE, [archive])
+    gen = LoadGenerator(mgr, history, seed=23)
+    gen.create_accounts(12, per_ledger=6)
+    gen.run_checkpoints(6, txs_per_ledger=2)
+    assert len(history.published_checkpoints) >= 6
+    return str(archive_dir), archive, mgr, history
+
+
+# ---------------------------------------------------------------------------
+# steal planning
+# ---------------------------------------------------------------------------
+
+class TestStealPlan:
+    def test_remaining_units_counts_boundaries_and_tail(self):
+        f = CHECKPOINT_FREQUENCY
+        assert remaining_checkpoint_units(1, f - 1) == 1
+        assert remaining_checkpoint_units(f - 1, 2 * f - 1) == 1
+        assert remaining_checkpoint_units(f - 1, 2 * f + 5) == 2  # + tail
+        assert remaining_checkpoint_units(100, 100) == 0
+        assert remaining_checkpoint_units(200, 100) == 0
+
+    def test_split_fairness_half_rounded_down_to_thief(self):
+        f = CHECKPOINT_FREQUENCY
+        for units in range(2, 12):
+            progress = f - 1
+            replay_to = progress + units * f
+            b = plan_steal(progress, replay_to)
+            assert b is not None
+            assert (b + 1) % f == 0, "split must sit on a boundary"
+            keep = remaining_checkpoint_units(progress, b)
+            stolen = remaining_checkpoint_units(b, replay_to)
+            assert keep + stolen == units, "no overlap, full coverage"
+            assert stolen == units // 2, "thief adopts half, rounded down"
+            assert abs(keep - stolen) <= 1, "split is fair"
+
+    def test_partial_tail_counts_as_a_unit(self):
+        f = CHECKPOINT_FREQUENCY
+        # progress at a boundary, 3 full checkpoints + a partial tail
+        progress = f - 1
+        replay_to = progress + 3 * f + 7
+        b = plan_steal(progress, replay_to)
+        assert b is not None
+        assert remaining_checkpoint_units(b, replay_to) == 2  # 4 // 2
+
+    def test_too_small_remainders_refuse(self):
+        f = CHECKPOINT_FREQUENCY
+        assert plan_steal(f - 1, 2 * f - 1) is None      # one unit
+        assert plan_steal(f - 1, f + 10) is None          # partial only
+        assert plan_steal(500, 400) is None               # nothing left
+
+    def test_victim_never_rewinds(self):
+        f = CHECKPOINT_FREQUENCY
+        progress = 5 * f - 1
+        b = plan_steal(progress, 11 * f - 1)
+        assert b is not None and b > progress
+
+
+# ---------------------------------------------------------------------------
+# the limit/ack handshake (worker side)
+# ---------------------------------------------------------------------------
+
+class TestRangeControl:
+    def _limit(self, ctl: RangeControl, boundary: int) -> None:
+        with open(os.path.join(ctl.dir, RangeControl.LIMIT), "w") as f:
+            json.dump({"replay_to": boundary}, f)
+
+    def test_heartbeat_without_limit(self, tmp_path):
+        ctl = RangeControl(str(tmp_path / "ctl"))
+        assert ctl.checkpoint_hook(127) is None
+        doc = json.load(open(os.path.join(ctl.dir, RangeControl.PROGRESS)))
+        assert doc["lcl"] == 127
+        assert not os.path.exists(os.path.join(ctl.dir, RangeControl.ACK))
+
+    def test_accept_is_sticky_and_acked(self, tmp_path):
+        ctl = RangeControl(str(tmp_path / "ctl"))
+        self._limit(ctl, 191)
+        assert ctl.checkpoint_hook(127) == 191
+        ack = json.load(open(os.path.join(ctl.dir, RangeControl.ACK)))
+        assert ack == {"accepted": 191}
+        # a second (lower) limit must NOT take effect: one steal per
+        # victim, or the already-spawned thief's seam would tear
+        self._limit(ctl, 63)
+        assert ctl.checkpoint_hook(163) == 191
+
+    def test_progress_past_limit_rejects(self, tmp_path):
+        ctl = RangeControl(str(tmp_path / "ctl"))
+        self._limit(ctl, 100)
+        assert ctl.checkpoint_hook(150) is None
+        ack = json.load(open(os.path.join(ctl.dir, RangeControl.ACK)))
+        assert ack == {"rejected": 150}
+        # rejection is sticky too (no re-ack churn per checkpoint)
+        assert ctl.checkpoint_hook(250) is None
+
+    def test_throttle_env_injects_straggler_delay(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("STPU_CATCHUP_THROTTLE_S", "0.15")
+        ctl = RangeControl(str(tmp_path / "ctl"))
+        t0 = time.perf_counter()
+        ctl.checkpoint_hook(63)
+        assert time.perf_counter() - t0 >= 0.15
+
+
+# ---------------------------------------------------------------------------
+# never-wait preverify (poll profile) + watermark accounting
+# ---------------------------------------------------------------------------
+
+class TestPollProfile:
+    def test_default_profile_is_poll(self):
+        pipe = PreverifyPipeline(NID, 256)
+        assert pipe.profile == PreverifyPipeline.PROFILE_POLL
+        pipe.close()
+
+    def test_unknown_profile_rejected(self):
+        with pytest.raises(ValueError):
+            PreverifyPipeline(NID, 256, profile="eager")
+
+    def _synthetic_group(self, pipe, release, n=2, cps=(63, 127)):
+        def job():
+            release.wait(10.0)
+            return np.ones(n, dtype=bool), 0.01
+
+        jb = pipe._submit(job)
+        group = {"job": jb,
+                 "pks": [bytes([i + 1]) * 32 for i in range(n)],
+                 "sigs": [bytes([i + 9]) * 64 for i in range(n)],
+                 "msgs": [b"m%d" % i for i in range(n)],
+                 "checkpoints": list(cps),
+                 "pairs_by_cp": {cps[0]: n, cps[1]: 1},
+                 "collected_cps": set()}
+        for cp in cps:
+            pipe._groups[cp] = group
+        pipe._live_groups.append(group)
+        return jb, group
+
+    def test_poll_collect_never_waits_then_late_seeds(self):
+        pipe = PreverifyPipeline(NID, 256)   # poll default
+        sink = []
+        pipe.verdict_sink = lambda pks, sigs, msgs, v: sink.append(len(pks))
+        release = threading.Event()
+        jb, group = self._synthetic_group(pipe, release)
+        race_lost = registry().counter("catchup.preverify.race-lost").value
+        t0 = time.perf_counter()
+        pipe.collect(63)               # device parked: must NOT block
+        assert time.perf_counter() - t0 < 0.5
+        assert pipe.stats.get("sigs_race_lost") == 2
+        assert pipe.stats.get("collect_race_misses") == 1
+        assert registry().counter("catchup.preverify.race-lost").value \
+            - race_lost == 2
+        assert not sink and not pipe.stats.get("sigs_shipped")
+        # the group ripens; the NEXT collect harvests and seeds it —
+        # checkpoint 63's sigs count as late (its apply already ran)
+        release.set()
+        assert jb[1].wait(5.0)
+        pipe.collect(127)
+        assert pipe.stats.get("sigs_shipped") == 2
+        assert sink == [2]
+        assert pipe.stats.get("sigs_late_seeded") == 2
+        assert not pipe._disabled
+        pipe.close()
+
+    def test_poll_disables_after_sustained_silence_but_sig_only_never(self):
+        for profile, expect_disabled in (("poll", True), ("sig-only", False)):
+            pipe = PreverifyPipeline(NID, 256, profile=profile)
+            pipe._harvested_once = True   # past the compile-grace window
+            release = threading.Event()
+            n_groups = PreverifyPipeline.MAX_POLL_MISS_COLLECTS + 2
+            for i in range(n_groups):
+                cp = 63 + 64 * i
+                self._synthetic_group(pipe, release, cps=(cp, cp + 32))
+                pipe.collect(cp)
+            assert pipe._disabled is expect_disabled, profile
+            release.set()
+            pipe.close()
+
+    def test_disabled_dispatch_counts_not_dispatched(self):
+        pipe = PreverifyPipeline(NID, 256)
+        pipe._disabled = True
+
+        class F:
+            signatures = [object(), object(), object()]
+
+        before = registry().counter("catchup.preverify.not-dispatched").value
+        pipe.dispatch({63: [F()]})
+        assert pipe.dispatched(63)
+        pipe.collect(63)   # no-op, no wait, no crash
+        assert pipe.stats.get("sigs_total") == 3
+        assert pipe.stats.get("sigs_not_dispatched") == 3
+        assert registry().counter(
+            "catchup.preverify.not-dispatched").value - before == 3
+        pipe.close()
+
+    def test_recommended_coalesce_tracks_consumer_rate(self):
+        pipe = PreverifyPipeline(NID, 256)
+        # no measurements yet: identity
+        assert pipe.recommended_coalesce(4) == 4
+        # device behind the consumer: grow toward the ceiling
+        pipe._apply_s_per_cp = 0.1
+        pipe._device_s_per_pair = 0.01
+        pipe._pairs_per_cp = 100.0     # 1.0s of device work per cp
+        assert pipe.recommended_coalesce(4) == 8
+        assert pipe.recommended_coalesce(8) == 8   # clamped
+        # device comfortably ahead: shrink for freshness
+        pipe._device_s_per_pair = 0.0001   # 0.01s per cp vs 0.1s apply
+        assert pipe.recommended_coalesce(4) == 3
+        assert pipe.recommended_coalesce(1) == 1   # floor
+        # in between: hold
+        pipe._device_s_per_pair = 0.0008   # 0.08s per cp
+        assert pipe.recommended_coalesce(4) == 4
+        pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# device-per-range mesh pinning
+# ---------------------------------------------------------------------------
+
+class TestMeshPinning:
+    def test_env_shapes_per_platform(self):
+        cpu = worker_device_env(2, 8, "cpu")
+        assert cpu[ENV_DEVICE_INDEX] == "2"
+        assert cpu[ENV_DEVICE_COUNT] == "8"
+        assert "xla_force_host_platform_device_count=1" in cpu["XLA_FLAGS"]
+        tpu = worker_device_env(3, 8, "tpu")
+        assert tpu["TPU_VISIBLE_DEVICES"] == "3"
+        assert tpu["TPU_PROCESS_BOUNDS"] == "1,1,1"
+        gpu = worker_device_env(1, 4, "cuda")
+        assert gpu["CUDA_VISIBLE_DEVICES"] == "1"
+
+    def test_assigned_device_index_roundtrip(self, monkeypatch):
+        monkeypatch.delenv(ENV_DEVICE_INDEX, raising=False)
+        assert assigned_device_index() is None
+        monkeypatch.setenv(ENV_DEVICE_INDEX, "5")
+        assert assigned_device_index() == 5
+
+    def test_cpu_mesh_env_actually_pins_one_device(self):
+        """The make-or-break property: a subprocess under the worker env
+        sees exactly ONE device while this (conftest-meshed) process sees
+        8 — the same visible-device threading the on-chip mesh uses."""
+        jax = pytest.importorskip("jax")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device CPU mesh (conftest)")
+        env = dict(os.environ)
+        env.update(worker_device_env(1, 4, "cpu"))
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu');"
+                "print(len(jax.devices()))")
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, timeout=180)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert r.stdout.strip() == b"1", r.stdout
+
+    def test_mesh_env_threads_through_worker_cmdline(self, tmp_path):
+        pc = ParallelCatchup(str(tmp_path / "a"), PASSPHRASE, workers=2,
+                             workdir=str(tmp_path / "w"),
+                             mesh_devices=2, mesh_platform="cpu")
+        pc._specs = plan_parallel_ranges(255, 2)
+        pc._target = 255
+        cmd = pc._worker_cmdline(pc._specs[1])
+        assert f"{ENV_DEVICE_INDEX}=1" in cmd
+        assert "xla_force_host_platform_device_count=1" in cmd
+        assert "--persist-target 255" in cmd
+        assert "--ctl-dir" in cmd
+        # round-robin wraps past the device count
+        pc2 = ParallelCatchup(str(tmp_path / "a"), PASSPHRASE, workers=3,
+                              workdir=str(tmp_path / "w2"),
+                              mesh_devices=2, mesh_platform="cpu")
+        pc2._specs = plan_parallel_ranges(400, 3)
+        pc2._target = 400
+        assert f"{ENV_DEVICE_INDEX}=0" in \
+            pc2._worker_cmdline(pc2._specs[2])
+
+    def test_config_keys_roundtrip(self):
+        cfg = Config.from_dict({"CATCHUP_MESH_DEVICES": 4,
+                                "CATCHUP_WORK_STEALING": False,
+                                "ACCEL_OFFLOAD_PROFILE": "sig-only"})
+        assert cfg.CATCHUP_MESH_DEVICES == 4
+        assert cfg.CATCHUP_WORK_STEALING is False
+        assert cfg.ACCEL_OFFLOAD_PROFILE == "sig-only"
+        # defaults: stealing on, no pinning, poll profile
+        dflt = Config()
+        assert dflt.CATCHUP_WORK_STEALING is True
+        assert dflt.CATCHUP_MESH_DEVICES == 0
+        assert dflt.ACCEL_OFFLOAD_PROFILE == "poll"
+
+
+# ---------------------------------------------------------------------------
+# forged steal seam: fail-stop with crash bundle
+# ---------------------------------------------------------------------------
+
+def test_forged_steal_seam_failstops_with_bundle(tmp_path):
+    """A steal splices a thief into the chain at the split boundary; its
+    seam is proven exactly like a planned one, so a FORGED thief seed
+    header (a poisoned worker claiming a seam it never verified) must
+    kill the catchup with a crash bundle naming the boundary."""
+    victim_end = 191
+    results = [
+        {"index": 0, "seed_checkpoint": None, "seed_header_hash": None,
+         "replay_to": 255, "final_ledger_seq": victim_end,
+         "final_hash": "aa" * 32, "ledgers_replayed": 190},
+        {"index": 2, "seed_checkpoint": victim_end,   # the thief
+         "seed_header_hash": "ff" * 32,               # FORGED
+         "replay_to": 255, "final_ledger_seq": 255,
+         "final_hash": "bb" * 32, "ledgers_replayed": 64},
+    ]
+    crash_dir = tmp_path / "crash"
+    with pytest.raises(CatchupError, match=f"boundary {victim_end}"):
+        verify_stitches(results, crash_dir=str(crash_dir))
+    bundles = list(crash_dir.glob("flight-*.json"))
+    assert bundles, "forged steal seam must write a crash bundle"
+    doc = json.loads(bundles[0].read_text())
+    assert str(victim_end) in doc["reason"] and "stitch" in doc["reason"]
+
+
+# ---------------------------------------------------------------------------
+# straggler-injected e2e: stealing beats no-steal, hashes identical
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def straggler_runs(published, tmp_path_factory):
+    """Run the SAME straggler-injected catchup twice — steal off, steal
+    on — over real subprocess workers.  Range 1 (the later half of a
+    2-worker plan) sleeps per checkpoint; with 3 workers the pool has a
+    spare to become the thief."""
+    archive_dir, archive, mgr, history = published
+    base = tmp_path_factory.mktemp("straggler")
+    throttle = {1: {"STPU_CATCHUP_THROTTLE_S": "1.0"}}
+
+    def one(steal: bool, name: str) -> dict:
+        pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=2,
+                             workdir=str(base / name), steal=steal,
+                             steal_min_checkpoints=2,
+                             extra_env=throttle)
+        report = pc.run()
+        return report
+
+    no_steal = one(False, "nosteal")
+    with_steal = one(True, "steal")
+    return mgr, no_steal, with_steal
+
+
+def test_straggler_steal_beats_no_steal(straggler_runs):
+    mgr, no_steal, with_steal = straggler_runs
+    # correctness first: bit-identical final hashes, every seam proven
+    assert no_steal["final_hash"] == mgr.lcl_hash.hex()
+    assert with_steal["final_hash"] == mgr.lcl_hash.hex()
+    assert with_steal["stitches_verified"] == \
+        len(with_steal["ranges"]) - 1
+    assert no_steal["steals"] == 0
+    assert with_steal["steals"] >= 1
+    # the dynamic seam chains exactly like planned ones
+    for a, b in zip(with_steal["ranges"], with_steal["ranges"][1:]):
+        assert a["final_ledger_seq"] == b["seed_checkpoint"]
+        assert a["final_hash"] == b["seed_header_hash"]
+    # and the whole point: wall clock beats the straggler-bound run
+    assert with_steal["wall_s"] < no_steal["wall_s"], (
+        f"steal {with_steal['wall_s']}s vs no-steal {no_steal['wall_s']}s")
+
+
+def test_steal_event_record_and_truncation(straggler_runs):
+    mgr, _no_steal, with_steal = straggler_runs
+    ev = with_steal["steal_events"][0]
+    assert ev["victim"] == 1
+    assert ev["thief"] >= 2
+    assert (ev["boundary"] + 1) % CHECKPOINT_FREQUENCY == 0
+    assert ev["checkpoints_adopted"] >= 1
+    victim = next(r for r in with_steal["ranges"]
+                  if r["index"] == ev["victim"])
+    thief = next(r for r in with_steal["ranges"]
+                 if r["index"] == ev["thief"])
+    assert victim["final_ledger_seq"] == ev["boundary"]
+    assert victim["truncated_to"] == ev["boundary"]
+    assert thief["seed_checkpoint"] == ev["boundary"]
+    assert thief["final_ledger_seq"] == with_steal["target"]
+    # whoever reached the target persisted; the truncated victim did not
+    assert thief["persisted"] and not victim["persisted"]
+    assert registry().counter("catchup.parallel.steal").value >= 1
+
+
+def test_stale_ctl_dirs_from_previous_run_are_wiped(published, tmp_path):
+    """A reused workdir holding an interrupted run's steal artifacts must
+    not poison the new run: a worker honoring a stale limit would
+    truncate its range with no thief to cover the tail."""
+    archive_dir, archive, mgr, history = published
+    w = tmp_path / "w"
+    for idx, boundary in ((0, 63), (1, 255)):
+        ctl = w / f"ctl-{idx:02d}"
+        ctl.mkdir(parents=True)
+        (ctl / RangeControl.LIMIT).write_text(
+            json.dumps({"replay_to": boundary}))
+        (ctl / RangeControl.ACK).write_text(
+            json.dumps({"accepted": boundary}))
+    pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=2,
+                         workdir=str(w))
+    report = pc.run()
+    assert report["final_hash"] == mgr.lcl_hash.hex()
+    assert report["steals"] == 0
+
+
+def test_stolen_catchup_state_is_adoptable(published, tmp_path):
+    """After a steal, load_manager() must rebuild the ledger from the
+    THIEF's persisted dir (the planned-last range was the victim)."""
+    archive_dir, archive, mgr, history = published
+    pc = ParallelCatchup(archive_dir, PASSPHRASE, workers=2,
+                         workdir=str(tmp_path / "w"), steal=True,
+                         steal_min_checkpoints=2,
+                         extra_env={1: {"STPU_CATCHUP_THROTTLE_S": "0.8"}})
+    report = pc.run()
+    assert report["steals"] >= 1
+    m2 = pc.load_manager()
+    assert m2.lcl_hash == mgr.lcl_hash
+    assert m2.last_closed_ledger_seq == report["target"]

@@ -49,6 +49,21 @@ class TestProvisioning:
             assert c.HISTORY[0].put_path == fleet.archive_dir
         # genesis boot bootstraps SCP; a provisioned node starts FORCE_SCP
         assert all(c.FORCE_SCP for c in cfgs)
+        # every soak carries native-live-close differential spot-checks
+        # (ROADMAP 1c): the cadence is provisioned into every node config
+        assert all(c.NATIVE_CLOSE_DIFFERENTIAL == 8 for c in cfgs)
+
+    def test_native_differential_cadence_configurable(self, tmp_path):
+        fleet = Fleet(str(tmp_path), n_nodes=2,
+                      native_close_differential=3)
+        fleet.provision()
+        cfgs = [Config.from_toml(n.conf_path) for n in fleet.nodes]
+        assert all(c.NATIVE_CLOSE_DIFFERENTIAL == 3 for c in cfgs)
+        fleet2 = Fleet(str(tmp_path / "off"), n_nodes=2,
+                       native_close_differential=0)
+        fleet2.provision()
+        cfgs2 = [Config.from_toml(n.conf_path) for n in fleet2.nodes]
+        assert all(c.NATIVE_CLOSE_DIFFERENTIAL == 0 for c in cfgs2)
 
     def test_quorum_is_majority_and_intersecting(self, tmp_path):
         fleet = Fleet(str(tmp_path), n_nodes=5)

@@ -229,10 +229,13 @@ def test_accel_catchup_end_to_end_on_8dev_mesh(published, no_race):
 
 @pytest.fixture
 def no_race(monkeypatch):
-    """Pin the collect CPU-race budget high: tests that assert an EXACT
-    offload hit rate need every collect to wait for the (slow CPU-jax)
-    device instead of racing it."""
+    """Pin the legacy race profile with a huge collect budget: tests that
+    assert an EXACT offload hit rate need every collect to wait for the
+    (slow CPU-jax) device instead of polling past it (the ISSUE 14
+    default) or racing it."""
     from stellar_core_tpu.catchup.catchup import PreverifyPipeline
+    monkeypatch.setattr(PreverifyPipeline, "DEFAULT_PROFILE",
+                        PreverifyPipeline.PROFILE_RACE)
     monkeypatch.setattr(PreverifyPipeline, "RACE_CPU_S_PER_SIG", 10.0)
 
 
@@ -690,6 +693,9 @@ def test_collect_race_loss_degrades_to_cpu(tmp_path, monkeypatch):
             history.published_checkpoints[-1] != mgr.last_closed_ledger_seq:
         close([])
 
+    # the race profile is opt-in since ISSUE 14 (poll never waits at all)
+    monkeypatch.setattr(PreverifyPipeline, "DEFAULT_PROFILE",
+                        PreverifyPipeline.PROFILE_RACE)
     # minimal race budget (0.25s floor) + a barrier that HOLDS every
     # group after the first: those collects deterministically miss
     monkeypatch.setattr(PreverifyPipeline, "RACE_CPU_S_PER_SIG", 1e-12)

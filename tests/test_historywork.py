@@ -132,7 +132,9 @@ def test_preverify_collect_timeout_falls_back_to_cpu():
     from stellar_core_tpu.catchup.catchup import PreverifyPipeline
     from stellar_core_tpu.testutils import network_id
 
-    pipe = PreverifyPipeline(network_id("wedge net"), 256)
+    # the bounded-wait (and therefore wedge-timeout) machinery is the
+    # opt-in race profile since ISSUE 14
+    pipe = PreverifyPipeline(network_id("wedge net"), 256, profile="race")
     pipe.COLLECT_TIMEOUT_S = 0.05
 
     # genuine wedge: a REAL submitted job that blocks past the timeout —
@@ -183,7 +185,7 @@ def test_preverify_disables_after_consecutive_wedges():
     from stellar_core_tpu.catchup.catchup import PreverifyPipeline
     from stellar_core_tpu.testutils import network_id
 
-    pipe = PreverifyPipeline(network_id("dead net"), 256)
+    pipe = PreverifyPipeline(network_id("dead net"), 256, profile="race")
     pipe.COLLECT_TIMEOUT_S = 0.05
     for i, cp in enumerate((63, 127)):
         job = pipe._submit(lambda: threading.Event().wait(30.0))  # wedge
