@@ -262,6 +262,14 @@ def bench_lint():
     bl_path = os.path.join(root, "LINT_BASELINE.json")
     if os.path.exists(bl_path):
         ratchet = check_baseline(rep, load_baseline(bl_path))
+    # every registered rule appears with an explicit count (zero included)
+    # so the native-C pass (ISSUE 15) is visibly part of the gate even on
+    # a clean tree; suppressed findings are broken out per rule too
+    counts = {r.id: 0 for r in all_rules()}
+    counts.update(rep.counts_by_rule())
+    suppressed_by_rule = {}
+    for v in rep.suppressed:
+        suppressed_by_rule[v.rule] = suppressed_by_rule.get(v.rule, 0) + 1
     return {
         "lint_wall_s": round(wall, 3),
         "lint_files": rep.files_scanned,
@@ -272,8 +280,59 @@ def bench_lint():
         "lint_parse_errors": len(rep.parse_errors),
         "lint_ratchet_problems": len(ratchet),
         "lint_suppressed": len(rep.suppressed),
-        "lint_rule_counts": rep.counts_by_rule(),
+        "lint_rule_counts": counts,
+        "lint_suppressed_by_rule": suppressed_by_rule,
     }
+
+
+def bench_native_asan(time_left_fn):
+    """ASan+UBSan differential-tier wall (ISSUE 15): rebuild the C
+    engine sanitized (its own .so cache under build/asan) and run the
+    native-close differential + fuzz suites with the runtime preloaded
+    and halt_on_error=1 — the `make native-asan` tax, measured so the
+    sanitizer tier's cost trend rides every report.  Emits
+    SKIPPED(no-toolchain) rows when cc/libasan is absent (the tier
+    itself degrades identically)."""
+    import subprocess
+    from stellar_core_tpu import _native_build as nb
+    if not nb.sanitizer_available():
+        return {"native_asan_wall_s": "SKIPPED(no-toolchain)",
+                "native_asan_green": False}
+    t0 = time.perf_counter()
+    if not nb.ensure_sanitized(quiet=False):
+        return {"native_asan_wall_s": "SKIPPED(sanitized-build-failed)",
+                "native_asan_green": False}
+    build_s = time.perf_counter() - t0
+    env = nb.sanitizer_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NATIVE_CLOSE_DIFFERENTIAL"] = "1"
+    root = os.path.dirname(os.path.abspath(__file__))
+    t1 = time.perf_counter()
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join(root, "tests", "test_native_close.py"),
+             os.path.join(root, "tests", "test_capply.py"),
+             "-q", "-m", "not slow", "-p", "no:cacheprovider",
+             "-p", "no:xdist", "-p", "no:randomly"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=max(120.0, time_left_fn()))
+    except subprocess.TimeoutExpired:
+        return {"native_asan_wall_s": "SKIPPED(budget, pre-empted)",
+                "native_asan_build_s": round(build_s, 2),
+                "native_asan_green": False}
+    wall = time.perf_counter() - t1
+    ok = res.returncode == 0
+    vals = {
+        "native_asan_wall_s": round(wall, 2) if ok
+        else f"FAILED(rc={res.returncode})",
+        "native_asan_build_s": round(build_s, 2),
+        "native_asan_green": ok,
+    }
+    if not ok:
+        _stage("native-asan tier FAILED:\n" + res.stdout[-2000:]
+               + res.stderr[-2000:])
+    return vals
 
 
 def bench_racetrace(n: int = 200_000):
@@ -1474,6 +1533,18 @@ def main():
     rt_vals = bench_racetrace()
     _cache_put("racetrace", rt_vals)
     extra.update(rt_vals)
+
+    # ASan+UBSan differential tier (ISSUE 15): CPU-only subprocess run of
+    # `make native-asan`'s suite — deadline-aware and last-good cached
+    # like every section, SKIPPED(no-toolchain) where cc/libasan is absent
+    if budget_fits("native_asan", 180):
+        _stage("native ASan+UBSan differential tier...")
+        asan_vals = bench_native_asan(time_left)
+        _cache_put("native_asan", _merge_last_good("native_asan", asan_vals))
+        extra.update(asan_vals)
+    else:
+        extra["native_asan"] = "SKIPPED(budget)"
+        _stale_fill(extra, "native_asan")
 
     # BucketListDB differential runs on CPU — measure it before touching
     # the (occasionally wedged) device so the numbers exist either way

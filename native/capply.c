@@ -125,6 +125,8 @@ buf_reserve(Buf *b, int extra)
 static int
 buf_put(Buf *b, const void *data, int len)
 {
+    if (len == 0)
+        return 0;    /* empty Bufs carry p == NULL: memcpy(NULL) is UB */
     if (buf_reserve(b, len) < 0)
         return -1;
     memcpy(b->p + b->len, data, len);
@@ -630,7 +632,7 @@ signer_key_xdr(const CSigner *s, uint8_t out[104])
         return 36;
     uint32_t n = s->payload_len;
     out[36] = n >> 24; out[37] = n >> 16; out[38] = n >> 8; out[39] = n;
-    memcpy(out + 40, s->payload, n);
+    memcpy(out + 40, s->payload, n); /* corelint: disable=memcpy-provenance -- payload_len <= 64 by parse_signer_key's rd_varopaque max; 40+64 fits out[104] */
     int pad = (4 - (n & 3)) & 3;
     memset(out + 40 + n, 0, pad);
     return 40 + (int)n + pad;
@@ -1087,7 +1089,7 @@ parse_op(Rd *r, COp *op, CTx *tx)
     op->op_type = rd_i32(r);
     if (r->err)
         return -1;
-    op->body = r->p + r->off;
+    op->body = r->p + r->off; /* corelint: disable=reader-discipline -- slice handle over the region the walk below bounds-checks via its own Rd */
     /* walk the body to find its length; only supported op types are
      * walked precisely — anything else marks the tx unsupported and
      * aborts the parse (the caller falls back to Python) */
@@ -1355,7 +1357,7 @@ static int
 parse_envelope_rd(Rd *outer, const uint8_t network_id[32], CTx *tx)
 {
     memset(tx, 0, sizeof(*tx));
-    const uint8_t *env = outer->p + outer->off;
+    const uint8_t *env = outer->p + outer->off; /* corelint: disable=reader-discipline -- envelope slice re-read through a fresh bounds-checked Rd below */
     int len = outer->len - outer->off;
     tx->env = env;
     Rd r;
@@ -1954,7 +1956,7 @@ parse_scp_value(Rd *r, CHeader *h)
     int len = r->off - start;
     h->scp_value = PyMem_Malloc(len);
     if (!h->scp_value) { PyErr_NoMemory(); return -1; }
-    memcpy(h->scp_value, r->p + start, len);
+    memcpy(h->scp_value, r->p + start, len); /* corelint: disable=reader-discipline -- copy of [start, off): every byte already consumed via rd_* above */
     h->scp_len = len;
     for (int i = 0; i < h->n_upgrades; i++) {
         h->upgrades[i].p = h->scp_value + (up_offs[i] - start);
@@ -2007,7 +2009,7 @@ parse_header(Rd *r, CHeader *h)
     int ext_len = r->off - ext_start;
     h->ext = PyMem_Malloc(ext_len);
     if (!h->ext) { PyErr_NoMemory(); return -1; }
-    memcpy(h->ext, r->p + ext_start, ext_len);
+    memcpy(h->ext, r->p + ext_start, ext_len); /* corelint: disable=reader-discipline -- copy of [ext_start, off): every byte already consumed via rd_* above */
     h->ext_len = ext_len;
     return r->err ? -1 : 0;
 }
@@ -2175,6 +2177,7 @@ eng_fold_overlay(Map *upper, Map *lower)
 static int
 eng_put(Engine *e, Map *overlay, const uint8_t *key, int klen, RB *val)
 {
+    (void)e;
     RB *k = rb_new(key, klen);
     if (!k) { rb_unref(val); PyErr_NoMemory(); return -1; }
     return map_put(overlay, k, val);
@@ -2333,6 +2336,7 @@ static int
 op_create_account(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                   Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     rd_skip(&r, 4);                     /* PK type (checked at parse) */
@@ -2394,6 +2398,7 @@ op_create_account(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
 static int
 op_payment(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32], Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint32_t mt = rd_u32(&r);
@@ -2441,6 +2446,7 @@ static int
 op_set_options(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     CHeader *h = &e->header;
@@ -3156,18 +3162,25 @@ apply_tx_c(Engine *e, CTx *tx, uint64_t close_time, Buf *out)
 
 /* ---- apply order (mirror LedgerManager.apply_order) ------------------- */
 
-static void
+static int
 apply_order_c(CTx *txs, int n, int *order_out)
 {
     /* per-source queues in seq order; repeatedly pick the head with the
      * smallest content hash.  n <= MAX_TX_PER_LEDGER; simple O(n^2). */
     int *next_in_src = PyMem_Malloc(n * sizeof(int));
     int *head = PyMem_Malloc(n * sizeof(int));
+    int *src_of = PyMem_Malloc(n * sizeof(int));
+    if (!next_in_src || !head || !src_of) {
+        PyMem_Free(next_in_src);
+        PyMem_Free(head);
+        PyMem_Free(src_of);
+        PyErr_NoMemory();
+        return -1;
+    }
     int n_src = 0;
     /* build per-source chains sorted by seq (insertion into linked list) */
     for (int i = 0; i < n; i++)
         next_in_src[i] = -1;
-    int *src_of = PyMem_Malloc(n * sizeof(int));
     for (int i = 0; i < n; i++) {
         int s;
         for (s = 0; s < n_src; s++)
@@ -3211,6 +3224,7 @@ apply_order_c(CTx *txs, int n, int *order_out)
     PyMem_Free(next_in_src);
     PyMem_Free(head);
     PyMem_Free(src_of);
+    return 0;
 }
 
 /* ---- ledger close (mirror LedgerManager.close_ledger) ----------------- */
@@ -3388,8 +3402,8 @@ apply_tx_phase(Engine *e, CTx *txs, int n_txs, Buf *results)
     CHeader *h = &e->header;
     uint64_t close_time = h->close_time;
     int order[MAX_TX_PER_LEDGER];
-    if (n_txs)
-        apply_order_c(txs, n_txs, order);
+    if (n_txs && apply_order_c(txs, n_txs, order) < 0)
+        return -1;
     for (int i = 0; i < n_txs; i++)
         if (fee_phase_c(e, &txs[order[i]]) < 0)
             return -1;
@@ -3545,6 +3559,7 @@ Engine_dealloc(Engine *self)
 static PyObject *
 Engine_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
 {
+    (void)kwds;
     const uint8_t *nid;
     Py_ssize_t nid_len;
     if (!PyArg_ParseTuple(args, "y#", &nid, &nid_len))
@@ -3730,6 +3745,7 @@ bucket_stream_py(CBucket *b)
 static PyObject *
 Engine_export_state(Engine *self, PyObject *args)
 {
+    (void)args;
     if (self->poisoned) {
         /* a post-fold close failure left the store/header torn —
          * exporting it would hand the caller silently-diverged state */
@@ -3804,6 +3820,7 @@ fail:
 static PyObject *
 Engine_export_buckets(Engine *self, PyObject *args)
 {
+    (void)args;
     if (self->poisoned) {
         PyErr_SetString(CapplyError,
                         "engine poisoned by a failed close; state is "
@@ -3974,6 +3991,7 @@ Engine_apply_checkpoint(Engine *self, PyObject *args)
 static PyObject *
 Engine_lcl(Engine *self, PyObject *args)
 {
+    (void)args;
     return Py_BuildValue("(ky#)", (unsigned long)self->header.ledger_seq,
                          self->lcl_hash, (Py_ssize_t)32);
 }
@@ -4359,6 +4377,7 @@ fail:
 static PyObject *
 Engine_stats(Engine *self, PyObject *args)
 {
+    (void)args;
     return Py_BuildValue(
         "{s:K,s:K,s:K,s:K,s:K}",
         "ledgers_applied", (unsigned long long)self->ledgers_applied,
@@ -4409,6 +4428,7 @@ static PyTypeObject EngineType = {
 static PyObject *
 capply_roundtrip_account(PyObject *self, PyObject *args)
 {
+    (void)self;
     const uint8_t *p;
     Py_ssize_t len;
     if (!PyArg_ParseTuple(args, "y#", &p, &len))
@@ -4438,6 +4458,7 @@ capply_roundtrip_account(PyObject *self, PyObject *args)
 static PyObject *
 capply_scan_tx_record(PyObject *self, PyObject *args)
 {
+    (void)self;
     const uint8_t *nid, *rec;
     Py_ssize_t nid_len, rec_len;
     if (!PyArg_ParseTuple(args, "y#y#", &nid, &nid_len, &rec, &rec_len))
@@ -4481,6 +4502,7 @@ static PyMethodDef capply_methods[] = {
 static struct PyModuleDef capply_module = {
     PyModuleDef_HEAD_INIT, "_capply",
     "Native catchup-replay apply core", -1, capply_methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC
@@ -4838,6 +4860,7 @@ static int
 op_payment_credit(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                   Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint32_t mt = rd_u32(&r);
@@ -5026,6 +5049,7 @@ static int
 op_manage_data(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint32_t name_len;
@@ -5214,6 +5238,7 @@ static int
 op_bump_sequence(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                  Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     int64_t bump_to = rd_i64(&r);
@@ -5238,6 +5263,7 @@ static int
 op_account_merge(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                  Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint32_t mt = rd_u32(&r);
@@ -5305,6 +5331,7 @@ static int
 op_allow_trust(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint8_t trustor[32];
@@ -5359,6 +5386,7 @@ static int
 op_set_tl_flags(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
                 Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint8_t trustor[32];
@@ -5422,6 +5450,7 @@ op_set_tl_flags(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32],
 static int
 op_clawback(Engine *e, CTx *tx, COp *op, const uint8_t src_id[32], Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     uint32_t at = rd_u32(&r);
@@ -6498,6 +6527,7 @@ static int
 op_manage_offer(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                 Buf *rb)
 {
+    (void)tx;
     int32_t op_type = op->op_type;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -6973,6 +7003,7 @@ op_create_cb(Engine *e, CTx *tx, COp *op, int op_index,
 static int
 op_claim_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
 {
+    (void)tx;
     CHeader *h = &e->header;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -7055,6 +7086,7 @@ op_claim_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
 static int
 op_clawback_cb(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     if (rd_u32(&r) != 0 || r.err)
@@ -7175,6 +7207,7 @@ static int
 op_begin_sponsoring(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                     Buf *rb)
 {
+    (void)tx;
     Rd r;
     rd_init(&r, op->body, op->body_len);
     rd_skip(&r, 4);                           /* PK type */
@@ -7207,6 +7240,7 @@ static int
 op_end_sponsoring(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                   Buf *rb)
 {
+    (void)tx;
     (void)op;
     for (int i = 0; i < e->n_sandwich; i++) {
         if (memcmp(e->sandwich[i].sponsored, src, 32) == 0) {
@@ -7421,6 +7455,7 @@ static int
 op_revoke_sponsorship(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                       Buf *rb)
 {
+    (void)tx;
     CHeader *h = &e->header;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -7926,6 +7961,7 @@ pool_receive_c(Engine *e, const uint8_t src[32], const CAssetC *asset,
 static int
 op_pool_deposit(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
 {
+    (void)tx;
     CHeader *h = &e->header;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -8056,6 +8092,7 @@ static int
 op_pool_withdraw(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                  Buf *rb)
 {
+    (void)tx;
     CHeader *h = &e->header;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -8176,6 +8213,7 @@ static int
 apply_pool_share_ct(Engine *e, CTx *tx, COp *op, const uint8_t src[32],
                     Buf *rb)
 {
+    (void)tx;
     CHeader *h = &e->header;
     Rd r;
     rd_init(&r, op->body, op->body_len);
@@ -8697,6 +8735,7 @@ pp_debit_source(Engine *e, int32_t ot, const uint8_t src[32],
 static int
 op_path_payment(Engine *e, CTx *tx, COp *op, const uint8_t src[32], Buf *rb)
 {
+    (void)tx;
     int strict_send = op->op_type == 13;
     int32_t ot = op->op_type;
     Rd r;

@@ -427,6 +427,7 @@ build_result(int code, u128 a, u128 b, int main_scc_size,
 static PyObject *
 cquorum_check(PyObject *self, PyObject *args)
 {
+    (void)self;
     Py_buffer blob;
     PyObject *interrupt = Py_None;
     if (!PyArg_ParseTuple(args, "y*|O", &blob, &interrupt))
@@ -540,6 +541,7 @@ static PyMethodDef cquorum_methods[] = {
 static struct PyModuleDef cquorum_module = {
     PyModuleDef_HEAD_INIT, "_cquorum",
     "Native quorum-intersection enumeration core", -1, cquorum_methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC

@@ -792,6 +792,7 @@ unpack_value(PyObject *prog, Rdr *r, int depth)
 static PyObject *
 cxdr_unpack_from(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *prog, *src;
     Py_ssize_t off = 0;
     if (!PyArg_ParseTuple(args, "O!O|n", &PyTuple_Type, &prog, &src, &off))
@@ -817,6 +818,7 @@ cxdr_unpack_from(PyObject *self, PyObject *args)
 static PyObject *
 cxdr_unpack(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *prog, *src;
     if (!PyArg_ParseTuple(args, "O!O", &PyTuple_Type, &prog, &src))
         return NULL;
@@ -841,6 +843,7 @@ cxdr_unpack(PyObject *self, PyObject *args)
 static PyObject *
 cxdr_pack(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *prog, *val;
     if (!PyArg_ParseTuple(args, "O!O", &PyTuple_Type, &prog, &val))
         return NULL;
@@ -1001,6 +1004,7 @@ deep_copy_c(PyObject *val, int depth)
 static PyObject *
 cxdr_deep_copy(PyObject *self, PyObject *val)
 {
+    (void)self;
     return deep_copy_c(val, 0);
 }
 
@@ -1019,6 +1023,7 @@ static PyMethodDef cxdr_methods[] = {
 static struct PyModuleDef cxdr_module = {
     PyModuleDef_HEAD_INIT, "_cxdr",
     "Native XDR serializer (see native/cxdr.c).", -1, cxdr_methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC

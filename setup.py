@@ -9,6 +9,12 @@ quorum-intersection enumeration.
 
 from setuptools import Extension, setup
 
+# Default build is warning-clean under -Wall -Wextra (ISSUE 15) and must
+# stay that way: the lint/CI path re-compiles with -Werror
+# (`python -m stellar_core_tpu._native_build --warn-check`), so a new
+# warning fails `make lint` while end-user builds keep plain warnings.
+_CFLAGS = ["-O2", "-Wall", "-Wextra"]
+
 setup(
     name="stellar-core-tpu-native",
     version="2.0.0",
@@ -16,17 +22,17 @@ setup(
         Extension(
             "stellar_core_tpu._cxdr",
             sources=["native/cxdr.c"],
-            extra_compile_args=["-O2"],
+            extra_compile_args=_CFLAGS,
         ),
         Extension(
             "stellar_core_tpu._cquorum",
             sources=["native/cquorum.c"],
-            extra_compile_args=["-O2"],
+            extra_compile_args=_CFLAGS,
         ),
         Extension(
             "stellar_core_tpu._capply",
             sources=["native/capply.c"],
-            extra_compile_args=["-O2"],
+            extra_compile_args=_CFLAGS,
         ),
     ],
 )

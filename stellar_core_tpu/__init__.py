@@ -18,3 +18,14 @@ Layering mirrors SURVEY.md §1 (bottom → top): util/crypto/xdr → bucket/ledg
 """
 
 __version__ = "0.1.0"
+
+# Sanitizer tier (ISSUE 15): with STPU_NATIVE_SANITIZE=1 the ASan+UBSan
+# instrumented native extensions (build/asan/, see _native_build) shadow
+# the regular in-place build for THIS process — `make native-asan` runs
+# the differential + fuzz tiers through here with the runtime preloaded.
+import os as _os
+
+if _os.environ.get("STPU_NATIVE_SANITIZE") == "1":
+    from . import _native_build as _nb
+
+    _nb.activate_sanitized()

@@ -11,8 +11,10 @@ failed build degrades to slow-but-correct.
 
 import glob
 import os
+import shutil
 import subprocess
 import sys
+import sysconfig
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PKG = os.path.join(_REPO, "stellar_core_tpu")
@@ -23,6 +25,31 @@ _EXTENSIONS = {
     "_cquorum": "native/cquorum.c",
     "_capply": "native/capply.c",
 }
+
+# the default build is warning-clean under these (setup.py mirrors them);
+# --warn-check re-compiles with -Werror so the lint/CI path fail-stops on
+# any new warning while end-user builds merely warn
+_WARN_FLAGS = ["-Wall", "-Wextra"]
+
+# sanitizer build (ISSUE 15): ASan+UBSan over the whole engine.  Its .so
+# cache lives under build/asan/ — a separate cache key from the regular
+# in-place build, so the two can never shadow each other silently; the
+# sanitized modules are activated by PREPENDING build/asan to the
+# package __path__ (see activate_sanitized), which wins import priority
+# only when STPU_NATIVE_SANITIZE=1 is set.
+_SANITIZE_FLAGS = ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+                   "-g", "-O1"]
+_ASAN_DIR = os.path.join(_REPO, "build", "asan")
+_ASAN_OPTIONS = "detect_leaks=0:halt_on_error=1:abort_on_error=1"
+_UBSAN_OPTIONS = "halt_on_error=1:print_stacktrace=1"
+
+
+def _cc():
+    return os.environ.get("CC") or shutil.which("cc") or shutil.which("gcc")
+
+
+def _py_include():
+    return sysconfig.get_paths()["include"]
 
 
 def _stale():
@@ -63,6 +90,155 @@ class StaleNativeExtensionError(RuntimeError):
     tests that are supposed to validate it."""
 
 
+def _stale_sanitized():
+    out = []
+    for mod, src in _EXTENSIONS.items():
+        src_path = os.path.join(_REPO, src)
+        if not os.path.exists(src_path):
+            continue
+        so = os.path.join(_ASAN_DIR, mod + ".so")
+        if not os.path.exists(so) \
+                or os.path.getmtime(so) < os.path.getmtime(src_path):
+            out.append(mod)
+    return out
+
+
+def ensure_sanitized(quiet=True):
+    """Build the ASan+UBSan instrumented extensions under build/asan/
+    iff missing or older than their C sources.  Returns True when every
+    extension with a source is built and current; False (never raises)
+    when the compiler is missing or a compile fails — callers skip the
+    sanitizer tier cleanly, exactly like the plain-build fallback."""
+    stale = _stale_sanitized()
+    if not stale:
+        return True
+    cc = _cc()
+    if cc is None:
+        return False
+    os.makedirs(_ASAN_DIR, exist_ok=True)
+    for mod in stale:
+        src_path = os.path.join(_REPO, _EXTENSIONS[mod])
+        so = os.path.join(_ASAN_DIR, mod + ".so")
+        cmd = [cc, "-shared", "-fPIC"] + _WARN_FLAGS + _SANITIZE_FLAGS + \
+            ["-I", _py_include(), src_path, "-o", so]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+        except Exception as e:  # corelint: disable=exception-hygiene -- missing/failed sanitizer toolchain: fall back like the plain build
+            if os.path.exists(so):
+                os.unlink(so)       # a killed cc can leave a fresh-mtime
+                                    # truncated .so the cache would trust
+            if not quiet:
+                sys.stderr.write(f"sanitized build failed: {e}\n")
+            return False
+        if res.returncode != 0:
+            if not quiet:
+                sys.stderr.write(res.stdout + res.stderr)
+            if os.path.exists(so):
+                os.unlink(so)       # never leave a half-written .so
+            return False
+    return not _stale_sanitized()
+
+
+def libasan_path():
+    """Path to the compiler's dynamic ASan runtime, or None.  The
+    instrumented .so files are loaded into an UNinstrumented python, so
+    the runtime must be LD_PRELOADed into the process."""
+    cc = _cc()
+    if cc is None:
+        return None
+    try:
+        res = subprocess.run([cc, "-print-file-name=libasan.so"],
+                             capture_output=True, text=True, timeout=30)
+    except Exception:  # corelint: disable=exception-hygiene -- probe-only: absent toolchain means no sanitizer tier
+        return None
+    path = res.stdout.strip()
+    if res.returncode != 0 or not path or not os.path.isabs(path) \
+            or not os.path.exists(path):
+        return None
+    return path
+
+
+def sanitizer_available():
+    return _cc() is not None and libasan_path() is not None
+
+
+def sanitizer_env(base=None):
+    """Environment for running python with the sanitized engine active:
+    LD_PRELOAD the ASan runtime, fail-stop sanitizer options
+    (halt_on_error=1; leak checking off — CPython frees nothing at
+    exit), and STPU_NATIVE_SANITIZE=1 so the package prepends the
+    instrumented build to its import path."""
+    env = dict(os.environ if base is None else base)
+    lib = libasan_path()
+    if lib:
+        prev = env.get("LD_PRELOAD")
+        env["LD_PRELOAD"] = lib + ((" " + prev) if prev else "")
+    env.setdefault("ASAN_OPTIONS", _ASAN_OPTIONS)
+    env.setdefault("UBSAN_OPTIONS", _UBSAN_OPTIONS)
+    env["STPU_NATIVE_SANITIZE"] = "1"
+    return env
+
+
+def activate_sanitized(quiet=True):
+    """Make `from stellar_core_tpu import _capply` (and friends) resolve
+    to the instrumented build: build if stale, then prepend build/asan/
+    to the package __path__.  Called from the package __init__ when
+    STPU_NATIVE_SANITIZE=1.  Returns False (plain modules stay active)
+    when the sanitizer toolchain is unavailable."""
+    for mod in _EXTENSIONS:
+        # too late to swap an already-imported engine — fail BEFORE the
+        # (expensive) sanitized build, not after
+        if f"stellar_core_tpu.{mod}" in sys.modules:
+            raise RuntimeError(
+                f"activate_sanitized() after stellar_core_tpu.{mod} was "
+                f"already imported — set STPU_NATIVE_SANITIZE=1 in the "
+                f"process environment instead")
+    if not ensure_sanitized(quiet=quiet):
+        return False
+    import stellar_core_tpu
+    if _ASAN_DIR not in stellar_core_tpu.__path__:
+        stellar_core_tpu.__path__.insert(0, _ASAN_DIR)
+    return True
+
+
+def warn_check(werror=True, quiet=False):
+    """Compile every native source with -Wall -Wextra (-Werror when
+    `werror`) in syntax-only mode: the lint/CI gate that keeps the
+    default build warning-clean.  Returns (ok, details); ok is True
+    with a notice when no compiler is available (missing-compiler
+    fallback intact — the build itself would also have fallen back)."""
+    cc = _cc()
+    if cc is None:
+        return True, ["warn-check skipped: no C compiler on PATH"]
+    details = []
+    ok = True
+    # a REAL -O2 compile (to /dev/null), not -fsyntax-only: the
+    # optimization-dependent dataflow warnings (-Wmaybe-uninitialized,
+    # -Wstrict-aliasing) only fire when the passes that feed them run —
+    # the gate must see everything the default -O2 build would emit
+    flags = ["-c", "-O2", "-o", os.devnull] + _WARN_FLAGS \
+        + (["-Werror"] if werror else [])
+    for mod, src in _EXTENSIONS.items():
+        src_path = os.path.join(_REPO, src)
+        if not os.path.exists(src_path):
+            continue
+        try:
+            res = subprocess.run(
+                [cc] + flags + ["-I", _py_include(), src_path],
+                capture_output=True, text=True, timeout=300)
+        except Exception as e:  # corelint: disable=exception-hygiene -- wedged compiler: report as a structured FAIL, not a traceback
+            ok = False
+            details.append(f"{src}: FAIL (compiler did not finish: {e})")
+            continue
+        if res.returncode != 0:
+            ok = False
+            details.append(f"{src}: FAIL\n{res.stderr.strip()}")
+        else:
+            details.append(f"{src}: warning-clean")
+    return ok, details
+
+
 def require_fresh(mod):
     """Staleness guard for import sites that load `mod` directly (the
     native bridge, bench): a MISSING .so degrades to Python as before,
@@ -84,3 +260,52 @@ def require_fresh(mod):
             f"run `make native` (or set STELLAR_TPU_NO_CAPPLY=1 to force "
             f"the Python engine)")
     return True
+
+
+def _main(argv):
+    """CLI: `python -m stellar_core_tpu._native_build <mode>`.
+
+    --warn-check         -Wall -Wextra -Werror syntax-only compile of
+                         every native source (the `make lint` gate);
+                         exit 1 on any warning, 0 when clean or when no
+                         compiler exists (fallback intact, notice printed)
+    --sanitize           build the ASan+UBSan .so cache under build/asan
+    --asan-exec CMD...   build sanitized, then exec CMD with the
+                         sanitizer environment (LD_PRELOAD runtime,
+                         halt_on_error, STPU_NATIVE_SANITIZE=1); exits 0
+                         with a SKIPPED notice when the toolchain is
+                         missing so CI tiers degrade instead of erroring
+    """
+    if not argv:
+        sys.stderr.write(_main.__doc__ + "\n")
+        return 2
+    mode, rest = argv[0], argv[1:]
+    if mode == "--warn-check":
+        ok, details = warn_check()
+        for d in details:
+            print(d)
+        return 0 if ok else 1
+    if mode == "--sanitize":
+        if not sanitizer_available():
+            print("sanitize SKIPPED: no cc/libasan in this environment")
+            return 0
+        ok = ensure_sanitized(quiet=False)
+        print("sanitized build: " + ("ok" if ok else "FAILED"))
+        return 0 if ok else 1
+    if mode == "--asan-exec":
+        if not rest:
+            sys.stderr.write("--asan-exec needs a command\n")
+            return 2
+        if not sanitizer_available():
+            print("native-asan SKIPPED: no cc/libasan in this environment")
+            return 0
+        if not ensure_sanitized(quiet=False):
+            sys.stderr.write("sanitized build FAILED\n")
+            return 1
+        os.execvpe(rest[0], rest, sanitizer_env())
+    sys.stderr.write(f"unknown mode {mode!r}\n{_main.__doc__}\n")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

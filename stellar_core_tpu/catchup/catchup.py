@@ -38,6 +38,7 @@ from ..util import eventlog
 from ..util import logging as slog
 from ..util.logging import discard_rate_limit, rate_limited
 from ..util.metrics import registry as _registry
+from ..util.racetrace import race_checked
 
 log = slog.get("History")
 
@@ -72,6 +73,7 @@ def verify_ledger_chain(headers: Sequence[X.LedgerHeaderHistoryEntry],
         raise CatchupError("chain tail does not match trusted hash")
 
 
+@race_checked
 class PreverifyPipeline:
     """Double-buffered TPU signature pre-verification (SURVEY §5.8:
     dispatch checkpoint k+1's batch while the CPU applies checkpoint k;
@@ -179,17 +181,22 @@ class PreverifyPipeline:
         self._groups: Dict[int, dict] = {}   # checkpoint -> shared group
         self._counted_sigs: Dict[int, int] = {}  # raw-path per-cp totals
         # poll-profile machinery: dispatched-but-unseeded groups in
-        # dispatch order, harvested (non-blocking) at every collect
-        self._live_groups: List[dict] = []
-        self._collects_since_harvest = 0
-        self._harvested_once = False
+        # dispatch order, harvested (non-blocking) at every collect.
+        # Thread contract (ISSUE 15 audit): the device worker touches NO
+        # pipeline state (see _submit) — every poll-profile field below
+        # is read and written only by the dispatch/collect caller, so
+        # each carries the owned-by attestation the thread-safety lint
+        # checks and @race_checked enforces at runtime under make race.
+        self._live_groups: List[dict] = []  # corelint: owned-by=main -- appended at dispatch, drained at collect; the device worker only fills job boxes
+        self._collects_since_harvest = 0  # corelint: owned-by=main -- poll stand-down counter, bumped only inside _collect_poll
+        self._harvested_once = False  # corelint: owned-by=main -- cold-vs-warm miss budget latch, flipped only in _harvest_ready
         # auto-tuned dispatch-ahead depth (recommended_coalesce): EWMAs of
         # the measured consumer rate (host apply seconds per checkpoint)
         # vs the measured device rate (seconds per paired signature)
-        self._last_collect_t: Optional[float] = None
-        self._apply_s_per_cp: Optional[float] = None
-        self._device_s_per_pair: Optional[float] = None
-        self._pairs_per_cp: Optional[float] = None
+        self._last_collect_t: Optional[float] = None  # corelint: owned-by=main -- consumer-rate EWMA input, collect-path only
+        self._apply_s_per_cp: Optional[float] = None  # corelint: owned-by=main -- consumer-rate EWMA, collect-path only
+        self._device_s_per_pair: Optional[float] = None  # corelint: owned-by=main -- device-rate EWMA; device wall rides home in the job box, folded in on harvest
+        self._pairs_per_cp: Optional[float] = None  # corelint: owned-by=main -- dispatch-path EWMA of pairs per checkpoint
         # per-pipeline rate-limit key, unique for process lifetime (an
         # id(self) key would recycle addresses after GC and inherit a
         # dead pipeline's count); discarded in close()

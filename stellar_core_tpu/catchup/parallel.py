@@ -69,6 +69,7 @@ from ..util import logging as slog
 from ..util.clock import ClockMode, VirtualClock
 from ..util.metrics import registry as _registry
 from ..util.process import ProcessManager
+from ..util.racetrace import race_checked
 from ..work.work import RETRY_A_FEW, BasicWork, State
 from .catchup import CatchupError
 
@@ -153,6 +154,7 @@ def _read_json(path: str) -> Optional[dict]:
         return None
 
 
+@race_checked
 class RangeControl:
     """The worker-side half of the stealing protocol, rooted in a control
     dir that OUTLIVES retry wipes of the range dir:
@@ -176,8 +178,13 @@ class RangeControl:
     def __init__(self, ctl_dir: str, throttle_s: Optional[float] = None):
         os.makedirs(ctl_dir, exist_ok=True)
         self.dir = ctl_dir
-        self.accepted: Optional[int] = None
-        self._rejected = False
+        # Thread contract (ISSUE 15 audit): cross-WORKER coordination
+        # rides exclusively in the atomically-replaced JSON files; these
+        # in-process latches are only ever touched by the range worker's
+        # single replay thread (checkpoint_hook), attested below and
+        # enforced at runtime by @race_checked under make race.
+        self.accepted: Optional[int] = None  # corelint: owned-by=main -- steal-limit accept latch, written once by checkpoint_hook on the replay thread
+        self._rejected = False  # corelint: owned-by=main -- sticky reject latch, checkpoint_hook only
         if throttle_s is None:
             throttle_s = float(
                 os.environ.get("STPU_CATCHUP_THROTTLE_S", "0") or 0.0)

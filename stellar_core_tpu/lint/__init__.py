@@ -22,4 +22,4 @@ from .core import (FileContext, LintReport, Rule, Violation,  # noqa: F401
                    render_json, run_paths, write_baseline)
 from .rules import ALL_RULE_CLASSES, all_rules, rules_by_id  # noqa: F401
 
-DEFAULT_TARGETS = ("stellar_core_tpu", "bench.py")
+DEFAULT_TARGETS = ("stellar_core_tpu", "bench.py", "native")

@@ -89,10 +89,13 @@ class FileContext:
 class Rule:
     """One invariant. Subclasses set `id`/`description` and implement
     `check(ctx)`; cross-file rules may also implement `finalize(ctxs)`,
-    called once after every file has been visited."""
+    called once after every file has been visited.  `language` routes
+    dispatch: "py" rules see FileContext (Python AST), "c" rules see
+    clex.CFileContext (token/function repr of native/*.c)."""
 
     id: str = ""
     description: str = ""
+    language: str = "py"
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
         return iter(())
@@ -132,7 +135,11 @@ class LintReport:
         }
 
 
-def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+_SOURCE_EXTS = (".py", ".c")
+
+
+def iter_source_files(paths: Iterable[str],
+                      exts: Tuple[str, ...] = _SOURCE_EXTS) -> Iterator[str]:
     skip_dirs = {"__pycache__", ".git", "build", "node_modules"}
     seen: Set[str] = set()  # overlapping args must not lint a file twice
 
@@ -144,14 +151,16 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
 
     for p in paths:
         if os.path.isfile(p):
-            if p.endswith(".py"):
+            if p.endswith(exts):
                 yield from emit(p)
             continue
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
             for fn in sorted(filenames):
-                if fn.endswith(".py"):
+                if fn.endswith(exts):
                     yield from emit(os.path.join(dirpath, fn))
+
+
 
 
 def run_paths(paths: Iterable[str], rules: Iterable[Rule],
@@ -159,29 +168,39 @@ def run_paths(paths: Iterable[str], rules: Iterable[Rule],
     """Lint every .py under `paths`. Relative paths in the report are
     computed against `root` (default: cwd) — rule scoping (allowed files,
     raw-path seams) keys off these relpaths."""
+    from .clex import CFileContext
     root = os.path.abspath(root or os.getcwd())
     rules = list(rules)
     report = LintReport()
     ctxs: List[FileContext] = []
-    for path in iter_py_files(paths):
+    for path in iter_source_files(paths):
         ap = os.path.abspath(path)
         rel = os.path.relpath(ap, root)
         try:
             with open(ap, "r", encoding="utf-8") as f:
                 src = f.read()
-            ctx = FileContext(ap, rel, src)
+            if ap.endswith(".c"):
+                ctx = CFileContext(ap, rel, src)
+            else:
+                ctx = FileContext(ap, rel, src)
         except (SyntaxError, ValueError, UnicodeDecodeError, OSError) as e:
-            # ValueError: ast.parse rejects NUL bytes with it (< 3.12)
+            # ValueError: ast.parse rejects NUL bytes with it (< 3.12);
+            # CParseError (brace-unbalanced C) IS-A ValueError
             report.parse_errors.append(f"{rel}: {e}")
             continue
         ctxs.append(ctx)
         report.files_scanned += 1
+        lang = getattr(ctx, "language", "py")
         for rule in rules:
+            if rule.language != lang:
+                continue
             for v in rule.check(ctx):
                 _file_violation(report, ctx, v)
     by_rel = {c.relpath: c for c in ctxs}
     for rule in rules:
-        for v in rule.finalize(ctxs):
+        lang_ctxs = [c for c in ctxs
+                     if getattr(c, "language", "py") == rule.language]
+        for v in rule.finalize(lang_ctxs):
             ctx = by_rel.get(v.path)
             if ctx is not None:
                 _file_violation(report, ctx, v)

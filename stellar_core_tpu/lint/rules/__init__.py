@@ -12,6 +12,7 @@ from .exceptions import ExceptionHygieneRule
 from .ledger_txn import LedgerTxnPathsRule
 from .lock_order import LockOrderRule
 from .metric_names import MetricRegistryRule
+from .native_c import NATIVE_C_RULE_CLASSES
 from .thread_safety import RawLockRule, ThreadSafetyRule
 
 ALL_RULE_CLASSES = (
@@ -24,7 +25,7 @@ ALL_RULE_CLASSES = (
     LockOrderRule,
     ThreadSafetyRule,
     RawLockRule,
-)
+) + NATIVE_C_RULE_CLASSES
 
 
 def all_rules() -> List[Rule]:

@@ -817,7 +817,8 @@ class TestSuppressions:
 class TestWholeTree:
     def test_tree_is_clean_and_matches_baseline(self):
         targets = [os.path.join(REPO_ROOT, "stellar_core_tpu"),
-                   os.path.join(REPO_ROOT, "bench.py")]
+                   os.path.join(REPO_ROOT, "bench.py"),
+                   os.path.join(REPO_ROOT, "native")]
         rep = run_paths(targets, all_rules(), root=REPO_ROOT)
         assert rep.files_scanned > 100
         assert rep.violations == [], \
@@ -898,7 +899,11 @@ class TestWholeTree:
         assert r.returncode == 0
         for rule in ("clock-discipline", "ledger-txn-paths",
                      "decode-free-seam", "exception-hygiene",
-                     "metric-registry", "lock-order"):
+                     "metric-registry", "lock-order",
+                     # native-C pass (ISSUE 15)
+                     "reader-discipline", "memcpy-provenance",
+                     "unchecked-alloc", "handler-result-discipline",
+                     "overlay-pairing"):
             assert rule in r.stdout
 
 

@@ -1,0 +1,620 @@
+"""Native-C corelint suite (ISSUE 15): every C rule proven to fire AND
+to stay quiet on paired fixtures, the C suppression-comment grammar
+round-trip (with the baseline ratchet), the brace-unbalanced parse-error
+fail-stop, the whole-tree clean gate over native/*.c, and an ASan smoke
+test proving the sanitizer build catches a deliberately-overflowing
+decoder (skipped cleanly when cc/libasan is absent).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from stellar_core_tpu._native_build import sanitizer_available
+from stellar_core_tpu.lint import (all_rules, check_baseline, run_paths,
+                                   rules_by_id, write_baseline,
+                                   load_baseline)
+from stellar_core_tpu.lint.clex import (CFileContext, CParseError,
+                                        extract_functions, tokenize)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_RULE_IDS = ("reader-discipline", "memcpy-provenance", "unchecked-alloc",
+              "handler-result-discipline", "overlay-pairing")
+
+
+def lint_c(tmp_path, src, rule_ids=None, name="native/mod.c"):
+    """Write C `src` under tmp_path and lint it in isolation."""
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    rules = rules_by_id(rule_ids or C_RULE_IDS)
+    return run_paths([str(tmp_path)], rules, root=str(tmp_path))
+
+
+def rule_hits(report, rule_id):
+    return [v for v in report.violations if v.rule == rule_id]
+
+
+# ---------------------------------------------------------------------------
+# lexer / function extraction
+# ---------------------------------------------------------------------------
+
+class TestClex:
+    def test_tokenize_strips_comments_strings_preprocessor(self):
+        toks, comments = tokenize(textwrap.dedent("""
+            #include <string.h>
+            /* block
+               comment */
+            // line comment
+            static int f(void) { return "lit; }"[0] + 'x'; }
+            #define M(a) \\
+                (a + 1)
+            """))
+        texts = [t.text for t in toks]
+        assert "include" not in texts          # preprocessor skipped
+        assert "M" not in texts                # continuation consumed
+        assert '"lit; }"' in texts             # string is ONE token
+        assert "'x'" in texts
+        assert len(comments) == 2
+        assert "block" in comments[0][1]
+
+    def test_function_extraction_skips_initializers_and_structs(self):
+        toks, _ = tokenize(textwrap.dedent("""
+            typedef struct { int a; } T;
+            static const int TAB[2] = { 1, 2 };
+            enum { X = 1 };
+            static int
+            add_one(int v)
+            {
+                if (v > 0) { v += 1; }
+                return v;
+            }
+            """))
+        fns = extract_functions(toks)
+        assert [f.name for f in fns] == ["add_one"]
+        assert [t.text for t in fns[0].params] == ["int", "v"]
+        assert [t.text for t in fns[0].body[-3:]] == ["return", "v", ";"]
+
+    def test_unbalanced_braces_raise(self):
+        toks, _ = tokenize("static int f(void) { if (1) { return 0; }\n")
+        with pytest.raises(CParseError):
+            extract_functions(toks)
+
+    def test_parse_error_is_reported_not_crashed(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            f(void)
+            {
+                return 0;
+            /* missing closing brace */
+            """)
+        assert rep.files_scanned == 0
+        assert rep.parse_errors and "mod.c" in rep.parse_errors[0]
+
+
+# ---------------------------------------------------------------------------
+# reader-discipline
+# ---------------------------------------------------------------------------
+
+class TestReaderDiscipline:
+    def test_fires_on_raw_buffer_pointer(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            typedef struct { const uint8_t *p; int off, len, err; } Rd;
+            static int
+            bad(Rd *r)
+            {
+                const uint8_t *q = r->p + r->off;
+                return q[0];
+            }
+            """)
+        assert len(rule_hits(rep, "reader-discipline")) == 1
+
+    def test_fires_on_local_reader_dot_access(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            bad(const uint8_t *data, int len)
+            {
+                Rd r;
+                rd_init(&r, data, len);
+                return r.p[0];
+            }
+            """)
+        assert len(rule_hits(rep, "reader-discipline")) == 1
+
+    def test_quiet_via_helpers_and_inside_rd_functions(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static const uint8_t *
+            rd_take(Rd *r, int n)
+            {
+                if (r->err || r->off + n > r->len) { r->err = 1; return NULL; }
+                const uint8_t *q = r->p + r->off;
+                r->off += n;
+                return q;
+            }
+            static int
+            good(Rd *r)
+            {
+                const uint8_t *q = rd_take(r, 4);
+                return q != NULL && r->off < r->len;
+            }
+            """)
+        assert not rule_hits(rep, "reader-discipline")
+
+
+# ---------------------------------------------------------------------------
+# memcpy-provenance
+# ---------------------------------------------------------------------------
+
+class TestMemcpyProvenance:
+    def test_fires_on_unbounded_variable_length(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static void
+            bad(uint8_t *dst, const uint8_t *src, int n)
+            {
+                memcpy(dst, src, n);
+            }
+            """)
+        assert len(rule_hits(rep, "memcpy-provenance")) == 1
+
+    def test_quiet_on_constant_sizeof_and_const_ternary(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static void
+            good(uint8_t *dst, const uint8_t *src, int four)
+            {
+                memcpy(dst, src, 32);
+                memcpy(dst, src, sizeof(uint64_t) * 2);
+                memcpy(dst, src, four == 1 ? 4 : 12);
+                memcpy(dst, src, 1 << 5);
+            }
+            """)
+        assert not rule_hits(rep, "memcpy-provenance")
+
+    def test_quiet_on_rd_varopaque_bound(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            good(Rd *r, uint8_t out[64])
+            {
+                uint32_t len;
+                const uint8_t *q = rd_varopaque(r, 64, &len);
+                if (!q)
+                    return -1;
+                memcpy(out, q, len);
+                return 0;
+            }
+            """)
+        assert not rule_hits(rep, "memcpy-provenance")
+
+    def test_quiet_on_matching_allocation(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static uint8_t *
+            good(const uint8_t *src, int n)
+            {
+                uint8_t *d = PyMem_Malloc(n);
+                if (!d)
+                    return NULL;
+                memcpy(d, src, n);
+                return d;
+            }
+            """)
+        assert not rule_hits(rep, "memcpy-provenance")
+
+    def test_fires_when_bound_is_in_another_function(self, tmp_path):
+        # the bound must be in the SAME function: cross-function
+        # provenance is exactly what the rule refuses to assume
+        rep = lint_c(tmp_path, """
+            static void
+            sized(uint8_t *d, int n)
+            {
+                uint8_t *x = PyMem_Malloc(n);
+                if (x)
+                    d[0] = x[0];
+            }
+            static void
+            bad(uint8_t *dst, const uint8_t *src, int n)
+            {
+                memcpy(dst, src, n);
+            }
+            """)
+        assert len(rule_hits(rep, "memcpy-provenance")) == 1
+
+
+# ---------------------------------------------------------------------------
+# unchecked-alloc
+# ---------------------------------------------------------------------------
+
+class TestUncheckedAlloc:
+    def test_fires_on_use_before_check(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            bad(int n)
+            {
+                int *v = PyMem_Malloc(n * sizeof(int));
+                v[0] = 1;
+                if (!v)
+                    return -1;
+                return v[0];
+            }
+            """)
+        hits = rule_hits(rep, "unchecked-alloc")
+        assert len(hits) == 1
+        assert "used before a null check" in hits[0].message
+
+    def test_fires_when_never_checked(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            bad(int n)
+            {
+                char *buf = malloc(n);
+                buf[0] = 0;
+                return 0;
+            }
+            """)
+        assert len(rule_hits(rep, "unchecked-alloc")) == 1
+
+    def test_quiet_on_immediate_and_combined_checks(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            good(int n, S *s)
+            {
+                int *a = PyMem_Malloc(n * sizeof(int));
+                int *b = PyMem_Calloc(n, sizeof(int));
+                if (!a || !b) {
+                    PyMem_Free(a);
+                    PyMem_Free(b);
+                    return -1;
+                }
+                s->tab = PyMem_Realloc(s->tab, n * 2);
+                if (s->tab == NULL)
+                    return -1;
+                a[0] = b[0];
+                PyMem_Free(a);
+                PyMem_Free(b);
+                return 0;
+            }
+            """)
+        assert not rule_hits(rep, "unchecked-alloc")
+
+    def test_quiet_on_truthiness_guards(self, tmp_path):
+        # `if (p)` / `while (p)` / ternary are null checks; `f(p)` is NOT
+        rep = lint_c(tmp_path, """
+            static int
+            good(int n)
+            {
+                char *p = PyMem_Malloc(n);
+                if (p)
+                    p[0] = 0;
+                char *q = malloc(n);
+                return q ? q[0] : -1;
+            }
+            """)
+        assert not rule_hits(rep, "unchecked-alloc")
+
+    def test_fires_on_call_use_before_check(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            bad(int n, uint8_t *src)
+            {
+                char *p = PyMem_Malloc(n);
+                memcpy(p, src, 4);
+                if (!p)
+                    return -1;
+                return 0;
+            }
+            """)
+        assert len(rule_hits(rep, "unchecked-alloc")) == 1
+
+
+# ---------------------------------------------------------------------------
+# handler-result-discipline
+# ---------------------------------------------------------------------------
+
+class TestHandlerResultDiscipline:
+    def test_fires_on_bare_early_return(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            op_bad(Engine *e, COp *op, const uint8_t src[32], Buf *rb)
+            {
+                if (op == NULL)
+                    return 0;
+                return res_inner(rb, 1, 0) < 0 ? -1 : 1;
+            }
+            """)
+        hits = rule_hits(rep, "handler-result-discipline")
+        assert len(hits) == 1
+        assert "op_bad" in hits[0].message
+
+    def test_quiet_on_res_inner_minus_one_and_delegation(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            op_good(Engine *e, COp *op, const uint8_t src[32], Buf *rb)
+            {
+                if (op == NULL)
+                    return res_inner(rb, 1, -1) < 0 ? -1 : 0;
+                if (e == NULL)
+                    return -1;
+                int rc = side_effect(e, rb, src);
+                if (rc <= 0)
+                    return rc;
+                return store_thing(e, src, rb, 6);
+            }
+            """)
+        assert not rule_hits(rep, "handler-result-discipline")
+
+    def test_quiet_on_success_arm_write_then_return_one(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            op_good(Engine *e, COp *op, const uint8_t src[32], Buf *rb)
+            {
+                if (op == NULL)
+                    return -1;
+                if (buf_i32(rb, 0) < 0 || buf_i64(rb, 7) < 0)
+                    return -1;
+                return 1;
+            }
+            """)
+        assert not rule_hits(rep, "handler-result-discipline")
+
+    def test_non_handler_functions_ignored(self, tmp_path):
+        # no Buf param => not a handler; op_-prefixed alone is not enough
+        rep = lint_c(tmp_path, """
+            static int
+            op_helperish(Engine *e)
+            {
+                return 0;
+            }
+            static int
+            plain(Buf *rb)
+            {
+                (void)rb;
+                return 0;
+            }
+            """)
+        assert not rule_hits(rep, "handler-result-discipline")
+
+
+# ---------------------------------------------------------------------------
+# overlay-pairing
+# ---------------------------------------------------------------------------
+
+class TestOverlayPairing:
+    def test_fires_on_leaked_push(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            bad(Engine *e, Buf *rb)
+            {
+                e->hop_active = 1;
+                if (rb == NULL)
+                    return -1;
+                e->hop_active = 0;
+                return 0;
+            }
+            """)
+        hits = rule_hits(rep, "overlay-pairing")
+        assert len(hits) == 1
+        assert "hop_active" in hits[0].message
+
+    def test_fires_on_leaky_loop_break_path(self, tmp_path):
+        # the pop is skipped when the loop exits via break-then-return
+        rep = lint_c(tmp_path, """
+            static int
+            bad(Engine *e, int n)
+            {
+                e->op_active = 1;
+                for (int i = 0; i < n; i++) {
+                    if (i == 3)
+                        break;
+                }
+                return 0;
+            }
+            """)
+        assert len(rule_hits(rep, "overlay-pairing")) == 1
+
+    def test_quiet_on_balanced_paths_and_rollback_call(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static int
+            good(Engine *e, Buf *rb, int n)
+            {
+                e->hop_active = 1;
+                if (rb == NULL) {
+                    e->hop_active = 0;
+                    return -1;
+                }
+                switch (n) {
+                case 0:
+                    e->hop_active = 0;
+                    return 0;
+                default:
+                    break;
+                }
+                eng_rollback_tx(e);
+                return 0;
+            }
+            static int
+            good2(Engine *e)
+            {
+                e->op_active = 1;
+                e->op_active = e->hop_active = 0;
+                return 0;
+            }
+            """)
+        assert not rule_hits(rep, "overlay-pairing")
+
+    def test_quiet_without_any_push(self, tmp_path):
+        rep = lint_c(tmp_path, """
+            static void
+            reset(Engine *e)
+            {
+                e->hop_active = 0;
+                e->op_active = 0;
+            }
+            """)
+        assert not rule_hits(rep, "overlay-pairing")
+
+
+# ---------------------------------------------------------------------------
+# suppressions + ratchet
+# ---------------------------------------------------------------------------
+
+class TestCSuppressions:
+    SRC = """
+        typedef struct { const uint8_t *p; int off, len, err; } Rd;
+        static int
+        f(Rd *r)
+        {
+            const uint8_t *q = r->p + r->off; /* corelint: disable=reader-discipline -- fixture reason */
+            return q[0];
+        }
+        """
+
+    def test_suppression_round_trip(self, tmp_path):
+        rep = lint_c(tmp_path, self.SRC)
+        assert not rule_hits(rep, "reader-discipline")
+        assert len(rep.suppressed) == 1
+        assert rep.suppressed[0].rule == "reader-discipline"
+        key = "native/mod.c:reader-discipline"
+        assert rep.suppression_counts() == {key: 1}
+
+    def test_file_level_suppression(self, tmp_path):
+        src = "/* corelint: disable-file=reader-discipline -- fixture */\n" \
+            + textwrap.dedent("""
+            static int
+            f(Rd *r)
+            {
+                return r->p[0];
+            }
+            """)
+        rep = lint_c(tmp_path, src)
+        assert not rule_hits(rep, "reader-discipline")
+        assert len(rep.suppressed) == 1
+
+    def test_ratchet_flags_new_c_suppression(self, tmp_path):
+        rep = lint_c(tmp_path, self.SRC)
+        problems = check_baseline(rep, {"suppressions": {}})
+        assert len(problems) == 1
+        assert "native/mod.c:reader-discipline" in problems[0]
+        # and a regenerated baseline accepts it (two-way ratchet intact)
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl), rep)
+        assert check_baseline(rep, load_baseline(str(bl))) == []
+
+
+# ---------------------------------------------------------------------------
+# whole-tree gate + CLI
+# ---------------------------------------------------------------------------
+
+class TestWholeTreeNative:
+    def test_native_tree_is_clean(self):
+        rep = run_paths([os.path.join(REPO_ROOT, "native")],
+                        rules_by_id(C_RULE_IDS), root=REPO_ROOT)
+        assert rep.files_scanned >= 3
+        assert rep.violations == [], \
+            "\n".join(v.format() for v in rep.violations)
+        assert not rep.parse_errors
+        # the documented engine-idiom suppressions are present and exact
+        counts = rep.suppression_counts()
+        assert counts.get("native/capply.c:reader-discipline") == 4
+        assert counts.get("native/capply.c:memcpy-provenance") == 1
+
+    def test_python_rules_do_not_see_c_files(self):
+        # dispatch isolation: running ONLY the Python rules over native/
+        # scans the files but produces zero findings (no cross-language
+        # crashes, no bogus hits)
+        rep = run_paths([os.path.join(REPO_ROOT, "native")],
+                        rules_by_id(["clock-discipline",
+                                     "exception-hygiene"]),
+                        root=REPO_ROOT)
+        assert rep.files_scanned >= 3
+        assert rep.violations == []
+        assert not rep.parse_errors
+
+    def test_cli_lists_c_rules(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 0
+        for rule in C_RULE_IDS:
+            assert rule in r.stdout
+
+    def test_cli_fires_on_bad_c_file(self, tmp_path):
+        bad = tmp_path / "bad.c"
+        bad.write_text(textwrap.dedent("""
+            static int
+            bad(int n)
+            {
+                char *b = malloc(n);
+                b[0] = 0;
+                return 0;
+            }
+            """))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "stellar_core_tpu.lint", str(bad),
+             "--root", str(tmp_path), "--json"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert r.returncode == 1
+        assert "unchecked-alloc" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# sanitizer smoke test
+# ---------------------------------------------------------------------------
+
+class TestSanitizerSmoke:
+    @pytest.mark.skipif(not sanitizer_available(),
+                        reason="no cc/libasan in this environment")
+    def test_asan_catches_overflowing_decoder(self, tmp_path):
+        """Compile a deliberately-overflowing XDR-ish decoder with the
+        same flags `make native-asan` uses and prove ASan fail-stops it:
+        the tier is only meaningful if a real out-of-bounds read dies."""
+        from stellar_core_tpu._native_build import _SANITIZE_FLAGS, _cc
+        src = tmp_path / "overflow.c"
+        src.write_text(textwrap.dedent("""
+            #include <stdint.h>
+            #include <stdlib.h>
+            #include <string.h>
+            /* a decoder that trusts the wire length instead of the
+               buffer bound — exactly what reader-discipline forbids */
+            static int
+            decode(const uint8_t *p, int wire_len)
+            {
+                int acc = 0;
+                for (int i = 0; i < wire_len; i++)
+                    acc += p[i];
+                return acc;
+            }
+            int main(void)
+            {
+                uint8_t *buf = malloc(16);
+                if (!buf)
+                    return 2;
+                memset(buf, 1, 16);
+                int v = decode(buf, 17);   /* one past the heap block */
+                free(buf);
+                return v == 0 ? 0 : 1;
+            }
+            """))
+        exe = tmp_path / "overflow"
+        comp = subprocess.run(
+            [_cc()] + _SANITIZE_FLAGS + [str(src), "-o", str(exe)],
+            capture_output=True, text=True, timeout=120)
+        if comp.returncode != 0:
+            pytest.skip(f"sanitizer compile unavailable: {comp.stderr[:200]}")
+        run = subprocess.run(
+            [str(exe)], capture_output=True, text=True, timeout=60,
+            env=dict(os.environ,
+                     ASAN_OPTIONS="detect_leaks=0:halt_on_error=1"))
+        assert run.returncode != 0
+        assert "AddressSanitizer" in run.stderr
+        assert "heap-buffer-overflow" in run.stderr
+
+    def test_sanitized_build_cache_is_separate(self):
+        """The ASan .so cache key (build/asan/<mod>.so) never collides
+        with the regular in-place build (<pkg>/<mod>.<tag>.so)."""
+        from stellar_core_tpu import _native_build as nb
+        assert os.path.basename(nb._ASAN_DIR) == "asan"
+        assert not nb._ASAN_DIR.startswith(nb._PKG)
