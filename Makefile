@@ -81,11 +81,13 @@ bucketdb-slow:
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # incident-observability suite: flight recorder + crash bundles, /health
-# + StatusManager, trace-correlated JSON logging, admin error paths, and
-# the metrics/trace exposition surface
+# + StatusManager, trace-correlated JSON logging, admin error paths, the
+# metrics/trace exposition surface, and the fleet observability plane
+# (cross-node trace merge, sampling profiler, SLO burn tracking)
 obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
-		tests/test_eventlog.py -q -m 'not slow' \
+		tests/test_eventlog.py tests/test_fleettrace.py \
+		tests/test_sampleprof.py tests/test_slo.py -q -m 'not slow' \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # chaos campaigns: the small-topology scenario tier (12-51 nodes —

@@ -141,7 +141,7 @@ def _degraded_report(detail: str) -> dict:
         vs = round(value / base, 2) if base else 0.0
     for section in ("sigs", "replay", "quorum", "bucketlistdb", "chaos",
                     "admission", "catchup_parallel", "catchup_mesh",
-                    "native_close", "fleet"):
+                    "native_close", "fleet", "sampleprof", "fleettrace"):
         got = cache.get(section)
         if not got:
             continue
@@ -963,6 +963,131 @@ def bench_native_close(time_left_fn):
     }
 
 
+def bench_sampleprof(time_left_fn):
+    """Observability plane (ISSUE 16): the always-on sampling profiler's
+    overhead on a replay-shaped CPU microbench (tx apply + ledger close
+    loop, the hot path the sampler would ride in production).  Interleaved
+    off/on/off/on rounds, best-of each arm to shed scheduler noise; the
+    <5% overhead claim is ASSERTED, not just reported — a sampler that
+    costs more than its budget must fail the bench before shipping."""
+    import random as _random
+
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.ledger.manager import LedgerManager
+    from stellar_core_tpu.testutils import (TestAccount, create_account_op,
+                                            native_payment_op, network_id)
+    from stellar_core_tpu.util.sampleprof import SamplingProfiler
+
+    nid = network_id("sampleprof bench")
+    # long enough arms that scheduler noise stays well under the 5%
+    # overhead budget being asserted (sub-second arms flap the ratio)
+    n_ledgers = int(os.environ.get("BENCH_SAMPLEPROF_LEDGERS", "300"))
+    txs_per_ledger = 10
+
+    def run_once():
+        mgr = LedgerManager(nid, invariant_manager=None)
+        mgr.start_new_ledger()
+        root_sk = mgr.root_account_secret()
+        ent = mgr.root.get_entry(
+            X.account_key_xdr(root_sk.public_key.ed25519))
+        root = TestAccount(mgr, root_sk, ent.data.value.seqNum)
+        sks = [SecretKey(bytes([60 + i]) * 32) for i in range(8)]
+        mgr.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 10 ** 12)
+            for sk in sks])], 1_700_000_000)
+        accts = []
+        for sk in sks:
+            e = mgr.root.get_entry(X.account_key_xdr(sk.public_key.ed25519))
+            accts.append(TestAccount(mgr, sk, e.data.value.seqNum))
+        rng = _random.Random(11)
+        ct = 1_700_000_000
+        t0 = time.perf_counter()
+        for _ in range(n_ledgers):
+            ct += 5
+            frames = []
+            for _ in range(txs_per_ledger):
+                a = accts[rng.randrange(len(accts))]
+                frames.append(a.tx([native_payment_op(
+                    accts[rng.randrange(len(accts))].account_id,
+                    1000 + rng.randrange(10 ** 6))]))
+            mgr.close_ledger(frames, ct)
+        return time.perf_counter() - t0
+
+    prof = SamplingProfiler()
+    run_once()    # warmup: first run pays import/jit/page-in costs
+    off_s, on_s = [], []
+    samples = 0
+    for round_ in range(4):
+        if time_left_fn() < 30:
+            break
+        off_s.append(run_once())
+        prof.start()
+        try:
+            on_s.append(run_once())
+        finally:
+            prof.stop()
+        samples = prof.snapshot()["samples"]
+    if not on_s:
+        return {"sampleprof": "SKIPPED(budget, pre-empted mid-section)"}
+    # min-of-N per arm: the sampler's true cost is additive and tiny
+    # (~5us/sample), while the workload's run-to-run spread is ~10% —
+    # the minima converge to each arm's floor
+    base, with_prof = min(off_s), min(on_s)
+    overhead = with_prof / base
+    vals = {
+        "sampleprof_off_s": round(base, 4),
+        "sampleprof_on_s": round(with_prof, 4),
+        "sampleprof_overhead_ratio": round(overhead, 4),
+        "sampleprof_samples": samples,
+        "sampleprof_ledgers": n_ledgers,
+    }
+    # the always-on claim: ride-along cost under 5% on the apply path
+    assert overhead < 1.05, (
+        f"sampling profiler overhead {overhead:.3f}x exceeds the 5% "
+        f"always-on budget (off={base:.3f}s on={with_prof:.3f}s)")
+    return vals
+
+
+def bench_fleettrace(time_left_fn):
+    """Observability plane (ISSUE 16): merged cross-node trace cost over
+    a synthetic 5-node x 4000-mark collection (a soak's worth of phase
+    marks) — merge wall-clock and events/s, so a regression in the
+    alignment/merge path shows up as a bench row, not a stuck soak
+    teardown."""
+    from stellar_core_tpu.util.fleettrace import FleetTraceCollector
+
+    n_nodes = 5
+    n_marks = int(os.environ.get("BENCH_FLEETTRACE_MARKS", "4000"))
+    if time_left_fn() < 20:
+        return {"fleettrace": "SKIPPED(budget, pre-empted mid-section)"}
+    coll = FleetTraceCollector()
+    phases = ("admission-flush", "tx-flood", "nominate", "externalize",
+              "close-seal")
+    for i in range(n_nodes):
+        skew = (i - 2) * 0.75    # seconds of injected wall skew
+        marks = []
+        for k in range(n_marks):
+            slot = 2 + k // len(phases)
+            marks.append({
+                "seq": k + 1, "phase": phases[k % len(phases)],
+                "slot": slot, "wall_s": 1_700_000_000.0 + slot * 5.0
+                + (k % len(phases)) * 0.05 + skew,
+                "node": f"node-{i}", "tid": 1, "args": {}})
+        coll.ingest(f"node-{i}", {"marks": marks, "next_since": n_marks})
+    t0 = time.perf_counter()
+    doc = coll.merge_chrome_trace()
+    merge_s = time.perf_counter() - t0
+    events = len(doc["traceEvents"])
+    return {
+        "fleettrace_nodes": n_nodes,
+        "fleettrace_marks_per_node": n_marks,
+        "fleettrace_merge_ms": round(merge_s * 1e3, 2),
+        "fleettrace_events": events,
+        "fleettrace_events_per_sec": round(events / merge_s, 1),
+    }
+
+
 def bench_merge_throughput(workdir):
     """ISSUE 3 acceptance: streaming-merge throughput.  Two synthetic
     buckets (disjoint + colliding keys) merged by the decoded path and by
@@ -1497,6 +1622,66 @@ def _arm_watchdog(deadline_s: float = 2100.0):
     return t.cancel
 
 
+SUMMARY_PATH = os.environ.get("BENCH_SUMMARY_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SUMMARY.json")
+
+# sections whose cached rows predate the PR-14 never-wait poll profile:
+# the r05 bench run was killed by the driver budget (rc=124) before the
+# accel sections re-measured, so their last-good rows are older-profile
+STALE_AFTER_HOURS = 24.0
+
+
+def _summary_main() -> int:
+    """`bench.py --summary`: render BENCH_CACHE.json's last-good rows
+    into BENCH_SUMMARY.json — one section per cached bench section with
+    its age and staleness flags — WITHOUT touching the device.  This is
+    the driver/reviewer view of 'what numbers do we actually have, and
+    how old are they'."""
+    cache = _cache_load()
+    if not cache:
+        print(json.dumps({"error": f"no cache at {CACHE_PATH}"}))
+        return 1
+    now = time.time()
+    sections = {}
+    for name in sorted(cache):
+        got = cache[name]
+        age_h = round(
+            (now - got.get("measured_at_unix", 0.0)) / 3600.0, 1)
+        vals = got.get("values", {})
+        restored = vals.get("restored_rows")
+        sections[name] = {
+            "measured_at": got.get("measured_at"),
+            "age_hours": age_h,
+            "stale": age_h > STALE_AFTER_HOURS,
+            "partially_restored": bool(restored),
+            "restored_rows": restored or {},
+            "source": got.get("source"),
+            "values": {k: v for k, v in vals.items()
+                       if k != "restored_rows"},
+        }
+    doc = {
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime(now)),
+        "cache_path": CACHE_PATH,
+        "stale_after_hours": STALE_AFTER_HOURS,
+        "note": ("last-good rows from BENCH_CACHE.json; 'stale' rows "
+                 "were measured more than stale_after_hours ago, "
+                 "'partially_restored' sections carry rows restored "
+                 "from an even older run (see restored_rows for the "
+                 "run that measured each)"),
+        "sections": sections,
+    }
+    tmp = SUMMARY_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, SUMMARY_PATH)
+    print(json.dumps({"summary": SUMMARY_PATH,
+                      "sections": len(sections),
+                      "stale": sorted(n for n, s in sections.items()
+                                      if s["stale"])}))
+    return 0
+
+
 def _stale_fill(extra: dict, section: str) -> dict:
     """Pull a skipped section's last-good cached values into `extra`,
     age-stamped and stale-flagged (never bare zeros while evidence
@@ -1633,6 +1818,26 @@ def main():
     else:
         extra["native_close"] = "SKIPPED(budget)"
         _stale_fill(extra, "native_close")
+
+    # observability plane (ISSUE 16): sampler overhead (<5% asserted on
+    # the apply-path microbench) + merged-trace cost — both CPU-only
+    if budget_fits("sampleprof", 60):
+        _stage("sampleprof overhead bench (CPU-only)...")
+        sp_vals = bench_sampleprof(time_left)
+        _cache_put("sampleprof", _merge_last_good("sampleprof", sp_vals))
+        extra.update(sp_vals)
+    else:
+        extra["sampleprof"] = "SKIPPED(budget)"
+        _stale_fill(extra, "sampleprof")
+
+    if budget_fits("fleettrace", 30):
+        _stage("fleettrace merge bench (CPU-only)...")
+        ft_vals = bench_fleettrace(time_left)
+        _cache_put("fleettrace", _merge_last_good("fleettrace", ft_vals))
+        extra.update(ft_vals)
+    else:
+        extra["fleettrace"] = "SKIPPED(budget)"
+        _stale_fill(extra, "fleettrace")
 
     if not budget_fits("device probe + accel sections", 240):
         # nothing device-side fits anymore: emit what the CPU sections
@@ -1797,6 +2002,9 @@ if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--quorum-cell":
         # one pre-emptible quorum matrix cell (see bench_quorum)
         sys.exit(_quorum_cell_main(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--summary":
+        # render the last-good cache into BENCH_SUMMARY.json (no device)
+        sys.exit(_summary_main())
     try:
         main()
     except AssertionError:

@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..util import eventlog
 from ..util import logging as slog
+from ..util import tracing
 from ..util.clock import VirtualClock, VirtualTimer
 from ..util.metrics import registry as _registry
 from ..util.racetrace import race_checked
@@ -303,6 +304,9 @@ class AdmissionPipeline:
             len(batch))
         eventlog.record("Herder", "INFO", "admission batch flushed",
                         txs=len(batch), sigs=sigs, depth=self.depth)
+        tracing.mark_phase("admission-flush",
+                           self.lm.last_closed_ledger_seq + 1,
+                           txs=len(batch), sigs=sigs)
         bid = next(_BATCH_IDS)
         self._maybe_collect_warmup()
         if self._preverify is not None and self._warmed \

@@ -24,6 +24,7 @@ from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP, EnvelopeState
 from ..util import eventlog
 from ..util import logging as slog
+from ..util import tracing
 from ..util.clock import VirtualClock, VirtualTimer
 from ..util.metrics import registry as _registry
 from .pending_envelopes import (ENVELOPE_STATUS_DISCARDED,
@@ -110,6 +111,8 @@ class Herder(SCPDriver):
         # slot -> perf_counter at nomination trigger (scp.slot.externalize
         # timer: nomination start -> value applied)
         self._nominate_started: Dict[int, float] = {}
+        # last slot that got a tx-flood phase mark (one mark per slot)
+        self._flood_marked_slot = 0
         # recovery bookkeeping: how often this node fell out of sync, how
         # many ledgers it applied from the buffered-externalize queue
         # while catching back up, and how often it had to resync from a
@@ -231,6 +234,20 @@ class Herder(SCPDriver):
             self._process_scp_queue()
         return ok
 
+    def trace_node(self) -> str:
+        """Node attribution for phase marks: the fleet-provisioned
+        process node id when configured, else this herder's short public
+        key — in-process multi-node simulations share one process but
+        must still split the merged trace into per-node rows."""
+        return slog.node_id() or self.node_id.hex()[:8]
+
+    def _mark_flood(self, slot: int) -> None:
+        """First tx flooded toward `slot` gets a phase mark (one per
+        slot, not per tx — floods are per-transaction hot)."""
+        if self._flood_marked_slot != slot:
+            self._flood_marked_slot = slot
+            tracing.mark_phase("tx-flood", slot, node=self.trace_node())
+
     def recv_transaction(self, frame, origin: str = "api") -> AddResult:
         """Reference: HerderImpl::recvTransaction (from /tx or overlay).
         With an admission pipeline enabled, intake is batched: the frame
@@ -242,6 +259,7 @@ class Herder(SCPDriver):
             return self.admission.submit(frame, origin=origin)
         res = self.tx_queue.try_add(frame)
         if res.code == AddResult.STATUS_PENDING:
+            self._mark_flood(self.lm.last_closed_ledger_seq + 1)
             self.tx_flood(frame)
         return res
 
@@ -250,10 +268,14 @@ class Herder(SCPDriver):
         tx-queue (herder/admission.py).  Admitted frames flood exactly
         like the legacy path did."""
         from .admission import AdmissionPipeline
+
+        def _flood(frame, origin):
+            self._mark_flood(self.lm.last_closed_ledger_seq + 1)
+            self.tx_flood(frame)
+
         self.admission = AdmissionPipeline(
             self.tx_queue, self.lm, self.clock, accel=accel,
-            on_admitted=lambda frame, origin: self.tx_flood(frame),
-            **knobs)
+            on_admitted=_flood, **knobs)
 
     def _process_scp_queue(self) -> None:
         if self._processing_ready:
@@ -312,6 +334,8 @@ class Herder(SCPDriver):
         # report crank speed instead
         self._nominate_started.setdefault(seq, self.clock.now())
         frames = self.tx_queue.tx_set_frames()
+        tracing.mark_phase("nominate", seq, node=self.trace_node(),
+                           txs=len(frames))
         tx_set, tx_set_hash, _ordered = self.lm.make_tx_set(frames)
         self.pending.add_txset(tx_set_hash, tx_set,
                                sorted(frames, key=lambda f: f.content_hash()))
@@ -489,6 +513,8 @@ class Herder(SCPDriver):
         self._buffered[slot_index] = sv
         eventlog.record("SCP", "INFO", "slot externalized",
                         slot=slot_index, lcl=lcl)
+        tracing.mark_phase("externalize", slot_index,
+                           node=self.trace_node(), lcl=lcl)
         if slot_index == lcl + 1:
             self._set_state(HerderState.TRACKING, "externalized next slot")
         self._drain_buffered()

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 from .. import xdr as X
 from ..ledger.manager import ClosedLedgerArtifacts, LedgerManager
+from ..util import eventlog, tracing
 from ..util import logging as slog
 from .archive import (CATEGORY_LEDGER, CATEGORY_RESULTS, CATEGORY_TRANSACTIONS,
                       FileHistoryArchive, HistoryArchiveState, category_path,
@@ -120,6 +121,11 @@ class HistoryManager:
             self.db.prune_tx_history(keep_from)
             self.db.delete_old_headers(keep_from)
             self.db.commit()
+        eventlog.record("History", "INFO", "checkpoint published",
+                        checkpoint=checkpoint_seq, headers=len(headers),
+                        txs=len(txs))
+        tracing.mark_phase("checkpoint-publish", checkpoint_seq,
+                           headers=len(headers), txs=len(txs))
         log.info("published checkpoint %d (%d headers, %d tx entries)",
                  checkpoint_seq, len(headers), len(txs))
 

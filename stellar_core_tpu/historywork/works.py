@@ -307,6 +307,10 @@ class ApplyCheckpointWork(BasicWork):
                             checkpoint=self.download.checkpoint,
                             lcl=self.mgr.last_closed_ledger_seq,
                             dur_ms=round(dur_s * 1e3, 1))
+            tracing.mark_phase("checkpoint-apply",
+                               self.download.checkpoint,
+                               lcl=self.mgr.last_closed_ledger_seq,
+                               dur_ms=round(dur_s * 1e3, 1))
         elif state == State.FAILURE:
             eventlog.record("History", "ERROR", "checkpoint apply FAILED",
                             checkpoint=self.download.checkpoint,

@@ -526,6 +526,8 @@ class LedgerManager:
                         seq=seq, txs=len(ordered),
                         dur_ms=round(dur_s * 1e3, 3),
                         hash=self.lcl_hash.hex()[:16])
+        tracing.mark_phase("close-seal", seq, txs=len(ordered),
+                           dur_ms=round(dur_s * 1e3, 3))
         _registry().meter("ledger.transaction.apply").mark(len(ordered))
         if self.meta_stream is not None:
             self._emit_close_meta(header_entry, tx_set, result_pairs)

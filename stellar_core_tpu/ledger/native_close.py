@@ -196,6 +196,9 @@ class NativeLedgerCloser:
                         seq=seq, txs=len(result_set.results),
                         dur_ms=round(dur_s * 1e3, 3),
                         hash=lcl_hash.hex()[:16], engine="native")
+        tracing.mark_phase("close-seal", seq,
+                           txs=len(result_set.results),
+                           dur_ms=round(dur_s * 1e3, 3), engine="native")
         if self._at_boundary(seq):
             self._sync_boundary()
         if mgr.meta_stream is not None:

@@ -79,6 +79,10 @@ class Application:
         self.node_secret = config.node_secret()
         slog.set_level(config.LOG_LEVEL)
         slog.set_format(config.LOG_FORMAT)
+        if config.NODE_NAME:
+            # fleet attribution: JSON log records, flight-event exports
+            # and /tracespans documents all carry this node's name
+            slog.set_node_id(config.NODE_NAME)
 
         # incident observability: per-category status lines (reference:
         # StatusManager feeding /info), the node.health gauge behind
@@ -96,6 +100,27 @@ class Application:
             "herder", lambda: _herder_bundle(ref()))
         eventlog.register_bundle_source(
             "config", lambda: _config_fingerprint(ref()))
+        # always-on sampling profiler (util/sampleprof): config flag or
+        # STPU_SAMPLEPROF=1; its folded stacks join every crash bundle
+        from ..util import sampleprof
+        if config.SAMPLEPROF:
+            sampleprof.profiler().start()
+        else:
+            sampleprof.start_if_configured()
+        # local SLO burn tracking (util/slo): evaluated on a clock timer
+        # so /slo answers with per-objective burn rates; 0 cadence = off
+        self.slo_tracker = None
+        self._slo_timer = None
+        if config.SLO_EVAL_CADENCE_S > 0:
+            from ..util.slo import SLOTracker, default_objectives
+            self.slo_tracker = SLOTracker(
+                default_objectives(
+                    close_p99_s=config.SLO_CLOSE_P99_S,
+                    admission_p99_s=config.SLO_ADMISSION_P99_S,
+                    catchup_rate=config.SLO_CATCHUP_RATE,
+                    budget=config.SLO_BURN_BUDGET),
+                source=config.NODE_NAME or "local")
+            self._arm_slo_timer()
 
         # database + buckets ------------------------------------------------
         self.database: Optional[Database] = None
@@ -384,8 +409,25 @@ class Application:
             if self.clock.crank() == 0:
                 time.sleep(0.005)
 
+    def _arm_slo_timer(self) -> None:
+        """Repeating SLO evaluation on the clock loop (VirtualTimer so
+        virtual-time tests crank it deterministically)."""
+        from ..util.clock import VirtualTimer
+        t = VirtualTimer(self.clock)
+
+        def tick() -> None:
+            if self._stopped:
+                return
+            self.slo_tracker.evaluate()
+            t.expires_from_now(self.config.SLO_EVAL_CADENCE_S, tick)
+
+        t.expires_from_now(self.config.SLO_EVAL_CADENCE_S, tick)
+        self._slo_timer = t
+
     def stop(self) -> None:
         self._stopped = True
+        if self._slo_timer is not None:
+            self._slo_timer.cancel()
         if self.lm.native_closer is not None:
             # move ledger authority back to Python (rebuilds buckets and,
             # with a database attached, persists the final LCL durably)

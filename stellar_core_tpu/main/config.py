@@ -125,6 +125,22 @@ class Config:
     # /ll?format=.  "text" = the classic human stream.
     LOG_FORMAT: str = "text"
     WORKER_THREADS: int = 4                  # background bucket merges
+    # Fleet observability plane (ISSUE 16).  NODE_NAME stamps every JSON
+    # log record, flight-event export and /tracespans document with this
+    # node's identity (simulation/fleet provisions "node-N" per node);
+    # "" = unattributed single-node run.
+    NODE_NAME: str = ""
+    # Always-on sampling profiler (util/sampleprof): true starts the
+    # ~67 Hz stack sampler at boot ($STPU_SAMPLEPROF=1 overrides to on).
+    SAMPLEPROF: bool = False
+    # Local SLO burn tracking (util/slo): evaluate the default
+    # objectives against this node's own registry every
+    # SLO_EVAL_CADENCE_S seconds and serve /slo.  0 = off.
+    SLO_EVAL_CADENCE_S: float = 0.0
+    SLO_CLOSE_P99_S: float = 2.0             # close-latency objective
+    SLO_ADMISSION_P99_S: float = 0.5         # admission-latency objective
+    SLO_CATCHUP_RATE: float = 20.0           # ledgers/s replay objective
+    SLO_BURN_BUDGET: float = 0.10            # breach fraction allowed
 
     # -- derived -------------------------------------------------------------
     def network_id(self) -> bytes:
@@ -199,6 +215,9 @@ class Config:
             "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
             "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
             "ADMISSION_MAX_BACKLOG",
+            "NODE_NAME", "SAMPLEPROF", "SLO_EVAL_CADENCE_S",
+            "SLO_CLOSE_P99_S", "SLO_ADMISSION_P99_S", "SLO_CATCHUP_RATE",
+            "SLO_BURN_BUDGET",
         }
         for key, val in raw.items():
             if key in simple:

@@ -1,9 +1,9 @@
 """HTTP admin endpoints (reference: src/main/CommandHandler.{h,cpp}).
 
-Full surface: /info /health /dumpflight /metrics /trace /quorum /peers
-/tx /scp /ll /logrotate /manualclose /bans /unban /connect /droppeer
-/maintenance /clearmetrics /self-check /upgrades
-/surveytopologytimesliced /getsurveyresult /getledgerentry.
+Full surface: /info /health /dumpflight /metrics /trace /tracespans
+/profile /slo /quorum /peers /tx /scp /ll /logrotate /manualclose /bans
+/unban /connect /droppeer /maintenance /clearmetrics /self-check
+/upgrades /surveytopologytimesliced /getsurveyresult /getledgerentry.
 
 /health answers 200 ("ok") or 503 ("degraded", with reasons) — the
 load-balancer probe surface; /dumpflight serves the live post-mortem
@@ -169,9 +169,46 @@ class CommandHandler:
                             self._reply({"metrics": self._snap(app.metrics)})
                     elif url.path == "/trace":
                         from ..util import tracing
-                        doc = self._snap(tracing.to_chrome_trace)
+                        qs = parse_qs(url.query)
+                        slot = _int_param(qs, "slot", default=-1) \
+                            if "slot" in qs else None
+                        doc = self._snap(
+                            lambda: tracing.to_chrome_trace(slot=slot))
                         self._reply_raw(json.dumps(doc).encode(),
                                         "application/json")
+                    elif url.path == "/tracespans":
+                        # incremental cross-node export: marks + finished
+                        # root spans past the caller's watermark, plus a
+                        # fresh clock anchor (util/fleettrace collector)
+                        from ..util import tracing
+                        qs = parse_qs(url.query)
+                        since = _int_param(qs, "since", default=0)
+                        slot = _int_param(qs, "slot", default=-1) \
+                            if "slot" in qs else None
+                        doc = self._snap(
+                            lambda: tracing.tracespans_doc(since,
+                                                           slot=slot))
+                        self._reply_raw(json.dumps(doc).encode(),
+                                        "application/json")
+                    elif url.path == "/profile":
+                        # always-on sampling profiler (util/sampleprof)
+                        from ..util import sampleprof
+                        qs = parse_qs(url.query)
+                        fmt = qs.get("format", ["json"])[0]
+                        prof = sampleprof.profiler()
+                        if fmt == "folded":
+                            self._reply_raw(
+                                (prof.folded() + "\n").encode(),
+                                "text/plain; charset=utf-8")
+                        else:
+                            self._reply(self._snap(prof.snapshot))
+                    elif url.path == "/slo":
+                        tracker = getattr(app, "slo_tracker", None)
+                        if tracker is None:
+                            self._reply({"error": "no SLO tracker "
+                                         "configured"}, 404)
+                        else:
+                            self._reply(self._snap(tracker.report))
                     elif url.path == "/quorum":
                         transitive = parse_qs(url.query).get(
                             "transitive", ["false"])[0] == "true"
@@ -357,7 +394,8 @@ class CommandHandler:
 
 
 _ENDPOINTS = [
-    "/info", "/health", "/dumpflight", "/metrics", "/trace", "/quorum",
+    "/info", "/health", "/dumpflight", "/metrics", "/trace",
+    "/tracespans", "/profile", "/slo", "/quorum",
     "/peers", "/scp", "/tx", "/ll",
     "/logrotate", "/manualclose", "/bans", "/ban", "/unban", "/connect",
     "/droppeer", "/maintenance", "/clearmetrics", "/self-check",

@@ -919,6 +919,16 @@ class ChaosRunner:
                 reason, crash_dir=self.artifact_dir)
         finally:
             eventlog.unregister_bundle_source("chaos")
+        # the merged cross-node trace: every SimNode shares this process,
+        # so the phase-mark buffer splits per node attribution into the
+        # same row-per-node Chrome trace shape the fleet soak emits
+        trace_path = os.path.join(
+            self.artifact_dir,
+            f"chaos-{self.scenario.name}-seed{self.scenario.seed}"
+            "-trace.json")
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        from ..util.fleettrace import merge_local_trace
+        trace_events = merge_local_trace(trace_path)
         artifact = {
             "scenario": self.scenario.name,
             "description": self.scenario.description,
@@ -931,8 +941,9 @@ class ChaosRunner:
             "event_trace": res.event_trace,
             "node_records": res.node_records,
             "crash_bundle": res.crash_bundle_path,
+            "merged_trace": trace_path,
+            "merged_trace_events": trace_events,
         }
-        os.makedirs(self.artifact_dir, exist_ok=True)
         path = os.path.join(
             self.artifact_dir,
             f"chaos-{self.scenario.name}-seed{self.scenario.seed}.json")

@@ -63,7 +63,9 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..util import logging as slog
 from ..util.clock import ClockMode, VirtualClock, monotonic_now
+from ..util.fleettrace import FleetScraper, FleetTraceCollector
 from ..util.process import ProcessManager
+from ..util.slo import SLOTracker, default_objectives
 from .loadgen import SeedAccountPool
 
 log = slog.get("Sim")
@@ -84,6 +86,10 @@ class FleetSLOs:
     max_retracking_s: Optional[float] = 90.0    # kill -> tracking again
     max_roll_node_s: Optional[float] = 60.0     # per-node rolling restart
     min_sustained_tps: Optional[float] = None   # accepted tx/s (soak only)
+    # fleet-wide SLO burn budget: the scraper drives an util/slo tracker
+    # with every node's snapshot; a run whose burn rate exceeds the
+    # budget for any objective fails.  None disables burn tracking.
+    burn_budget: Optional[float] = 0.25
 
 
 # ---------------------------------------------------------------------------
@@ -698,7 +704,20 @@ class Fleet:
         self.partition_pairs: List[Tuple[int, int]] = []
         self._t0 = monotonic_now()
         self._last_sample = 0.0
+        self._last_trace_poll = 0.0
         self._archive_tip_cache: Tuple[float, Optional[int]] = (0.0, None)
+        # observability plane: incremental /tracespans collection into
+        # ONE merged cross-node Chrome trace, plus a /metrics scraper
+        # feeding SLO curves and a fleet-wide burn tracker
+        self.trace_collector = FleetTraceCollector()
+        self.slo_tracker: Optional[SLOTracker] = None
+        if self.slos.burn_budget is not None:
+            self.slo_tracker = SLOTracker(
+                default_objectives(
+                    close_p99_s=self.slos.max_p99_close_s or 2.0,
+                    budget=self.slos.burn_budget),
+                source="fleet")
+        self.scraper: Optional[FleetScraper] = None
 
     # -- provisioning -------------------------------------------------------
     @staticmethod
@@ -750,6 +769,11 @@ class Fleet:
                 # live-close differential cadence rides in every node
                 # config, so every soak spot-checks the native engine
                 "NATIVE_CLOSE_DIFFERENTIAL": self.native_close_differential,
+                # observability plane: stable node name on every span,
+                # flight event and phase mark, plus in-process SLO burn
+                # evaluation on a 1s cadence
+                "NODE_NAME": f"node-{i}",
+                "SLO_EVAL_CADENCE_S": 1.0,
                 "LOG_LEVEL": "INFO",
                 "QUORUM_SET": {"THRESHOLD": self.threshold,
                                "VALIDATORS": validators},
@@ -815,7 +839,23 @@ class Fleet:
         os.environ["STPU_CRASH_DIR"] = self.crash_dir
         for i in range(self.n_nodes):
             self.start_node(i)
+        self.scraper = FleetScraper(
+            {f"node-{i}": self._metrics_fetcher(self.nodes[i])
+             for i in range(self.n_nodes)},
+            tracker=self.slo_tracker).start()
         self.note(f"launched {self.n_nodes} run processes")
+
+    def _metrics_fetcher(self, node: FleetNode):
+        """Scraper fetcher: the node's flat metric registry snapshot;
+        raises when the node is unreachable (killed / mid-restart) so
+        the sweep counts it as a scrape error."""
+        def fetch() -> dict:
+            doc = node.http_json("/metrics", timeout=5.0)
+            if doc is None:
+                raise RuntimeError(
+                    f"node {node.index} /metrics unreachable")
+            return doc.get("metrics", {}).get("registry", {})
+        return fetch
 
     def kill_node(self, index: int) -> None:
         """SIGKILL — the crash shape (rejoin brings it back)."""
@@ -859,6 +899,8 @@ class Fleet:
             output_path=os.path.join(node.workdir, "catchup.log"))
 
     def teardown(self, grace_s: float = 8.0) -> None:
+        if self.scraper is not None:
+            self.scraper.stop()
         self.pm.shutdown(grace_s=grace_s)
         prev = getattr(self, "_prev_crash_dir", None)
         if prev is None:
@@ -938,6 +980,25 @@ class Fleet:
                         f"{seq}: {prev[:16]} -> {h[:16]}")
                 seen[node.index] = h
 
+    def poll_traces(self) -> int:
+        """Incremental /tracespans sweep over live nodes into the trace
+        collector; returns new marks+spans folded in.  An unreachable
+        node keeps what it already contributed."""
+        got = 0
+        for node in self.live_nodes():
+            def fetch(path: str, node=node) -> dict:
+                doc = node.http_json(path, timeout=5.0)
+                if doc is None:
+                    raise RuntimeError(
+                        f"node {node.index} {path} unreachable")
+                return doc
+            try:
+                got += self.trace_collector.poll(
+                    f"node-{node.index}", fetch)
+            except Exception:  # corelint: disable=exception-hygiene -- a killed node must not stop the sweep; its already-collected spans stay in the merge
+                continue
+        return got
+
     def note(self, msg: str) -> None:
         t = round(monotonic_now() - self._t0, 1)
         self.events_log.append({"t_s": t, "event": msg})
@@ -988,6 +1049,9 @@ class Fleet:
             if now - self._last_sample >= 0.25:
                 self._last_sample = now
                 self.sample()
+            if now - self._last_trace_poll >= 1.0:
+                self._last_trace_poll = now
+                self.poll_traces()
             if current is None:
                 if idx >= len(events):
                     break
@@ -1068,6 +1132,13 @@ class Fleet:
         return out
 
     def finalize(self) -> dict:
+        # drain the observability plane while nodes are still up: one
+        # last metric sweep + trace poll so the merged artifact carries
+        # the run's tail
+        if self.scraper is not None:
+            self.scraper.stop()
+            self.scraper.sweep()
+        self.poll_traces()
         compared = self.check_divergence()
         slo = self.slos
         p99 = self.p99_close_s()
@@ -1096,6 +1167,20 @@ class Fleet:
                 and tps < slo.min_sustained_tps:
             self.violation(f"sustained TPS {tps} below "
                            f"{slo.min_sustained_tps}")
+        if self.slo_tracker is not None \
+                and not self.slo_tracker.within_budget():
+            for o in self.slo_tracker.objectives:
+                if self.slo_tracker.burning(o.name):
+                    rate = self.slo_tracker.burn_rate(o.name)
+                    self.violation(
+                        f"SLO burn: objective {o.name} burned "
+                        f"{rate:.0%} of its recent windows "
+                        f"(budget {o.budget:.0%})")
+        trace_path = os.path.join(self.workdir, "fleet-trace.json")
+        trace_events = self.trace_collector.write_merged_trace(trace_path)
+        self.note(f"merged cross-node trace written to {trace_path} "
+                  f"({trace_events} events from "
+                  f"{len(self.trace_collector.nodes())} nodes)")
         report = {
             "passed": not self.violations,
             "violations": list(self.violations),
@@ -1109,6 +1194,14 @@ class Fleet:
             "traffic": self.client.report(),
             "metrics": self.metrics,
             "archive_tip": self.archive_tip(),
+            "observability": {
+                "trace_path": trace_path,
+                "trace_events": trace_events,
+                "trace_nodes": self.trace_collector.nodes(),
+                "clock_offsets_s": self.trace_collector.align_offsets(),
+                "scraper": (self.scraper.report()
+                            if self.scraper is not None else None),
+            },
             "schedule": getattr(self, "_schedule_input", []),
             "events": self.events_log,
             "node_artifacts": [

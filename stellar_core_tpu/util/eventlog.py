@@ -69,6 +69,13 @@ class FlightEvent:
                "partition": self.partition,
                "severity": self.severity,
                "msg": self.msg}
+        # node attribution happens at EXPORT time (zero hot-path cost):
+        # the record path stays on its <2 µs budget and a late
+        # set_node_id() still stamps earlier events correctly for the
+        # common fleet case (id configured once at startup)
+        node = _node_id()
+        if node is not None:
+            out["node"] = node
         if self.fields:
             out["fields"] = _tracing.jsonable_args(self.fields)
         if self.span_id is not None:
@@ -128,6 +135,12 @@ def _known_partitions() -> frozenset:
         from .logging import PARTITIONS
         _partitions = frozenset(PARTITIONS)
     return _partitions
+
+
+def _node_id():
+    # same circular-import constraint as _known_partitions
+    from .logging import node_id
+    return node_id()
 
 
 # counter cached per registry INSTANCE: reset_registry() (tests) swaps
@@ -211,6 +224,7 @@ def flight_bundle(reason: str) -> dict:
     from . import tracing
     bundle = {
         "reason": reason,
+        "node": _node_id(),
         "wall_s": round(wall_now(), 3),
         "mono_s": round(monotonic_now(), 6),
         "thread": threading.current_thread().name,

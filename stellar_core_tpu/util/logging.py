@@ -29,6 +29,23 @@ LOG_FORMATS = ("text", "json")
 _loggers: Dict[str, logging.Logger] = {}
 _configured = False
 _format = "text"
+# fleet-wide attribution: the node name this process (or in-sim node)
+# runs as.  Provisioned per node by simulation/fleet (NODE_NAME config
+# key) and stamped into JSON log records, flight-event exports and
+# rate-limit keys so aggregated soak logs stay attributable.
+_node_id: str | None = None
+
+
+def set_node_id(name: str | None) -> None:
+    """Configure the node name stamped into structured output (JSON log
+    records, flight-event exports, /tracespans documents).  None clears."""
+    global _node_id
+    _node_id = name or None
+
+
+def node_id() -> str | None:
+    """The configured node name, or None when unset (single-node runs)."""
+    return _node_id
 
 _TEXT_FORMATTER = logging.Formatter(
     "%(asctime)s [%(name)s %(levelname)s] %(message)s")
@@ -48,6 +65,8 @@ class JsonFormatter(logging.Formatter):
             "level": rec.levelname,
             "msg": rec.getMessage(),
         }
+        if _node_id is not None:
+            doc["node"] = _node_id
         span_id = tracing.current_span_id()
         if span_id is not None:
             doc["span"] = span_id
@@ -152,7 +171,11 @@ def rate_limited(log: logging.Logger, key: str, every_n: int):
     ``log.debug`` and ``occurrence`` the 1-based count for ``key``.
 
     Replaces hand-rolled every-Nth counters at call sites (the catchup
-    preverify collect-fallback warning was the first)."""
+    preverify collect-fallback warning was the first).  Keys are scoped
+    by the configured node id so in-process multi-node simulations don't
+    share one occurrence counter across nodes."""
+    if _node_id is not None:
+        key = f"{_node_id}:{key}"
     n = _rate_counts.get(key, 0) + 1
     _rate_counts[key] = n
     emit = log.warning if n == 1 or n % every_n == 0 else log.debug
@@ -163,6 +186,8 @@ def discard_rate_limit(key: str) -> None:
     """Drop one key's counter — call when the subsystem that owned the
     key is torn down, so per-instance keys don't accumulate for process
     lifetime."""
+    if _node_id is not None:
+        key = f"{_node_id}:{key}"
     _rate_counts.pop(key, None)
 
 

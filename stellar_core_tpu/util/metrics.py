@@ -134,11 +134,26 @@ CANONICAL_METRICS = frozenset({
     "node.health",
     "eventlog.record.count",
     "log.bridge.records",
+    # fleet observability plane (ISSUE 16): slot phase marks + the
+    # cross-node collector/scraper (util/tracing, util/fleettrace)
+    "fleet.trace.marks",
+    "fleet.trace.merge",
+    "fleet.scrape.polls",
+    "fleet.scrape.errors",
+    # always-on sampling profiler (util/sampleprof)
+    "profile.sampler.samples",
+    "profile.sampler.dropped",
+    "profile.sampler.running",
+    # SLO burn tracking (util/slo)
+    "slo.eval.windows",
+    "slo.burn.flips",
 })
 
 # Prefixes for families whose tail is data-dependent (one meter per overlay
-# message type; one probe counter per bucket-list level).
-CANONICAL_PREFIXES = ("overlay.recv.", "bucketlistdb.probe.")
+# message type; one probe counter per bucket-list level; one burn-rate
+# gauge per declared SLO objective).
+CANONICAL_PREFIXES = ("overlay.recv.", "bucketlistdb.probe.",
+                      "slo.objective.")
 
 
 class Counter:

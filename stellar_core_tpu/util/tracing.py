@@ -64,6 +64,23 @@ _tree_count: contextvars.ContextVar[Optional[list]] = \
 _EPOCH_WALL = wall_now()
 _EPOCH_PERF = time.perf_counter()
 
+# shared export sequence over phase marks AND finished root spans: an
+# incremental consumer (/tracespans?since=) names one watermark and gets
+# exactly the new data of both kinds (GIL-atomic counter)
+_EXPORT_SEQ = itertools.count(1)
+
+# Phase-mark ring capacity: a 5-node soak emits ~6 marks/slot/node; 4096
+# covers hundreds of slots between collector scrapes.
+MARK_BUFFER_MARKS = 4096
+
+
+def clock_anchor() -> dict:
+    """A fresh monotonic↔wall pairing for this process: perf_counter and
+    wall clock sampled back-to-back.  A cross-node collector uses the
+    pair to map each node's perf-epoch timestamps onto one wall timebase
+    (util/fleettrace aligns residual wall skew via matched slot marks)."""
+    return {"perf_s": time.perf_counter(), "wall_s": wall_now()}
+
 # process-unique span ids (GIL-atomic counter).  The id is what a
 # structured log line carries (util/logging LOG_FORMAT=json) so a slow
 # span can be joined against every record it emitted.
@@ -72,7 +89,7 @@ _SPAN_IDS = itertools.count(1)
 
 class Span:
     __slots__ = ("name", "start_s", "dur_s", "args", "children", "tid",
-                 "truncated", "span_id", "parent")
+                 "truncated", "span_id", "parent", "export_seq")
 
     def __init__(self, name: str, args: Optional[Dict] = None,
                  parent: Optional["Span"] = None):
@@ -85,6 +102,7 @@ class Span:
         self.truncated = 0  # children elided past MAX_CHILD_SPANS
         self.span_id = f"{next(_SPAN_IDS):x}"
         self.parent = parent
+        self.export_seq: Optional[int] = None  # set when a root is recorded
 
     def finish(self) -> None:
         self.dur_s = time.perf_counter() - self.start_s
@@ -107,6 +125,7 @@ class TraceBuffer:
         self._lock = make_lock("tracing.buffer")
 
     def record(self, root: Span) -> None:
+        root.export_seq = next(_EXPORT_SEQ)
         with self._lock:
             self._roots.append(root)
 
@@ -124,6 +143,91 @@ _buffer = TraceBuffer()
 
 def trace_buffer() -> TraceBuffer:
     return _buffer
+
+
+# ---------------------------------------------------------------------------
+# slot-keyed phase marks: the cross-node lifecycle skeleton
+# ---------------------------------------------------------------------------
+
+class PhaseMark:
+    """One point on a slot's lifecycle: admission-flush, tx-flood,
+    nominate, externalize, close-seal, checkpoint-publish.  Cheap (one
+    object + two clock reads), node-attributed at record time so an
+    in-process multi-node simulation can still split marks per node."""
+    __slots__ = ("seq", "phase", "slot", "perf_s", "wall_s", "node",
+                 "tid", "args")
+
+    def __init__(self, phase: str, slot: int, node: Optional[str],
+                 args: Optional[Dict]):
+        self.seq = next(_EXPORT_SEQ)
+        self.phase = phase
+        self.slot = slot
+        self.perf_s = time.perf_counter()
+        self.wall_s = wall_now()
+        self.node = node
+        self.tid = threading.get_ident()
+        self.args = args or None
+
+    def to_dict(self) -> dict:
+        out = {"seq": self.seq, "phase": self.phase, "slot": self.slot,
+               "perf_s": self.perf_s, "wall_s": round(self.wall_s, 6)}
+        if self.node is not None:
+            out["node"] = self.node
+        if self.args:
+            out["args"] = jsonable_args(self.args)
+        return out
+
+
+class MarkBuffer:
+    """Bounded ring of PhaseMarks (newest kept)."""
+
+    def __init__(self, maxlen: int = MARK_BUFFER_MARKS):
+        self._marks: deque = deque(maxlen=maxlen)
+        self._lock = make_lock("tracing.marks")
+
+    def record(self, mark: PhaseMark) -> None:
+        with self._lock:
+            self._marks.append(mark)
+
+    def marks(self) -> List[PhaseMark]:
+        with self._lock:
+            return list(self._marks)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._marks.clear()
+
+
+_marks = MarkBuffer()
+
+# counter cached per registry INSTANCE (same pattern as eventlog.record):
+# reset_registry() in tests swaps the registry, so the identity check
+# re-resolves the cached counter at one `is` per mark
+_mark_counter_box: list = [None, None]
+
+
+def mark_buffer() -> MarkBuffer:
+    return _marks
+
+
+def mark_phase(phase: str, slot: int, node: Optional[str] = None,
+               **args) -> PhaseMark:
+    """Record a slot-keyed lifecycle mark.  ``node`` defaults to the
+    process node id (util/logging.set_node_id); in-process simulations
+    pass it explicitly so one process can attribute marks to many
+    nodes."""
+    if node is None:
+        from . import logging as _slog  # lazy: logging imports tracing
+        node = _slog.node_id()
+    mark = PhaseMark(phase, slot, node, args or None)
+    _marks.record(mark)
+    from .metrics import registry as _registry
+    reg = _registry()
+    if _mark_counter_box[0] is not reg:
+        _mark_counter_box[0] = reg
+        _mark_counter_box[1] = reg.counter("fleet.trace.marks")
+    _mark_counter_box[1].inc()
+    return mark
 
 
 @contextlib.contextmanager
@@ -229,14 +333,87 @@ def _emit(events: List[dict], s: Span, pid: int) -> None:
         _emit(events, c, pid)
 
 
+_SLOT_ARG_KEYS = ("slot", "seq", "ledger", "checkpoint")
+
+
+def _tree_mentions_slot(s: Span, slot: int) -> bool:
+    if s.args:
+        for k in _SLOT_ARG_KEYS:
+            if s.args.get(k) == slot:
+                return True
+    return any(_tree_mentions_slot(c, slot) for c in s.children)
+
+
 def to_chrome_trace(roots: Optional[List[Span]] = None,
-                    pid: int = 1) -> dict:
+                    pid: int = 1,
+                    slot: Optional[int] = None) -> dict:
     """The trace buffer (or explicit roots) as a Chrome trace-event JSON
-    document — load it in chrome://tracing or ui.perfetto.dev."""
+    document — load it in chrome://tracing or ui.perfetto.dev.  With
+    ``slot``, only root trees mentioning that slot/seq in any span's args
+    are emitted (the /trace?slot=N view of one ledger's close)."""
     events: List[dict] = []
     for root in (roots if roots is not None else _buffer.roots()):
+        if slot is not None and not _tree_mentions_slot(root, slot):
+            continue
         _emit(events, root, pid)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def mark_chrome_events(marks: List[PhaseMark], pid: int = 1,
+                       wall_offset_s: float = 0.0,
+                       anchor: Optional[dict] = None) -> List[dict]:
+    """Phase marks as Chrome instant events ("i", thread scope).  When
+    ``anchor`` (a clock_anchor() dict from the emitting process) is
+    given, each mark's perf timestamp is mapped through it onto the wall
+    timebase; otherwise the process-local epoch applies.
+    ``wall_offset_s`` shifts the result (fleettrace skew correction)."""
+    events: List[dict] = []
+    for m in marks:
+        if anchor is not None:
+            wall = anchor["wall_s"] + (m.perf_s - anchor["perf_s"])
+        else:
+            wall = _EPOCH_WALL + (m.perf_s - _EPOCH_PERF)
+        ev = {"name": f"{m.phase}@{m.slot}",
+              "ph": "i", "s": "t",
+              "ts": round((wall + wall_offset_s) * 1e6, 3),
+              "pid": pid, "tid": m.tid,
+              "cat": "mark",
+              "args": {"slot": m.slot, "phase": m.phase}}
+        if m.node is not None:
+            ev["args"]["node"] = m.node
+        if m.args:
+            ev["args"].update(jsonable_args(m.args))
+        events.append(ev)
+    return events
+
+
+def tracespans_doc(since: int = 0,
+                   slot: Optional[int] = None) -> dict:
+    """The /tracespans?since=N incremental export: everything recorded
+    after watermark ``since`` — phase marks (raw dicts, perf+wall
+    stamped) and finished root spans (Chrome events) — plus a FRESH
+    clock anchor and the node id, so a cross-node collector can align
+    this process onto a shared timebase.  ``next_since`` is the new
+    watermark to pass on the next poll."""
+    from . import logging as _slog  # lazy: logging imports tracing
+    marks = [m for m in _marks.marks() if m.seq > since
+             and (slot is None or m.slot == slot)]
+    roots = [r for r in _buffer.roots()
+             if r.export_seq is not None and r.export_seq > since]
+    span_events: List[dict] = []
+    for root in roots:
+        if slot is not None and not _tree_mentions_slot(root, slot):
+            continue
+        _emit(span_events, root, pid=1)
+    next_since = max(
+        [since] + [m.seq for m in marks]
+        + [r.export_seq for r in roots])
+    return {"node": _slog.node_id(),
+            "anchor": clock_anchor(),
+            "epoch": {"wall_s": _EPOCH_WALL, "perf_s": _EPOCH_PERF},
+            "marks": [m.to_dict() for m in marks],
+            "spans": span_events,
+            "next_since": next_since}
 
 
 def dump_trace(path: str, roots: Optional[List[Span]] = None) -> int:

@@ -241,6 +241,21 @@ class TestJsonLogging:
         assert cfg.LOG_FORMAT == "json"
         assert Config().LOG_FORMAT == "text"
 
+    def test_node_id_stamps_json_lines(self):
+        """ISSUE 16: a named node (fleet NODE_NAME) stamps every JSON
+        log line with `node` so interleaved fleet logs attribute."""
+        buf, h = self._capture()
+        slog.set_node_id("node-3")
+        try:
+            slog.get("Ledger").warning("who said this")
+        finally:
+            slog.set_node_id(None)
+            pylog.getLogger("stellar").removeHandler(h)
+        doc = json.loads([ln for ln in buf.getvalue().splitlines()
+                          if "who said this" in ln][0])
+        assert doc["node"] == "node-3"
+        assert slog.node_id() is None
+
 
 # ---------------------------------------------------------------------------
 # rate_limited helper
@@ -264,6 +279,24 @@ class TestRateLimited:
         slog.rate_limited(log, "k1", 10)
         emit, n = slog.rate_limited(log, "k2", 10)
         assert n == 1 and emit == log.warning
+
+    def test_keys_include_node_id(self):
+        """ISSUE 16: the same logical key on different nodes (in-process
+        multi-node tests) rate-limits independently, and discard uses
+        the same node-scoped key."""
+        slog.reset_rate_limits()
+        log = slog.get("History")
+        slog.set_node_id("node-a")
+        try:
+            slog.rate_limited(log, "shared", 10)
+            slog.set_node_id("node-b")
+            emit, n = slog.rate_limited(log, "shared", 10)
+            assert n == 1 and emit == log.warning   # fresh per node
+            slog.discard_rate_limit("shared")
+            emit, n = slog.rate_limited(log, "shared", 10)
+            assert n == 1   # discard removed node-b's counter
+        finally:
+            slog.set_node_id(None)
 
 
 # ---------------------------------------------------------------------------

@@ -242,6 +242,31 @@ class TestFleetEndToEnd:
         for n in range(5):
             assert os.path.exists(
                 os.path.join(str(tmp_path), f"node-{n}", "node.log"))
+        # ISSUE 16 acceptance: finalize() merged every node's phase
+        # marks into ONE Chrome trace on an aligned timebase — one row
+        # per node, the rejoined node's marks against the others' closes
+        obs = report["observability"]
+        assert os.path.exists(obs["trace_path"])
+        events = json.load(open(obs["trace_path"]))["traceEvents"]
+        rows = {e["args"]["name"] for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert {f"node-{n}" for n in range(5)} <= rows
+        assert obs["trace_events"] == len(events)
+        marks = [e for e in events if e.get("ph") == "i"]
+        phases = {e["name"].split("@")[0] for e in marks}
+        assert "close-seal" in phases and "externalize" in phases
+        # clock alignment produced an offset for every scraped node
+        assert set(obs["clock_offsets_s"]) == set(obs["trace_nodes"])
+        assert len(obs["trace_nodes"]) == 5
+        # ISSUE 16 acceptance: the SLO curve section — close p99 as a
+        # time series per node, not an end-of-run point
+        scr = obs["scraper"]
+        assert scr["polls"] > 0
+        close_curves = scr["curves"]["close_p99_s"]
+        assert any(len(series) >= 2 for series in close_curves.values())
+        assert scr["divergence"]["close_p99_s"] is not None
+        # the fleet-wide burn tracker evaluated and stayed in budget
+        assert scr["slo"]["objectives"]["close-p99"]["evaluations"] > 0
 
 
 @pytest.mark.slow

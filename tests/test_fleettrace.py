@@ -1,0 +1,370 @@
+"""Fleet observability plane (ISSUE 16): cross-node trace collection,
+clock-anchor alignment, the merged Chrome trace, the /tracespans
+incremental export, /trace?slot filtering, and the fleet metrics
+scraper (ring bound, SLO curves, divergence deltas).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util import metrics, tracing
+from stellar_core_tpu.util.fleettrace import (ALIGN_PHASE,
+                                              FleetScraper,
+                                              FleetTraceCollector,
+                                              merge_local_trace)
+
+
+@pytest.fixture(autouse=True)
+def _clean_buffers():
+    metrics.reset_registry()
+    tracing.trace_buffer().clear()
+    tracing.mark_buffer().clear()
+    yield
+    tracing.trace_buffer().clear()
+    tracing.mark_buffer().clear()
+
+
+def _node_doc(node, slots, skew_s, base_wall=1_700_000_000.0,
+              base_perf=50_000.0):
+    """A synthetic /tracespans document for a node whose WALL clock is
+    skewed by ``skew_s`` from true time.  Phase marks for each slot:
+    externalize at true time base+5*slot, close-seal 30ms later."""
+    anchor = {"perf_s": base_perf, "wall_s": base_wall + skew_s}
+    marks = []
+    seq = 0
+    for slot in slots:
+        for phase, off in ((ALIGN_PHASE, 0.0), ("close-seal", 0.030)):
+            seq += 1
+            true_s = 5.0 * slot + off
+            marks.append({
+                "seq": seq, "phase": phase, "slot": slot,
+                # perf clock is per-node but drift-free: the anchor maps
+                # it onto the node's (skewed) wall clock
+                "perf_s": base_perf + true_s,
+                "wall_s": base_wall + skew_s + true_s,
+                "node": node, "tid": 1})
+    return {"node": node, "anchor": anchor, "marks": marks,
+            "spans": [], "next_since": seq}
+
+
+class TestClockAlignment:
+    def test_offsets_recover_injected_skew(self):
+        """Nodes whose wall clocks disagree by seconds still merge onto
+        one timebase: the externalize-mark median delta IS the skew."""
+        coll = FleetTraceCollector()
+        skews = {"node-0": 0.0, "node-1": 2.5, "node-2": -1.75}
+        for node, skew in skews.items():
+            coll.ingest(node, _node_doc(node, range(2, 12), skew))
+        offsets = coll.align_offsets()
+        # node-0 is the reference (first sorted): offset 0 by definition
+        assert offsets["node-0"] == 0.0
+        # a node whose clock reads AHEAD needs a negative correction
+        assert offsets["node-1"] == pytest.approx(-2.5, abs=1e-6)
+        assert offsets["node-2"] == pytest.approx(1.75, abs=1e-6)
+
+    def test_aligned_marks_order_correctly_across_nodes(self):
+        """After alignment, slot N's marks on every node sit together on
+        the merged timeline even with multi-second wall skew — slot
+        ordering survives, which is the property the merged trace
+        exists to show."""
+        coll = FleetTraceCollector()
+        for node, skew in (("node-0", 0.0), ("node-1", 7.0)):
+            coll.ingest(node, _node_doc(node, range(2, 8), skew))
+        doc = coll.merge_chrome_trace()
+        marks = [e for e in doc["traceEvents"] if e.get("cat") == "mark"]
+        by_slot = {}
+        for e in marks:
+            by_slot.setdefault(e["args"]["slot"], []).append(e["ts"])
+        slots = sorted(by_slot)
+        for a, b in zip(slots, slots[1:]):
+            # every mark of slot a precedes every mark of slot b (slots
+            # are 5s apart; unaligned 7s skew would interleave them)
+            assert max(by_slot[a]) < min(by_slot[b])
+
+    def test_no_shared_slots_means_zero_offset(self):
+        coll = FleetTraceCollector()
+        coll.ingest("node-0", _node_doc("node-0", [2, 3], 0.0))
+        coll.ingest("node-1", _node_doc("node-1", [50, 51], 3.0))
+        assert coll.align_offsets()["node-1"] == 0.0
+
+
+class TestMergedTrace:
+    def test_one_process_row_per_node(self):
+        """Node identity: each node gets its own pid with a process_name
+        metadata row, and every one of its events carries that pid."""
+        coll = FleetTraceCollector()
+        for i in range(3):
+            coll.ingest(f"node-{i}",
+                        _node_doc(f"node-{i}", [2, 3], 0.1 * i))
+        doc = coll.merge_chrome_trace()
+        names = {e["args"]["name"]: e["pid"]
+                 for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert sorted(names) == ["node-0", "node-1", "node-2"]
+        assert len(set(names.values())) == 3
+        for e in doc["traceEvents"]:
+            if e.get("cat") == "mark":
+                assert names[e["args"]["node"]] == e["pid"]
+        assert doc["metadata"]["nodes"] == ["node-0", "node-1", "node-2"]
+
+    def test_slot_flow_arrows_span_nodes(self):
+        """Each slot seen on >1 node gets a flow (s ... t ... f) chain
+        whose endpoints live on different pids — the slot-spanning
+        arrow in the rendered trace."""
+        coll = FleetTraceCollector()
+        for i in range(2):
+            coll.ingest(f"node-{i}",
+                        _node_doc(f"node-{i}", [2, 3, 4], 0.0))
+        doc = coll.merge_chrome_trace()
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "slot-flow"]
+        assert flows
+        for slot in (2, 3, 4):
+            chain = sorted((e for e in flows if e["id"] == slot),
+                           key=lambda e: e["ts"])
+            assert chain[0]["ph"] == "s"
+            assert chain[-1]["ph"] == "f"
+            assert chain[-1]["bp"] == "e"
+            assert len({e["pid"] for e in chain}) == 2
+
+    def test_collector_side_node_name_wins(self):
+        """A node misconfigured with a duplicate self-reported id must
+        not silently merge rows: the collector keys by ITS name."""
+        coll = FleetTraceCollector()
+        doc = _node_doc("liar", [2], 0.0)
+        coll.ingest("node-0", doc)
+        coll.ingest("node-1", _node_doc("liar", [2], 0.0))
+        assert coll.nodes() == ["node-0", "node-1"]
+
+    def test_incremental_since_watermark(self):
+        coll = FleetTraceCollector()
+        docs = {"n": _node_doc("n", [2, 3], 0.0)}
+        calls = []
+
+        def fetch(path):
+            calls.append(path)
+            return docs["n"]
+
+        coll.poll("n", fetch)
+        assert calls[-1] == "/tracespans?since=0"
+        assert coll.since("n") == docs["n"]["next_since"]
+        coll.poll("n", fetch)
+        assert calls[-1] == f"/tracespans?since={docs['n']['next_since']}"
+
+    def test_merge_local_trace_splits_in_process_nodes(self, tmp_path):
+        """Chaos shape: ONE process, marks attributed to many nodes —
+        the local merge splits them into per-node rows."""
+        for i, node in enumerate(("alpha", "beta")):
+            for slot in (2, 3):
+                tracing.mark_phase("externalize", slot, node=node)
+        path = tmp_path / "chaos-trace.json"
+        n = merge_local_trace(str(path))
+        assert n > 0
+        doc = json.loads(path.read_text())
+        rows = sorted(e["args"]["name"] for e in doc["traceEvents"]
+                      if e["ph"] == "M" and e["name"] == "process_name")
+        assert rows == ["alpha", "beta"]
+
+    def test_merge_timer_metric_records(self):
+        coll = FleetTraceCollector()
+        coll.ingest("n", _node_doc("n", [2], 0.0))
+        coll.merge_chrome_trace()
+        snap = metrics.registry().snapshot()
+        assert snap["fleet.trace.merge"]["count"] >= 1
+
+
+class TestMarkPhase:
+    def test_mark_ring_is_bounded(self):
+        for i in range(tracing.MARK_BUFFER_MARKS + 100):
+            tracing.mark_phase("externalize", i)
+        assert len(tracing.mark_buffer().marks()) \
+            == tracing.MARK_BUFFER_MARKS
+
+    def test_mark_counter_and_node_attribution(self):
+        from stellar_core_tpu.util import logging as slog
+        slog.set_node_id("node-7")
+        try:
+            m = tracing.mark_phase("close-seal", 42, txs=3)
+        finally:
+            slog.set_node_id(None)
+        assert m.node == "node-7"
+        assert m.to_dict()["args"] == {"txs": 3}
+        snap = metrics.registry().snapshot()
+        assert snap["fleet.trace.marks"]["count"] >= 1
+
+    def test_tracespans_doc_incremental(self):
+        tracing.mark_phase("externalize", 2)
+        doc1 = tracing.tracespans_doc(0)
+        assert [m["slot"] for m in doc1["marks"]] == [2]
+        assert doc1["anchor"]["perf_s"] <= time.perf_counter()
+        doc2 = tracing.tracespans_doc(doc1["next_since"])
+        assert doc2["marks"] == [] and doc2["spans"] == []
+        tracing.mark_phase("close-seal", 3)
+        doc3 = tracing.tracespans_doc(doc1["next_since"])
+        assert [m["phase"] for m in doc3["marks"]] == ["close-seal"]
+
+
+class TestFleetScraper:
+    def _mk(self, snaps, ring=5, tracker=None):
+        return FleetScraper(
+            {name: (lambda q=q: q.pop(0) if q else (_ for _ in ())
+                    .throw(RuntimeError("drained")))
+             for name, q in snaps.items()},
+            cadence_s=0.01, ring=ring, tracker=tracker)
+
+    def test_ring_is_bounded(self):
+        snaps = {"a": [{"m": {"value": i}} for i in range(12)]}
+        sc = self._mk(snaps, ring=5)
+        for _ in range(12):
+            sc.sweep()
+        assert len(sc.ring("a")) == 5
+        assert sc.polls == 12
+
+    def test_failed_fetch_counts_error_and_keeps_ring(self):
+        snaps = {"a": [{"m": {"value": 1}}]}
+        sc = self._mk(snaps)
+        sc.sweep()   # ok
+        sc.sweep()   # queue drained -> error
+        assert sc.polls == 1 and sc.errors == 1
+        assert len(sc.ring("a")) == 1
+        snap = metrics.registry().snapshot()
+        assert snap["fleet.scrape.errors"]["count"] == 1
+        assert snap["fleet.scrape.polls"]["count"] == 1
+
+    def test_divergence_delta(self):
+        snaps = {
+            "a": [{"ledger.ledger.close": {"p99_s": 0.10}}],
+            "b": [{"ledger.ledger.close": {"p99_s": 0.45}}],
+        }
+        sc = self._mk(snaps)
+        sc.sweep()
+        d = sc.divergence("ledger.ledger.close", "p99_s")
+        assert d["values"] == {"a": 0.10, "b": 0.45}
+        assert d["delta"] == pytest.approx(0.35)
+
+    def test_curves_time_series(self):
+        snaps = {"a": [{"ledger.ledger.close": {"p99_s": v}}
+                       for v in (0.1, 0.2, 0.3)]}
+        sc = self._mk(snaps)
+        for _ in range(3):
+            sc.sweep()
+        series = sc.curve("ledger.ledger.close", "p99_s")["a"]
+        assert [v for _, v in series] == [0.1, 0.2, 0.3]
+        ts = [t for t, _ in series]
+        assert ts == sorted(ts)
+
+    def test_background_thread_start_stop(self):
+        snaps = {"a": [{"m": {"value": i}} for i in range(1000)]}
+        sc = self._mk(snaps)
+        sc.start()
+        deadline = time.time() + 5.0
+        while sc.polls == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        sc.stop()
+        assert sc.polls > 0
+        assert not any(t.name == "fleet-scraper" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_scraper_drives_slo_tracker(self):
+        from stellar_core_tpu.util.slo import Objective, SLOTracker
+        tracker = SLOTracker([Objective(
+            "close-p99", "ledger.ledger.close", "p99_s",
+            threshold=0.2, budget=0.25, window=8)], source="fleet")
+        snaps = {"a": [{"ledger.ledger.close": {"p99_s": 0.9}}
+                       for _ in range(6)]}
+        sc = self._mk(snaps, tracker=tracker)
+        for _ in range(6):
+            sc.sweep()
+        assert tracker.burning("close-p99")
+        assert "slo" in sc.report()
+
+
+class TestEndpoints:
+    """Round-trips through the live admin HTTP server (the app_http
+    fixture shape from test_observability)."""
+
+    @pytest.fixture()
+    def app_http(self, tmp_path):
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.main.http_admin import CommandHandler
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        metrics.reset_registry()
+        tracing.trace_buffer().clear()
+        tracing.mark_buffer().clear()
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "fleettrace test net",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+            "NODE_NAME": "node-t",
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        http = CommandHandler(app, 0)
+        http.start()
+        app.start()
+        assert clock.crank_until(
+            lambda: app.lm.last_closed_ledger_seq >= 3, timeout=60)
+        try:
+            yield app, clock, http.port
+        finally:
+            http.stop()
+            app.stop()
+            from stellar_core_tpu.util import logging as slog
+            slog.set_node_id(None)
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as r:
+            return r.read(), r.status
+
+    def test_tracespans_roundtrip_and_watermark(self, app_http):
+        app, clock, port = app_http
+        body, status = self._get(port, "/tracespans?since=0")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["node"] == "node-t"
+        assert {"perf_s", "wall_s"} <= set(doc["anchor"])
+        # standalone close loop emitted lifecycle marks, node-stamped
+        assert doc["marks"], "no phase marks from the close loop"
+        assert all(m["node"] == "node-t" for m in doc["marks"])
+        phases = {m["phase"] for m in doc["marks"]}
+        assert "close-seal" in phases
+        nxt = doc["next_since"]
+        body2, _ = self._get(port, f"/tracespans?since={nxt}")
+        assert json.loads(body2)["marks"] == []
+
+    def test_tracespans_slot_filter(self, app_http):
+        app, clock, port = app_http
+        body, _ = self._get(port, "/tracespans?since=0&slot=2")
+        doc = json.loads(body)
+        assert doc["marks"]
+        assert all(m["slot"] == 2 for m in doc["marks"])
+
+    def test_trace_slot_filter(self, app_http):
+        app, clock, port = app_http
+        full = json.loads(self._get(port, "/trace")[0])["traceEvents"]
+        one = json.loads(
+            self._get(port, "/trace?slot=2")[0])["traceEvents"]
+        assert len(one) < len(full)
+        absent = json.loads(
+            self._get(port, "/trace?slot=999999")[0])["traceEvents"]
+        assert absent == []
+
+    @pytest.mark.parametrize("path", [
+        "/tracespans?since=bogus",
+        "/tracespans?since=0&slot=bogus",
+        "/trace?slot=notanint",
+    ])
+    def test_malformed_params_answer_400(self, app_http, path):
+        app, clock, port = app_http
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(port, path)
+        assert ei.value.code == 400
+        assert "error" in json.loads(ei.value.read())

@@ -1,0 +1,203 @@
+"""Always-on sampling profiler (ISSUE 16): start/stop idempotence,
+subsystem attribution, folded-stack export, crash-bundle ride-along,
+and the /profile admin endpoint.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from stellar_core_tpu.util import eventlog, metrics
+from stellar_core_tpu.util.sampleprof import (SamplingProfiler,
+                                              _subsystem_of)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset_registry()
+    yield
+
+
+class TestSubsystemMapping:
+    @pytest.mark.parametrize("path,expected", [
+        ("/root/repo/stellar_core_tpu/ledger/manager.py", "ledger"),
+        ("/x/stellar_core_tpu/util/tracing.py", "util"),
+        ("/x/stellar_core_tpu/herder/admission.py", "herder"),
+        # a module directly under the package roots to its own name
+        ("/x/stellar_core_tpu/testutils.py", "testutils"),
+        ("/usr/lib/python3.11/threading.py", "other"),
+        ("C:\\work\\stellar_core_tpu\\bucket\\fresh.py", "bucket"),
+    ])
+    def test_mapping(self, path, expected):
+        assert _subsystem_of(path) == expected
+
+
+class TestLifecycle:
+    def test_start_stop_idempotent(self):
+        p = SamplingProfiler(hz=200.0)
+        assert p.start() is True
+        try:
+            assert p.start() is False      # already running
+            assert p.running()
+        finally:
+            assert p.stop() is True
+        assert p.stop() is False           # already stopped
+        assert not p.running()
+
+    def test_restart_after_stop(self):
+        p = SamplingProfiler(hz=200.0)
+        p.start()
+        p.stop()
+        assert p.start() is True
+        p.stop()
+
+    def test_sampler_thread_does_not_sample_itself(self):
+        p = SamplingProfiler(hz=500.0)
+        p.start()
+        # burn CPU on this thread so samples land somewhere
+        deadline = time.time() + 1.0
+        while time.time() < deadline and p.snapshot()["samples"] < 5:
+            sum(i * i for i in range(1000))
+        p.stop()
+        snap = p.snapshot()
+        assert snap["samples"] >= 5
+        for row in snap["top_stacks"]:
+            assert "_sample_once" not in row["stack"]
+
+    def test_running_gauge_tracks_state(self):
+        p = SamplingProfiler(hz=200.0)
+        assert metrics.registry().snapshot()[
+            "profile.sampler.running"]["value"] == 0.0
+        p.start()
+        try:
+            assert metrics.registry().snapshot()[
+                "profile.sampler.running"]["value"] == 1.0
+        finally:
+            p.stop()
+
+
+class TestCollection:
+    def _sample_busy(self, p, min_samples=10, timeout=5.0):
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+
+        t = threading.Thread(target=busy, name="busy", daemon=True)
+        t.start()
+        p.start()
+        deadline = time.time() + timeout
+        while time.time() < deadline \
+                and p.snapshot()["samples"] < min_samples:
+            time.sleep(0.01)
+        p.stop()
+        stop.set()
+        t.join(2.0)
+
+    def test_snapshot_shape_and_folded(self):
+        p = SamplingProfiler(hz=500.0)
+        self._sample_busy(p)
+        snap = p.snapshot()
+        assert snap["samples"] >= 10
+        assert snap["hz"] == 500.0
+        assert snap["subsystems"]
+        total = sum(s["samples"] for s in snap["subsystems"].values())
+        assert total == snap["samples"]
+        folded = p.folded()
+        assert folded
+        for line in folded.splitlines():
+            stack, count = line.rsplit(" ", 1)
+            assert ";" in stack or stack  # root-only stacks are legal
+            assert int(count) >= 1
+        # the metric mirrors the in-state sample count
+        assert metrics.registry().snapshot()[
+            "profile.sampler.samples"]["count"] == snap["samples"]
+
+    def test_reset_clears_state(self):
+        p = SamplingProfiler(hz=500.0)
+        self._sample_busy(p)
+        p.reset()
+        snap = p.snapshot()
+        assert snap["samples"] == 0
+        assert snap["subsystems"] == {}
+        assert p.folded() == ""
+
+    def test_crash_bundle_carries_folded_stacks(self, tmp_path):
+        p = SamplingProfiler(hz=500.0)
+        self._sample_busy(p)
+        p.start()   # bundle source registered while running
+        try:
+            path = eventlog.write_crash_bundle(
+                "test crash", crash_dir=str(tmp_path))
+            bundle = json.loads(open(path).read())
+            prof = bundle["profile"]
+            assert prof["samples"] >= 10
+            assert prof["folded"]
+            assert prof["subsystems"]
+        finally:
+            p.stop()
+
+
+class TestSingleton:
+    def test_env_gate(self, monkeypatch):
+        import stellar_core_tpu.util.sampleprof as sp
+        monkeypatch.setattr(sp, "_profiler", None)
+        monkeypatch.setenv("STPU_SAMPLEPROF", "0")
+        assert sp.start_if_configured() is False
+        monkeypatch.setenv("STPU_SAMPLEPROF", "1")
+        monkeypatch.setenv("STPU_SAMPLEPROF_HZ", "250")
+        try:
+            assert sp.start_if_configured() is True
+            assert sp.profiler().hz == 250.0
+            assert sp.start_if_configured() is False  # already on
+        finally:
+            sp.profiler().stop()
+            monkeypatch.setattr(sp, "_profiler", None)
+
+
+class TestProfileEndpoint:
+    @pytest.fixture()
+    def app_http(self):
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.main.config import Config
+        from stellar_core_tpu.main.http_admin import CommandHandler
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "sampleprof test net",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+            "SAMPLEPROF": True,
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        http = CommandHandler(app, 0)
+        http.start()
+        app.start()
+        assert clock.crank_until(
+            lambda: app.lm.last_closed_ledger_seq >= 3, timeout=60)
+        try:
+            yield app, clock, http.port
+        finally:
+            http.stop()
+            app.stop()
+            from stellar_core_tpu.util import sampleprof
+            sampleprof.profiler().stop()
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as r:
+            return r.read(), r.headers.get("Content-Type", "")
+
+    def test_profile_json_and_folded(self, app_http):
+        app, clock, port = app_http
+        body, ctype = self._get(port, "/profile")
+        doc = json.loads(body)
+        assert doc["running"] is True    # SAMPLEPROF config started it
+        assert "subsystems" in doc and "top_stacks" in doc
+        body, ctype = self._get(port, "/profile?format=folded")
+        assert ctype.startswith("text/plain")

@@ -1,0 +1,229 @@
+"""Declarative SLOs with burn-rate tracking (ISSUE 16): objective
+verdicts, the burning latch + flight events, budget assertions, the
+/slo admin endpoint, and Prometheus exposition of every new
+observability metric name.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from stellar_core_tpu.util import eventlog, metrics
+from stellar_core_tpu.util.slo import (Objective, SLOTracker,
+                                       default_objectives)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    metrics.reset_registry()
+    eventlog.event_log().clear()
+    yield
+
+
+def _snap(p99):
+    return {"ledger.ledger.close": {"p99_s": p99}}
+
+
+def _tracker(budget=0.5, window=4, threshold=0.2):
+    return SLOTracker([Objective(
+        "close-p99", "ledger.ledger.close", "p99_s",
+        threshold=threshold, budget=budget, window=window)],
+        source="test")
+
+
+class TestObjective:
+    def test_comparison_directions(self):
+        lat = Objective("l", "m", "f", 1.0, "<=")
+        assert lat.met(1.0) and lat.met(0.5) and not lat.met(1.5)
+        rate = Objective("r", "m", "f", 20.0, ">=")
+        assert rate.met(20.0) and rate.met(99.0) and not rate.met(5.0)
+        with pytest.raises(ValueError):
+            Objective("x", "m", "f", 1.0, "==").met(1.0)
+
+
+class TestBurnTracking:
+    def test_burn_flip_records_flight_event_and_counter(self):
+        t = _tracker(budget=0.5, window=4)
+        for _ in range(2):
+            t.evaluate(_snap(0.1))      # healthy
+        assert not t.burning("close-p99")
+        for _ in range(3):
+            t.evaluate(_snap(0.9))      # breaching
+        assert t.burning("close-p99")
+        assert not t.within_budget()
+        assert t.burn_rate("close-p99") > 0.5
+        events = [e for e in eventlog.event_log().snapshot()
+                  if e["msg"] == "slo burn started"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["partition"] == "Perf"
+        assert ev["severity"] == "WARNING"
+        assert ev["fields"]["objective"] == "close-p99"
+        assert ev["fields"]["source"] == "test"
+        assert metrics.registry().snapshot()[
+            "slo.burn.flips"]["count"] == 1
+
+    def test_burn_clears_when_window_recovers(self):
+        t = _tracker(budget=0.5, window=4)
+        for _ in range(4):
+            t.evaluate(_snap(0.9))
+        assert t.burning("close-p99")
+        for _ in range(4):
+            t.evaluate(_snap(0.05))     # window rolls over to healthy
+        assert not t.burning("close-p99")
+        assert t.within_budget()
+        msgs = [e["msg"] for e in eventlog.event_log().snapshot()
+                if e["msg"].startswith("slo burn")]
+        assert msgs == ["slo burn started", "slo burn cleared"]
+        assert metrics.registry().snapshot()[
+            "slo.burn.flips"]["count"] == 2
+
+    def test_absent_metric_is_skipped_not_breached(self):
+        t = _tracker()
+        out = t.evaluate({"something.else": {"value": 1}})
+        assert out == {}
+        assert t.burn_rate("close-p99") == 0.0
+        assert t.within_budget()
+
+    def test_burn_gauge_exported(self):
+        t = _tracker(budget=0.5, window=4)
+        for _ in range(4):
+            t.evaluate(_snap(0.9))
+        snap = metrics.registry().snapshot()
+        assert snap["slo.objective.close-p99"]["value"] == 1.0
+        assert snap["slo.eval.windows"]["count"] == 4
+
+    def test_report_curve(self):
+        t = _tracker(window=4)
+        for i, v in enumerate((0.1, 0.3, 0.2)):
+            t.evaluate(_snap(v), now=float(i))
+        rep = t.report()
+        obj = rep["objectives"]["close-p99"]
+        assert obj["evaluations"] == 3
+        assert obj["breaches"] == 1
+        assert obj["curve"] == [[0.0, 0.1], [1.0, 0.3], [2.0, 0.2]]
+        assert obj["last_value"] == 0.2
+        assert rep["source"] == "test"
+
+    def test_default_objectives_cover_close_admission_catchup(self):
+        objs = {o.name: o for o in default_objectives()}
+        assert set(objs) == {"close-p99", "admission-p99",
+                             "catchup-rate"}
+        assert objs["close-p99"].metric == "ledger.ledger.close"
+        assert objs["catchup-rate"].comparison == ">="
+
+
+NEW_METRICS = [
+    "fleet.trace.marks", "fleet.trace.merge", "fleet.scrape.polls",
+    "fleet.scrape.errors", "profile.sampler.samples",
+    "profile.sampler.dropped", "profile.sampler.running",
+    "slo.eval.windows", "slo.burn.flips",
+]
+
+
+class TestExposition:
+    def test_every_new_metric_name_is_canonical_and_renders(self):
+        """All ISSUE 16 metric names are registered canonical names and
+        appear in the Prometheus exposition once touched."""
+        from stellar_core_tpu.util.metrics import (CANONICAL_METRICS,
+                                                   CANONICAL_PREFIXES,
+                                                   render_prometheus)
+        for name in NEW_METRICS:
+            assert name in CANONICAL_METRICS, name
+        assert any(p.startswith("slo.objective.")
+                   for p in CANONICAL_PREFIXES)
+        reg = metrics.registry()
+        # touch every name with its proper kind
+        reg.counter("fleet.trace.marks").inc()
+        reg.timer("fleet.trace.merge").update(0.01)
+        reg.counter("fleet.scrape.polls").inc()
+        reg.counter("fleet.scrape.errors").inc()
+        reg.counter("profile.sampler.samples").inc()
+        reg.counter("profile.sampler.dropped").inc()
+        class _Box:
+            value = 1.0
+        box = _Box()
+        reg.weak_gauge("profile.sampler.running", box,
+                       lambda b: b.value)
+        reg.counter("slo.eval.windows").inc()
+        reg.counter("slo.burn.flips").inc()
+        reg.weak_gauge("slo.objective.close-p99", box,
+                       lambda b: b.value)
+        text = render_prometheus(reg.snapshot())
+        for name in NEW_METRICS + ["slo.objective.close-p99"]:
+            prom = name.replace(".", "_").replace("-", "_")
+            assert prom in text, f"{name} missing from exposition"
+
+
+class TestSLOEndpoint:
+    @pytest.fixture()
+    def app_http(self, slo_cadence=1.0):
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.main.config import Config
+        from stellar_core_tpu.main.http_admin import CommandHandler
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "slo test net",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+            "SLO_EVAL_CADENCE_S": slo_cadence,
+            "SLO_CLOSE_P99_S": 10.0,
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        http = CommandHandler(app, 0)
+        http.start()
+        app.start()
+        assert clock.crank_until(
+            lambda: app.lm.last_closed_ledger_seq >= 3, timeout=60)
+        try:
+            yield app, clock, http.port
+        finally:
+            http.stop()
+            app.stop()
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as r:
+            return json.loads(r.read())
+
+    def test_slo_endpoint_reports_objectives(self, app_http):
+        app, clock, port = app_http
+        assert app.slo_tracker is not None
+        doc = self._get(port, "/slo")
+        assert doc["source"] == "local"
+        assert set(doc["objectives"]) == {"close-p99", "admission-p99",
+                                          "catchup-rate"}
+        # the virtual-time crank drove the evaluation timer: the close
+        # objective saw real close latencies and stayed healthy
+        close = doc["objectives"]["close-p99"]
+        assert close["evaluations"] >= 1
+        assert doc["ok"] is True
+
+    def test_slo_endpoint_404_when_unconfigured(self):
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.main.config import Config
+        from stellar_core_tpu.main.http_admin import CommandHandler
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "slo test net 2",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        http = CommandHandler(app, 0)
+        http.start()
+        try:
+            assert app.slo_tracker is None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/slo", timeout=10.0)
+            assert ei.value.code == 404
+        finally:
+            http.stop()
+            app.stop()
