@@ -1,6 +1,6 @@
 .PHONY: native native-live native-asan test lint race metrics obs bucketdb \
 	bucketdb-slow chaos chaos-byz chaos-soak loadgen loadgen-slow \
-	catchup-par catchup-mesh fleet fleet-soak clean
+	catchup-par catchup-mesh fleet fleet-soak soroban clean
 
 native:
 	python setup.py build_ext --inplace
@@ -160,6 +160,17 @@ fleet:
 fleet-soak:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
 		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# Soroban execution subsystem (ISSUE 17): bounded-host metering
+# (budget-exceeded differential: fee charged, state untouched),
+# footprint enforcement fail-stop, TTL extend/restore/eviction,
+# generalized tx sets through nomination and the wire, and the
+# footprint-scheduled parallel-apply campaign — >=50 mixed ledgers with
+# byte-identical bucket-list hashes serial vs parallel, >=4 disjoint
+# clusters applied concurrently in at least one ledger.
+soroban:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_soroban.py -q \
+		-m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # metric-name lint: every name recorded by a simulated ledger close must
 # match layer.subsystem.event and appear in the documented canonical list

@@ -963,6 +963,116 @@ def bench_native_close(time_left_fn):
     }
 
 
+def bench_soroban(time_left_fn):
+    """Soroban execution subsystem (ISSUE 17): mixed-phase close
+    throughput, footprint-parallel speedup vs serial apply (bucket-hash
+    identity asserted), and host metering overhead (metered insns/sec
+    through the `burn` built-in).  CPU-only; deadline-aware like the
+    other sections."""
+    import random as _random
+
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.ledger.manager import LedgerManager
+    from stellar_core_tpu.testutils import (TestAccount, contract_address,
+                                            create_account_op, invoke_op,
+                                            make_soroban_data,
+                                            native_payment_op, network_id)
+    from stellar_core_tpu.soroban.storage import contract_data_key
+
+    nid = network_id("soroban bench")
+    n_ledgers = int(os.environ.get("BENCH_SOROBAN_LEDGERS", "30"))
+    n_accounts = 12
+    classic_per_ledger = 4
+
+    def mk_mgr():
+        mgr = LedgerManager(nid, invariant_manager=None)
+        mgr.start_new_ledger()
+        root_sk = mgr.root_account_secret()
+        ent = mgr.root.get_entry(
+            X.account_key_xdr(root_sk.public_key.ed25519))
+        root = TestAccount(mgr, root_sk, ent.data.value.seqNum)
+        sks = [SecretKey(bytes([70 + i]) * 32) for i in range(n_accounts)]
+        mgr.close_ledger([root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 10 ** 12)
+            for sk in sks])], 1_700_000_000)
+        accts = []
+        for sk in sks:
+            e = mgr.root.get_entry(X.account_key_xdr(sk.public_key.ed25519))
+            accts.append(TestAccount(mgr, sk, e.data.value.seqNum))
+        return mgr, accts
+
+    def run(parallel: bool):
+        mgr, accts = mk_mgr()
+        mgr.soroban_parallel_apply = parallel
+        rng = _random.Random(23)
+        ct = 1_700_000_000
+        t0 = time.perf_counter()
+        for ledger in range(n_ledgers):
+            ct += 5
+            frames = []
+            for _ in range(classic_per_ledger):
+                a = accts[rng.randrange(len(accts))]
+                frames.append(a.tx([native_payment_op(
+                    accts[rng.randrange(len(accts))].account_id,
+                    1000 + rng.randrange(10 ** 6))]))
+            # one invoke per account, each on its own contract: the
+            # write sets are disjoint, so every soroban tx is its own
+            # cluster and the parallel side fans out fully
+            for i, a in enumerate(accts):
+                c = contract_address(i + 1)
+                key = X.SCVal.sym("v")
+                dk = contract_data_key(c, key,
+                                       X.ContractDataDurability.PERSISTENT)
+                sd = make_soroban_data(read_write=[dk])
+                frames.append(a.tx(
+                    [invoke_op(c, "put", [key, X.SCVal.u64(ledger),
+                                          X.SCVal.sym("persistent")])],
+                    fee=1000 + sd.resourceFee, soroban_data=sd))
+            mgr.close_ledger(frames, ct)
+        return n_ledgers / (time.perf_counter() - t0), mgr.lcl_hash
+
+    _stage(f"soroban: serial apply ({n_ledgers} mixed ledgers x "
+           f"{classic_per_ledger}+{n_accounts} txs)...")
+    serial_rate, serial_hash = run(parallel=False)
+    if time_left_fn() < (n_ledgers / serial_rate) * 1.2 + 30:
+        return {"soroban": "PARTIAL(budget, serial side only)",
+                "soroban_serial_ledgers_per_sec": round(serial_rate, 1),
+                "soroban_ledgers": n_ledgers}
+    _stage("soroban: footprint-parallel apply...")
+    par_rate, par_hash = run(parallel=True)
+    assert par_hash == serial_hash, \
+        "footprint-parallel close diverged from serial"
+
+    # metering overhead: one account hammering `burn` — wall time per
+    # metered instruction through the bounded host's budget charging
+    burn_insns = 2_000_000
+    mgr, accts = mk_mgr()
+    c = contract_address(99)
+    sd = make_soroban_data(instructions=burn_insns + 1_000_000)
+    n_burn = 20
+    ct = 1_800_000_000
+    t0 = time.perf_counter()
+    for _ in range(n_burn):
+        ct += 5
+        mgr.close_ledger([accts[0].tx(
+            [invoke_op(c, "burn", [X.SCVal.u64(burn_insns)])],
+            fee=1000 + sd.resourceFee, soroban_data=sd)], ct)
+    burn_wall = time.perf_counter() - t0
+    return {
+        "soroban_serial_ledgers_per_sec": round(serial_rate, 1),
+        "soroban_parallel_ledgers_per_sec": round(par_rate, 1),
+        "soroban_parallel_speedup": round(par_rate / serial_rate, 3),
+        "soroban_hashes_identical": True,
+        "soroban_ledgers": n_ledgers,
+        "soroban_clusters_per_ledger": n_accounts,
+        "soroban_metered_insns_per_sec": round(
+            n_burn * burn_insns / burn_wall, 0),
+        "soroban_metering_us_per_invoke": round(
+            burn_wall / n_burn * 1e6, 1),
+    }
+
+
 def bench_sampleprof(time_left_fn):
     """Observability plane (ISSUE 16): the always-on sampling profiler's
     overhead on a replay-shaped CPU microbench (tx apply + ledger close
@@ -1818,6 +1928,18 @@ def main():
     else:
         extra["native_close"] = "SKIPPED(budget)"
         _stale_fill(extra, "native_close")
+
+    # soroban subsystem (ISSUE 17): mixed-phase close throughput,
+    # footprint-parallel speedup (hash identity asserted) and host
+    # metering overhead — CPU-only
+    if budget_fits("soroban", 90):
+        _stage("soroban bench (CPU-only)...")
+        sb_vals = bench_soroban(time_left)
+        _cache_put("soroban", _merge_last_good("soroban", sb_vals))
+        extra.update(sb_vals)
+    else:
+        extra["soroban"] = "SKIPPED(budget)"
+        _stale_fill(extra, "soroban")
 
     # observability plane (ISSUE 16): sampler overhead (<5% asserted on
     # the apply-path microbench) + merged-trace cost — both CPU-only

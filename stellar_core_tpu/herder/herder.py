@@ -22,6 +22,8 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP, EnvelopeState
+from ..soroban import (decode_tx_set, tx_set_envelopes,
+                       tx_set_previous_hash)
 from ..util import eventlog
 from ..util import logging as slog
 from ..util import tracing
@@ -216,7 +218,7 @@ class Herder(SCPDriver):
         except X.XdrError:
             return False  # unencodable peer tx set == hash mismatch
         try:
-            frames = [self.lm.make_frame(e) for e in txset.txs]
+            frames = [self.lm.make_frame(e) for e in tx_set_envelopes(txset)]
         except Exception:
             # Hash-correct tx set we cannot build frames for: this is a bug
             # (or unsupported tx shape) worth surfacing, not a peer lying.
@@ -336,9 +338,8 @@ class Herder(SCPDriver):
         frames = self.tx_queue.tx_set_frames()
         tracing.mark_phase("nominate", seq, node=self.trace_node(),
                            txs=len(frames))
-        tx_set, tx_set_hash, _ordered = self.lm.make_tx_set(frames)
-        self.pending.add_txset(tx_set_hash, tx_set,
-                               sorted(frames, key=lambda f: f.content_hash()))
+        tx_set, tx_set_hash, ordered = self.lm.make_tx_set_any(frames)
+        self.pending.add_txset(tx_set_hash, tx_set, ordered)
 
         lcl = self.lm.lcl_header
         close_time = max(self.clock.system_now(), lcl.scpValue.closeTime + 1)
@@ -376,7 +377,7 @@ class Herder(SCPDriver):
             return ValidationLevel.MAYBE_VALID
         txset, _frames = got
         if slot_index == next_seq \
-                and txset.previousLedgerHash != self.lm.lcl_hash:
+                and tx_set_previous_hash(txset) != self.lm.lcl_hash:
             return ValidationLevel.INVALID
         for up in sv.upgrades:
             if not self.upgrades.is_valid(up, lcl, nomination=nomination,
@@ -668,8 +669,9 @@ class Herder(SCPDriver):
             return
         for h, blob in self.db.load_txsets():
             try:
-                txset = X.TransactionSet.from_xdr(blob)
-                frames = [self.lm.make_frame(e) for e in txset.txs]
+                txset = decode_tx_set(blob)
+                frames = [self.lm.make_frame(e)
+                          for e in tx_set_envelopes(txset)]
             except Exception:
                 log.warning("dropping undecodable stored txset %s", h.hex())
                 continue

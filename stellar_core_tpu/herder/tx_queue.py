@@ -253,14 +253,33 @@ class TransactionQueue:
                       ) -> List[TransactionFrame]:
         """Candidate tx set under surge pricing: best fee-per-op first,
         trimmed to the ledger operation limit.  Reference:
-        TxSetUtils/TxSetFrame — surge pricing + trimInvalid."""
+        TxSetUtils/TxSetFrame — surge pricing + trimInvalid.
+
+        Soroban txs ride a separate lane (reference: SurgePricingLaneConfig
+        with a dedicated Soroban lane): they are capped by the network
+        config's per-ledger tx count and declared-instruction total, and do
+        NOT consume classic tx-set operations."""
+        from ..soroban import is_soroban_frame, network_config
         header = self.lm.lcl_header
         limit = max_ops if max_ops is not None else header.maxTxSetSize
         # protocol >= 11 counts operations; earlier protocols count txs
         count_ops = header.ledgerVersion >= 11
+        net = network_config()
         out: List[TransactionFrame] = []
         used = 0
+        sb_count = 0
+        sb_insns = 0
         for f in sorted(self.by_hash.values(), key=surge_sort_key):
+            if is_soroban_frame(f):
+                sd = f.soroban_data()
+                insns = int(sd.resources.instructions) if sd is not None else 0
+                if sb_count + 1 > net.ledger_max_tx_count or \
+                        sb_insns + insns > net.ledger_max_instructions:
+                    continue
+                out.append(f)
+                sb_count += 1
+                sb_insns += insns
+                continue
             cost = f.num_operations() if count_ops else 1
             if used + cost > limit:
                 continue

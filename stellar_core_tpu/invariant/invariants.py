@@ -519,10 +519,46 @@ class ConstantProductInvariant(Invariant):
         return None
 
 
+class SorobanStateIsValid(Invariant):
+    """Contract-state/TTL pairing (ISSUE 17): every CONTRACT_DATA or
+    CONTRACT_CODE entry alive after a close must have a live TTL entry
+    (keyHash = sha256 of the data key's XDR), and deleting the data entry
+    must delete its TTL in the same close — a dangling TTL would survive
+    in buckets forever, and a TTL-less entry could never expire."""
+    NAME = "SorobanStateIsValid"
+
+    _DATA_TYPES = (X.LedgerEntryType.CONTRACT_DATA,
+                   X.LedgerEntryType.CONTRACT_CODE)
+
+    def check_on_ledger_close(self, ctx: LedgerCloseContext) -> Optional[str]:
+        from ..crypto.sha import sha256
+        tags = tuple(int(t).to_bytes(4, "big") for t in self._DATA_TYPES)
+        for kb in set(ctx.pre) | set(ctx.post):
+            if not kb.startswith(tags):
+                continue
+            ttl_kb = X.LedgerKey.ttl(X.LedgerKeyTtl(
+                keyHash=sha256(kb))).to_xdr()
+            post = ctx.post.get(kb, ctx.pre.get(kb))
+            ttl = ctx.post_state(ttl_kb)
+            label = kb.hex()[:16]
+            if kb in ctx.post and ctx.post[kb] is None:
+                if ttl is not None:
+                    return (f"contract entry {label} deleted but its TTL "
+                            f"entry survives (liveUntil="
+                            f"{ttl.data.value.liveUntilLedgerSeq})")
+            elif post is not None:
+                if ttl is None:
+                    return f"live contract entry {label} has no TTL entry"
+                if ttl.data.value.liveUntilLedgerSeq <= 0:
+                    return (f"contract entry {label} has non-positive "
+                            f"liveUntilLedgerSeq")
+        return None
+
+
 ALL_INVARIANTS = (LedgerEntryIsValid, AccountSubEntriesCountIsValid,
                   ConservationOfLumens, LiabilitiesMatchOffers,
                   SponsorshipCountIsValid, ConstantProductInvariant,
-                  BucketListIsConsistentWithDatabase)
+                  SorobanStateIsValid, BucketListIsConsistentWithDatabase)
 
 
 class InvariantManager:

@@ -210,12 +210,16 @@ class LedgerTxn(AbstractLedgerTxnParent):
     explicitly; falling out of scope without commit == rollback (matches the
     reference's destructor behavior)."""
 
+    # Instances are thread-confined: the soroban cluster workers each build
+    # a PRIVATE LedgerTxn chain, and their only reach into the shared close
+    # ltx goes through _ClusterBase, which serializes on the
+    # soroban.cluster-read lock (see soroban/scheduler.py).
     def __init__(self, parent: AbstractLedgerTxnParent):
         self._parent = parent
-        self._delta: Dict[bytes, Optional[LedgerEntry]] = {}
-        self._header: Optional[LedgerHeader] = None
-        self._child: Optional[LedgerTxn] = None
-        self._open = True
+        self._delta: Dict[bytes, Optional[LedgerEntry]] = {}  # corelint: owned-by=instance-thread -- per-instance; cross-thread reads serialize at _ClusterBase
+        self._header: Optional[LedgerHeader] = None  # corelint: owned-by=instance-thread -- per-instance; cross-thread reads serialize at _ClusterBase
+        self._child: Optional[LedgerTxn] = None  # corelint: owned-by=instance-thread -- per-instance; cluster chains never span threads
+        self._open = True  # corelint: owned-by=instance-thread -- per-instance; cluster chains never span threads
         parent._attach_child(self)
 
     # -- context manager ----------------------------------------------------

@@ -179,6 +179,13 @@ class LedgerManager:
         # close_ledger routes through the C engine with differential
         # spot-checks; None = classic Python close
         self.native_closer = None
+        # Soroban (ISSUE 17): footprint-parallel apply of the Soroban
+        # phase (serial fallback stays byte-identical); the TTL expiry
+        # index drives archival/eviction at close.  None = unknown (state
+        # was loaded, rebuild lazily on first Soroban activity); {} =
+        # known-empty (fresh chain).
+        self.soroban_parallel_apply = True
+        self._ttl_expiry: Optional[dict] = {}
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -280,6 +287,20 @@ class LedgerManager:
             txs=[f.envelope for f in ordered])
         return tx_set, sha256(tx_set.to_xdr()), ordered
 
+    def make_tx_set_any(self, frames: Sequence[TransactionFrame]):
+        """make_tx_set, upgraded: a set containing Soroban txs becomes a
+        GeneralizedTransactionSet (classic phase + Soroban phase); a
+        pure-classic set keeps the legacy shape (and hash) byte-for-byte."""
+        soroban = [f for f in frames if f.is_soroban()]
+        if not soroban:
+            return self.make_tx_set(frames)
+        from ..soroban.txset import build_generalized_tx_set
+        classic = [f for f in frames if not f.is_soroban()]
+        gts, h = build_generalized_tx_set(self.lcl_hash, classic, soroban)
+        ordered = sorted(classic, key=lambda f: f.content_hash()) \
+            + sorted(soroban, key=lambda f: f.content_hash())
+        return gts, h, ordered
+
     @staticmethod
     def apply_order(frames: Sequence[TransactionFrame]
                     ) -> List[TransactionFrame]:
@@ -344,8 +365,19 @@ class LedgerManager:
         with tracing.span("ledger.close",
                           seq=self.lcl_header.ledgerSeq + 1,
                           txs=len(frames)):
-            return self._close_ledger(frames, close_time, tx_set,
-                                      expected_ledger_hash, stellar_value)
+            try:
+                return self._close_ledger(frames, close_time, tx_set,
+                                          expected_ledger_hash, stellar_value)
+            except BaseException:
+                # a close that dies mid-flight (fail-stop invariant, a bug
+                # surfaced by fuzzing) must not leave its LedgerTxn attached
+                # to the root — the manager would refuse every later close
+                # with "already has an active child" instead of reporting
+                # the real error
+                child = getattr(self.root, "_child", None)
+                if child is not None and getattr(child, "_open", False):
+                    child.rollback()
+                raise
 
     # -- native live close ---------------------------------------------------
     def attach_native_close(self, differential: Optional[int] = None
@@ -373,10 +405,18 @@ class LedgerManager:
                       ) -> ClosedLedgerArtifacts:
         _t0 = time.perf_counter()
         if tx_set is None:
-            tx_set, tx_set_hash, ordered = self.make_tx_set(frames)
+            tx_set, tx_set_hash, ordered = self.make_tx_set_any(frames)
         else:
             tx_set_hash = sha256(tx_set.to_xdr())
-        ordered = self.apply_order(frames)
+        # phase split: classic applies first, then the Soroban phase —
+        # for a pure-classic set this is exactly the legacy apply order
+        soroban_frames = [f for f in frames if f.is_soroban()]
+        if soroban_frames:
+            classic_frames = [f for f in frames if not f.is_soroban()]
+            ordered = self.apply_order(classic_frames) \
+                + self.apply_order(soroban_frames)
+        else:
+            ordered = self.apply_order(frames)
         if stellar_value is not None:
             if stellar_value.txSetHash != tx_set_hash:
                 # fail-stop: committing a header that names a tx set other
@@ -419,14 +459,26 @@ class LedgerManager:
                     f.process_fee_seq_num(fee_ltx)
                     fee_ltx.commit()
 
-        # phase 2: apply
+        # phase 2: apply — classic serially, then the Soroban phase
+        # (footprint-clustered, optionally parallel)
         result_pairs: List[X.TransactionResultPair] = []
+        split = len(ordered) - len(soroban_frames) if soroban_frames \
+            else len(ordered)
         with tracing.span("ledger.tx-apply"):
-            for f in ordered:
+            for f in ordered[:split]:
                 with tracing.span("tx.apply"):
                     res = f.apply(ltx, close_time)
                 result_pairs.append(X.TransactionResultPair(
                     transactionHash=f.content_hash(), result=res))
+            if split < len(ordered):
+                for f, res in self._apply_soroban_phase(
+                        ltx, ordered[split:], close_time, seq):
+                    result_pairs.append(X.TransactionResultPair(
+                        transactionHash=f.content_hash(), result=res))
+
+        # state archival: expired TTLs evict at the close edge (before
+        # the delta is split for the bucket list)
+        self._evict_expired_ttl(ltx, seq)
 
         result_set = X.TransactionResultSet(results=result_pairs)
         header = ltx.load_header()
@@ -446,6 +498,7 @@ class LedgerManager:
         # lastModified at top-level commit time (reference: LedgerTxn
         # shouldUpdateLastModified at the root commit)
         delta = ltx.delta()
+        self._note_soroban_delta(delta)
         pre_entries = {kb: self.root.get_entry(kb) for kb in delta}
         init_entries, live_entries, dead_keys = [], [], []
         for kb, entry in delta.items():
@@ -511,7 +564,19 @@ class LedgerManager:
 
         header_entry = X.LedgerHeaderHistoryEntry(
             hash=self.lcl_hash, header=self.lcl_header)
-        tx_entry = X.TransactionHistoryEntry(ledgerSeq=seq, txSet=tx_set)
+        meta_tx_set = tx_set
+        from ..soroban.txset import is_generalized
+        if is_generalized(tx_set):
+            # history entry: generalized sets ride in ext v1; the legacy
+            # txSet field carries an empty classic set (reference:
+            # TransactionHistoryEntry.ext.generalizedTxSet)
+            meta_tx_set = X.TransactionSet(
+                previousLedgerHash=tx_set.value.previousLedgerHash, txs=[])
+            tx_entry = X.TransactionHistoryEntry(
+                ledgerSeq=seq, txSet=meta_tx_set,
+                ext=X.TransactionHistoryEntryExt.generalizedTxSet(tx_set))
+        else:
+            tx_entry = X.TransactionHistoryEntry(ledgerSeq=seq, txSet=tx_set)
         result_entry = X.TransactionHistoryResultEntry(
             ledgerSeq=seq, txResultSet=result_set)
 
@@ -530,8 +595,129 @@ class LedgerManager:
                            dur_ms=round(dur_s * 1e3, 3))
         _registry().meter("ledger.transaction.apply").mark(len(ordered))
         if self.meta_stream is not None:
-            self._emit_close_meta(header_entry, tx_set, result_pairs)
+            self._emit_close_meta(header_entry, meta_tx_set, result_pairs)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)
+
+    # -- Soroban phase (ISSUE 17) -------------------------------------------
+    def _apply_soroban_phase(self, ltx: LedgerTxn, soroban_ordered,
+                             close_time: int, seq: int):
+        """Apply the Soroban phase: partition into disjoint write-set
+        clusters, apply clusters as parallel batches (serial when the
+        partition is a single cluster or parallel apply is off), and
+        return (frame, result) pairs in canonical order.  Serial and
+        parallel runs are byte-identical — asserted end-to-end by
+        tests/test_soroban.py and the loadgen campaign."""
+        from ..soroban.scheduler import (apply_clusters_parallel,
+                                         cluster_footprints)
+        t0 = time.perf_counter()
+        clusters = cluster_footprints(soroban_ordered)
+        _registry().histogram("soroban.apply.clusters").update(len(clusters))
+        if not self.soroban_parallel_apply or len(clusters) <= 1:
+            out = []
+            for f in soroban_ordered:
+                with tracing.span("tx.apply"):
+                    out.append((f, f.apply(ltx, close_time)))
+        else:
+            positions = {id(f): i for i, f in enumerate(soroban_ordered)}
+            with tracing.span("soroban.parallel-apply",
+                              clusters=len(clusters)):
+                res_map = apply_clusters_parallel(
+                    ltx, clusters,
+                    lambda fr, cltx: fr.apply(cltx, close_time), positions)
+            out = [(f, res_map[id(f)]) for f in soroban_ordered]
+        dur_s = time.perf_counter() - t0
+        _registry().timer("soroban.apply.phase").update(dur_s)
+        _registry().meter("soroban.transaction.apply").mark(
+            len(soroban_ordered))
+        eventlog.record("Ledger", "INFO", "soroban phase applied",
+                        seq=seq, txs=len(soroban_ordered),
+                        clusters=len(clusters),
+                        parallel=bool(self.soroban_parallel_apply
+                                      and len(clusters) > 1),
+                        dur_ms=round(dur_s * 1e3, 3))
+        tracing.mark_phase("soroban-apply", seq, txs=len(soroban_ordered),
+                           clusters=len(clusters))
+        return out
+
+    _TTL_KEY_PREFIX = (9).to_bytes(4, "big")
+    _CONTRACT_KEY_PREFIXES = ((6).to_bytes(4, "big"), (7).to_bytes(4, "big"))
+
+    def _rebuild_ttl_index(self) -> dict:
+        """Full scan rebuild of keyHash → [liveUntil, dataKeyXdr,
+        durability] (loaded/assumed state arrives without one).  Only
+        CONTRACT_DATA/CONTRACT_CODE/TTL keys are decoded — sniffed by
+        the 4-byte LedgerEntryType prefix, so classic-only state pays
+        one pass of byte compares and zero decodes."""
+        idx: dict = {}
+        for kb in self.root.all_keys():
+            prefix = bytes(kb[:4])
+            if prefix in self._CONTRACT_KEY_PREFIXES:
+                key = X.LedgerKey.from_xdr(kb)
+                dur = (key.value.durability
+                       if key.switch == X.LedgerEntryType.CONTRACT_DATA
+                       else X.ContractDataDurability.PERSISTENT)
+                rec = idx.setdefault(sha256(kb), [0, None, None])
+                rec[1], rec[2] = kb, dur
+            elif prefix == self._TTL_KEY_PREFIX:
+                entry = self.root.get_entry(kb)
+                if entry is not None:
+                    kh = bytes(entry.data.value.keyHash)
+                    rec = idx.setdefault(kh, [0, None, None])
+                    rec[0] = int(entry.data.value.liveUntilLedgerSeq)
+        self._ttl_expiry = idx
+        return idx
+
+    def _note_soroban_delta(self, delta) -> None:
+        """Fold one close's delta into the TTL expiry index (no-op for
+        classic-only deltas; index is rebuilt lazily when None)."""
+        idx = self._ttl_expiry
+        if idx is None:
+            return
+        for kb, entry in delta.items():
+            prefix = bytes(kb[:4])
+            if prefix in self._CONTRACT_KEY_PREFIXES:
+                kh = sha256(kb)
+                if entry is None:
+                    idx.pop(kh, None)
+                else:
+                    d = entry.data
+                    dur = (d.value.durability
+                           if d.switch == X.LedgerEntryType.CONTRACT_DATA
+                           else X.ContractDataDurability.PERSISTENT)
+                    rec = idx.setdefault(kh, [0, None, None])
+                    rec[1], rec[2] = kb, dur
+            elif prefix == self._TTL_KEY_PREFIX:
+                if entry is not None:
+                    kh = bytes(entry.data.value.keyHash)
+                    rec = idx.setdefault(kh, [0, None, None])
+                    rec[0] = int(entry.data.value.liveUntilLedgerSeq)
+
+    def _evict_expired_ttl(self, ltx: LedgerTxn, seq: int) -> int:
+        """State archival at the close edge: expired TEMPORARY entries
+        (and their TTL entries) are erased; expired PERSISTENT entries
+        stay put — they read as ENTRY_ARCHIVED until RestoreFootprint.
+        Deterministic: expiry candidates walk in sorted keyHash order."""
+        idx = self._ttl_expiry
+        if idx is None:
+            idx = self._rebuild_ttl_index()
+        if not idx:
+            return 0
+        evicted = 0
+        for kh in sorted(idx):
+            live_until, data_kb, durability = idx[kh]
+            if data_kb is None or live_until >= seq:
+                continue
+            if durability != X.ContractDataDurability.TEMPORARY:
+                continue
+            if ltx.get_entry(data_kb) is not None:
+                ltx.erase(X.LedgerKey.from_xdr(data_kb))
+                evicted += 1
+            ttl_kb = X.LedgerKey.ttl(X.LedgerKeyTtl(keyHash=kh)).to_xdr()
+            if ltx.get_entry(ttl_kb) is not None:
+                ltx.erase(X.LedgerKey.from_xdr(ttl_kb))
+        if evicted:
+            _registry().meter("soroban.ttl.evicted").mark(evicted)
+        return evicted
 
     def close_ledger_synthetic(self, init_entries: Sequence[X.LedgerEntry],
                                close_time: int) -> None:
@@ -559,6 +745,10 @@ class LedgerManager:
         entries = list(init_entries)
         for e in entries:
             e.lastModifiedLedgerSeq = seq
+        # synthetic injections bypass _close_ledger: keep the TTL expiry
+        # index honest for any contract/TTL entries seeded this way
+        self._note_soroban_delta(
+            {X.ledger_entry_key_xdr(e): e for e in entries})
         self.bucket_list.add_batch(seq, self.lcl_header.ledgerVersion,
                                    entries, [], [])
         if self.root.disk_backed:
@@ -722,6 +912,7 @@ class LedgerManager:
         mgr.lcl_hash = bytes.fromhex(lcl_hex)
         mgr.db = database
         mgr.bucket_dir = bucket_dir
+        mgr._ttl_expiry = None   # loaded state: rebuild index lazily
         log.info("resumed at ledger %d (%d entries)",
                  header.ledgerSeq, mgr.root.entry_count())
         return mgr

@@ -114,6 +114,18 @@ class NativeLedgerCloser:
         if self.degraded is not None or not self.bridge.active:
             return mgr._close_ledger_python(frames, close_time, tx_set,
                                             None, stellar_value)
+        # Soroban content (generalized tx set / soroban frames / pending
+        # TTL archival) is Python-only: the C engine neither hosts the
+        # built-in table nor evicts expired entries — fall back EARLY,
+        # before any TransactionHistoryEntry is built from a set shape
+        # the legacy record cannot carry
+        from ..soroban.txset import is_generalized
+        if (tx_set is not None and is_generalized(tx_set)) \
+                or any(f.is_soroban() for f in frames) \
+                or mgr._ttl_expiry is None or mgr._ttl_expiry:
+            return self._fallback_close(frames, close_time, tx_set,
+                                        stellar_value,
+                                        why="soroban content in the tx set")
         _t0 = time.perf_counter()
         if tx_set is None:
             tx_set, tx_set_hash, _ = mgr.make_tx_set(frames)

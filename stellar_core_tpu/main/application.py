@@ -179,6 +179,8 @@ class Application:
             if self.database is not None:
                 self.lm.enable_persistence(self.database, self.bucket_dir)
 
+        self.lm.soroban_parallel_apply = config.SOROBAN_PARALLEL_APPLY
+
         # herder + overlay --------------------------------------------------
         self.herder = Herder(self.clock, self.lm, self.node_secret,
                              config.quorum_set(),

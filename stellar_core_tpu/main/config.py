@@ -141,6 +141,15 @@ class Config:
     SLO_ADMISSION_P99_S: float = 0.5         # admission-latency objective
     SLO_CATCHUP_RATE: float = 20.0           # ledgers/s replay objective
     SLO_BURN_BUDGET: float = 0.10            # breach fraction allowed
+    # Soroban execution subsystem (ISSUE 17).  These override the
+    # process-wide SorobanNetworkConfig (soroban/config.py) — resource
+    # limits live OFF-ledger here, so enabling them never perturbs
+    # genesis or classic ledger hashes.  0 = keep the compiled default.
+    SOROBAN_PARALLEL_APPLY: bool = True      # footprint-clustered apply
+    SOROBAN_TX_MAX_INSTRUCTIONS: int = 0
+    SOROBAN_TX_MAX_MEMORY_BYTES: int = 0
+    SOROBAN_LEDGER_MAX_TX_COUNT: int = 0
+    SOROBAN_LEDGER_MAX_INSTRUCTIONS: int = 0
 
     # -- derived -------------------------------------------------------------
     def network_id(self) -> bytes:
@@ -169,6 +178,20 @@ class Config:
         maintenance — agrees with the network this config describes."""
         from ..history.archive import set_checkpoint_frequency
         set_checkpoint_frequency(self.checkpoint_frequency())
+        overrides = {}
+        if self.SOROBAN_TX_MAX_INSTRUCTIONS:
+            overrides["tx_max_instructions"] = self.SOROBAN_TX_MAX_INSTRUCTIONS
+        if self.SOROBAN_TX_MAX_MEMORY_BYTES:
+            overrides["tx_max_memory_bytes"] = self.SOROBAN_TX_MAX_MEMORY_BYTES
+        if self.SOROBAN_LEDGER_MAX_TX_COUNT:
+            overrides["ledger_max_tx_count"] = self.SOROBAN_LEDGER_MAX_TX_COUNT
+        if self.SOROBAN_LEDGER_MAX_INSTRUCTIONS:
+            overrides["ledger_max_instructions"] = \
+                self.SOROBAN_LEDGER_MAX_INSTRUCTIONS
+        if overrides:
+            from ..soroban import network_config, set_network_config
+            from dataclasses import replace
+            set_network_config(replace(network_config(), **overrides))
 
     def quorum_set(self) -> X.SCPQuorumSet:
         from ..crypto.keys import PublicKey
@@ -218,6 +241,9 @@ class Config:
             "NODE_NAME", "SAMPLEPROF", "SLO_EVAL_CADENCE_S",
             "SLO_CLOSE_P99_S", "SLO_ADMISSION_P99_S", "SLO_CATCHUP_RATE",
             "SLO_BURN_BUDGET",
+            "SOROBAN_PARALLEL_APPLY", "SOROBAN_TX_MAX_INSTRUCTIONS",
+            "SOROBAN_TX_MAX_MEMORY_BYTES", "SOROBAN_LEDGER_MAX_TX_COUNT",
+            "SOROBAN_LEDGER_MAX_INSTRUCTIONS",
         }
         for key, val in raw.items():
             if key in simple:

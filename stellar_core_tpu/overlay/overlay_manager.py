@@ -293,7 +293,7 @@ class OverlayManager:
             self._recv_demand(peer, msg.value.txHashes)
         elif t == MT.GET_TX_SET:
             self._serve_txset(peer, msg.value)
-        elif t == MT.TX_SET:
+        elif t in (MT.TX_SET, MT.GENERALIZED_TX_SET):
             txset = msg.value
             h = sha256(txset.to_xdr())
             self.fetcher.stop_fetch(h)
@@ -402,7 +402,11 @@ class OverlayManager:
         got = self.herder.pending.get_txset(h)
         if got is not None:
             self.stats["txsets_served"] += 1
-            peer.send_message(X.StellarMessage.txSet(got[0]))
+            txset = got[0]
+            if isinstance(txset, X.GeneralizedTransactionSet):
+                peer.send_message(X.StellarMessage.generalizedTxSet(txset))
+            else:
+                peer.send_message(X.StellarMessage.txSet(txset))
         else:
             peer.send_message(X.StellarMessage.dontHave(X.DontHave(
                 type=X.MessageType.GET_TX_SET, reqHash=h)))

@@ -3,7 +3,11 @@
 Reference: src/simulation/LoadGenerator.{h,cpp} — modes: create accounts /
 pay / pretend (we add per-ledger batching identical in spirit to
 generateLoad's txrate pacing, minus the timer loop: callers drive ledgers
-explicitly).  Soroban modes are out of scope (SURVEY.md §2.4).
+explicitly), plus a Soroban invoke mode over the bounded host (ISSUE 17):
+``AdmissionCampaign(soroban_mix=...)`` blends InvokeHostFunction traffic
+into the paced admission stream, and ``SorobanMixCampaign`` closes the
+same seed-derived mixed tx sets under serial AND footprint-parallel
+apply, asserting per-close bucket-list hash identity.
 
 Sustained-ingestion additions (ROADMAP item 3):
 
@@ -252,7 +256,8 @@ class AdmissionCampaign:
                  entry_cache_size: int = 8192,
                  resident_levels: int = 1,
                  install_chunk: int = 20_000,
-                 network_passphrase: str = "admission campaign"):
+                 network_passphrase: str = "admission campaign",
+                 soroban_mix: float = 0.0):
         from ..herder.admission import AdmissionPipeline
         from ..herder.tx_queue import TransactionQueue
         from ..util.clock import ClockMode, VirtualClock
@@ -281,6 +286,12 @@ class AdmissionCampaign:
             self.tx_queue, self.mgr, self.clock, accel=accel,
             batch_size=batch_size, flush_delay_s=flush_delay_s,
             max_backlog=max_backlog)
+        # soroban_mix: fraction of offered txs that are InvokeHostFunction
+        # invokes against a per-account contract (the Soroban traffic-mix
+        # knob, ISSUE 17) — they ride the tx queue's resource-limited
+        # Soroban lane and close as the generalized set's second phase
+        self.soroban_mix = soroban_mix
+        self.soroban_offered = 0
         self.statuses: Dict[str, int] = {}
         self.peak_queue_depth = 0
         self.peak_admission_depth = 0
@@ -290,6 +301,10 @@ class AdmissionCampaign:
         return build_tx(self.nid, self.pool.secret(i), self.pool.next_seq(i),
                         [native_payment_op(self.pool.account_id(j), 100)],
                         fee=100 + self.rng.randrange(200))
+
+    def _soroban_frame(self, i: int):
+        return _soroban_pool_frame(self.nid, self.pool, i,
+                                   self.rng.randrange(2 ** 32))
 
     def _offer(self, n_txs: int, submit_burst: int = 64) -> None:
         """Offer `n_txs` payment txs this round, cranking between bursts
@@ -301,7 +316,11 @@ class AdmissionCampaign:
             for _ in range(burst):
                 i = self.rng.randrange(self.pool.n)
                 j = self.rng.randrange(self.pool.n)
-                frame = self._payment_frame(i, j)
+                if self.rng.random() < self.soroban_mix:
+                    frame = self._soroban_frame(i)
+                    self.soroban_offered += 1
+                else:
+                    frame = self._payment_frame(i, j)
                 res = self.admission.submit(frame)
                 self.statuses[res.code] = self.statuses.get(res.code, 0) + 1
             offered += burst
@@ -347,6 +366,7 @@ class AdmissionCampaign:
             "ledgers": n_ledgers,
             "offered": n_ledgers * offered_per_ledger,
             "applied": applied,
+            "soroban_offered": self.soroban_offered,
             "wall_s": round(wall, 2),
             "sustained_tps": round(applied / wall, 1) if wall else 0.0,
             "statuses": dict(self.statuses),
@@ -374,3 +394,98 @@ class AdmissionCampaign:
 
     def close(self) -> None:
         self.admission.close()
+
+
+def _soroban_pool_frame(nid: bytes, pool: SeedAccountPool, i: int,
+                        value: int):
+    """One InvokeHostFunction frame from pool account `i` against ITS
+    OWN contract (contract id derived from the account index), writing
+    one persistent CONTRACT_DATA key.  Distinct accounts therefore have
+    disjoint write sets — the footprint scheduler can fan them out as
+    separate clusters."""
+    from ..soroban.storage import contract_data_key
+    from ..testutils import contract_address, invoke_op, make_soroban_data
+
+    c = contract_address(1 + (i % 250))
+    key = X.SCVal.sym("v")
+    dk = contract_data_key(c, key, X.ContractDataDurability.PERSISTENT)
+    sd = make_soroban_data(read_write=[dk])
+    op = invoke_op(c, "put", [key, X.SCVal.u64(value),
+                              X.SCVal.sym("persistent")])
+    return build_tx(nid, pool.secret(i), pool.next_seq(i), [op],
+                    fee=1000 + sd.resourceFee, soroban_data=sd)
+
+
+class SorobanMixCampaign:
+    """Mixed classic+Soroban close campaign with serial-vs-parallel
+    hash identity (ISSUE 17 acceptance driver).
+
+    The same seed-derived traffic (payments from a ``SeedAccountPool``
+    interleaved with per-account contract invokes) is closed twice —
+    once with the footprint scheduler disabled (serial apply) and once
+    with it fanning disjoint write-set clusters across threads — and
+    EVERY per-close bucket-list hash must match byte-for-byte.  Each
+    ledger's Soroban phase draws ``soroban_per_ledger`` DISTINCT
+    accounts, so its write sets are disjoint and the parallel side
+    genuinely runs that many concurrent clusters."""
+
+    def __init__(self, n_accounts: int = 8, classic_per_ledger: int = 3,
+                 soroban_per_ledger: int = 5, seed: int = 11,
+                 network_passphrase: str = "soroban mix campaign"):
+        assert soroban_per_ledger <= n_accounts
+        self.nid = sha256(network_passphrase.encode())
+        self.n_accounts = n_accounts
+        self.classic_per_ledger = classic_per_ledger
+        self.soroban_per_ledger = soroban_per_ledger
+        self.seed = seed
+
+    def _run_side(self, n_ledgers: int, parallel: bool) -> dict:
+        from ..soroban import cluster_footprints, is_soroban_frame
+
+        pool = SeedAccountPool(self.n_accounts, seed=self.seed)
+        mgr = LedgerManager(self.nid)
+        mgr.start_new_ledger()
+        mgr.soroban_parallel_apply = parallel
+        lg = LoadGenerator(mgr, seed=self.seed)
+        lg.install_account_pool(pool)
+        rng = random.Random(self.seed * 7919)
+        hashes: List[bytes] = []
+        max_clusters = 0
+        applied = 0
+        t0 = _time.perf_counter()
+        for ledger in range(n_ledgers):
+            frames = []
+            for _ in range(self.classic_per_ledger):
+                i, j = rng.sample(range(pool.n), 2)
+                frames.append(build_tx(
+                    self.nid, pool.secret(i), pool.next_seq(i),
+                    [native_payment_op(pool.account_id(j),
+                                       100 + rng.randrange(10 ** 6))],
+                    fee=100 + rng.randrange(200)))
+            for i in rng.sample(range(pool.n), self.soroban_per_ledger):
+                frames.append(_soroban_pool_frame(
+                    self.nid, pool, i, rng.randrange(2 ** 32)))
+            sb = [f for f in frames if is_soroban_frame(f)]
+            max_clusters = max(max_clusters, len(cluster_footprints(sb)))
+            lg._close(frames)
+            applied += len(frames)
+            hashes.append(bytes(mgr.lcl_header.bucketListHash))
+        return {"hashes": hashes, "lcl": mgr.lcl_hash,
+                "max_clusters": max_clusters, "applied": applied,
+                "wall_s": _time.perf_counter() - t0}
+
+    def run(self, n_ledgers: int = 50) -> dict:
+        serial = self._run_side(n_ledgers, parallel=False)
+        par = self._run_side(n_ledgers, parallel=True)
+        assert serial["hashes"] == par["hashes"], \
+            "footprint-parallel close diverged from serial apply"
+        assert serial["lcl"] == par["lcl"]
+        return {
+            "ledgers": n_ledgers,
+            "applied": serial["applied"],
+            "max_disjoint_clusters": par["max_clusters"],
+            "serial_wall_s": round(serial["wall_s"], 2),
+            "parallel_wall_s": round(par["wall_s"], 2),
+            "hashes_identical": True,
+            "bucket_hashes": serial["hashes"],
+        }

@@ -40,8 +40,8 @@ def build_tx(network_id: bytes, source: SecretKey, seq_num: int,
              memo: Optional[X.Memo] = None,
              time_bounds: Optional[X.TimeBounds] = None,
              extra_signers: Sequence[SecretKey] = (),
-             signers: Optional[Sequence[SecretKey]] = None
-             ) -> TransactionFrame:
+             signers: Optional[Sequence[SecretKey]] = None,
+             soroban_data=None) -> TransactionFrame:
     """Build + sign a v1 envelope (reference: TxTests — transactionFromOps).
     `signers` overrides the signing set entirely (e.g. a multisig tx signed
     only by an added signer, not the master key)."""
@@ -53,6 +53,8 @@ def build_tx(network_id: bytes, source: SecretKey, seq_num: int,
               if time_bounds is not None else X.Preconditions.none()),
         memo=memo if memo is not None else X.Memo.none(),
         operations=list(ops))
+    if soroban_data is not None:
+        tx.ext = X.TransactionExt.sorobanData(soroban_data)
     env = X.TransactionEnvelope.v1(
         X.TransactionV1Envelope(tx=tx, signatures=[]))
     frame = TransactionFrame(network_id, env)
@@ -264,3 +266,55 @@ def asym_org_qmap(n_orgs: int):
         for m in orgs[o]:
             qmap[m] = q
     return qmap
+
+
+# --- Soroban tx builders (reference: src/test/TxTests — sorobanTransactionFrameFromOps)
+
+def contract_address(tag: int) -> "X.SCAddress":
+    """Deterministic contract address from a small integer tag."""
+    return X.SCAddress.contractId(bytes([tag]) * 32)
+
+
+def invoke_op(contract: "X.SCAddress", fname: str,
+              args: Sequence["X.SCVal"], source=None) -> X.Operation:
+    return X.Operation(
+        sourceAccount=_src(source),
+        body=X.OperationBody.invokeHostFunctionOp(X.InvokeHostFunctionOp(
+            hostFunction=X.HostFunction.invokeContract(X.InvokeContractArgs(
+                contractAddress=contract, functionName=fname,
+                args=list(args))))))
+
+
+def extend_ttl_op(extend_to: int, source=None) -> X.Operation:
+    return X.Operation(
+        sourceAccount=_src(source),
+        body=X.OperationBody.extendFootprintTTLOp(X.ExtendFootprintTTLOp(
+            ext=X.ExtensionPoint.v0(), extendTo=extend_to)))
+
+
+def restore_footprint_op(source=None) -> X.Operation:
+    return X.Operation(
+        sourceAccount=_src(source),
+        body=X.OperationBody.restoreFootprintOp(X.RestoreFootprintOp(
+            ext=X.ExtensionPoint.v0())))
+
+
+def make_soroban_data(read_only: Sequence["X.LedgerKey"] = (),
+                      read_write: Sequence["X.LedgerKey"] = (),
+                      instructions: int = 1_000_000,
+                      read_bytes: int = 10_000, write_bytes: int = 10_000,
+                      resource_fee: Optional[int] = None
+                      ) -> "X.SorobanTransactionData":
+    """Resource declaration with a fee that (by default) meets the network
+    minimum for the declared resources."""
+    resources = X.SorobanResources(
+        footprint=X.LedgerFootprint(readOnly=list(read_only),
+                                    readWrite=list(read_write)),
+        instructions=instructions, readBytes=read_bytes,
+        writeBytes=write_bytes)
+    if resource_fee is None:
+        from .soroban import network_config
+        resource_fee = network_config().min_resource_fee(resources)
+    return X.SorobanTransactionData(
+        ext=X.ExtensionPoint.v0(), resources=resources,
+        resourceFee=resource_fee)

@@ -28,6 +28,14 @@ from .utils import (THRESHOLD_HIGH, THRESHOLD_LOW, THRESHOLD_MED,
 
 MAX_SEQ_NUM = 2 ** 63 - 1
 
+SOROBAN_PROTOCOL_VERSION = 20
+
+_SOROBAN_OP_TYPES = frozenset((
+    X.OperationType.INVOKE_HOST_FUNCTION,
+    X.OperationType.EXTEND_FOOTPRINT_TTL,
+    X.OperationType.RESTORE_FOOTPRINT,
+))
+
 
 class TransactionFrame:
     """Wraps a TransactionEnvelope (v0 normalized to v1 view)."""
@@ -129,9 +137,65 @@ class TransactionFrame:
         return self.num_operations() * header.baseFee
 
     def fee_charged(self, header: X.LedgerHeader) -> int:
-        """min(bid, numOps*baseFee) — flat per-op pricing; the reference's
-        surge-priced effective base fee arrives with generalized tx sets."""
-        return min(self.fee_bid, self.min_fee(header))
+        """min(bid, numOps*baseFee) — flat per-op pricing.  A Soroban tx
+        additionally pays its declared resourceFee in full (this repo's
+        model has no refundable-fee split: the declared fee IS the
+        charge, reference's non-refundable portion)."""
+        fee = min(self.fee_bid, self.min_fee(header))
+        sd = self.soroban_data()
+        if sd is not None and self.is_soroban():
+            fee = min(self.fee_bid, self.min_fee(header) + int(sd.resourceFee))
+        return fee
+
+    # -- Soroban views ------------------------------------------------------
+    def soroban_data(self) -> Optional[X.SorobanTransactionData]:
+        """The tx ext's SorobanTransactionData, or None for classic txs."""
+        if self.is_v0:
+            return None
+        ext = self.tx.ext
+        return ext.value if ext.switch == 1 else None
+
+    def is_soroban(self) -> bool:
+        return any(op.body.switch in _SOROBAN_OP_TYPES
+                   for op in self.operations)
+
+    def _soroban_valid(self, header: X.LedgerHeader
+                       ) -> Optional[X.TransactionResultCode]:
+        """Soroban envelope shape + declared-resource validation
+        (reference: TransactionFrame::XDRProvidesValidFee +
+        checkSorobanResourceAndSetError)."""
+        C = X.TransactionResultCode
+        sd = self.soroban_data()
+        if not self.is_soroban():
+            # sorobanData on a classic tx is malformed shape
+            return C.txMALFORMED if sd is not None else None
+        if header.ledgerVersion < SOROBAN_PROTOCOL_VERSION:
+            return C.txNOT_SUPPORTED
+        if self.num_operations() != 1:
+            return C.txMALFORMED      # Soroban txs carry exactly one op
+        if sd is None:
+            return C.txMALFORMED
+        from ..soroban.config import network_config
+        net = network_config()
+        res = sd.resources
+        fp = res.footprint
+        ro = [k.to_xdr() for k in fp.readOnly]
+        rw = [k.to_xdr() for k in fp.readWrite]
+        if len(set(ro)) != len(ro) or len(set(rw)) != len(rw) \
+                or set(ro) & set(rw):
+            return C.txSOROBAN_INVALID
+        if len(ro) + len(rw) > net.tx_max_read_entries \
+                or len(rw) > net.tx_max_write_entries:
+            return C.txSOROBAN_INVALID
+        if int(res.instructions) > net.tx_max_instructions \
+                or int(res.readBytes) > net.tx_max_read_bytes \
+                or int(res.writeBytes) > net.tx_max_write_bytes:
+            return C.txSOROBAN_INVALID
+        if int(sd.resourceFee) < net.min_resource_fee(res):
+            return C.txSOROBAN_INVALID
+        if self.fee_bid < self.min_fee(header) + int(sd.resourceFee):
+            return C.txINSUFFICIENT_FEE
+        return None
 
     # -- validation ---------------------------------------------------------
     def _common_valid(self, ltx: LedgerTxn, close_time: int,
@@ -158,6 +222,9 @@ class TransactionFrame:
                 return C.txTOO_LATE
         if self.fee_bid < self.min_fee(header):
             return C.txINSUFFICIENT_FEE
+        soroban_code = self._soroban_valid(header)
+        if soroban_code is not None:
+            return soroban_code
         if self.seq_num < 0 or self.seq_num > MAX_SEQ_NUM:
             return C.txBAD_SEQ
         acc_entry = ltx.get_entry(
@@ -418,6 +485,9 @@ class FeeBumpTransactionFrame(TransactionFrame):
 
     def time_bounds(self):
         return self.inner.time_bounds()
+
+    def soroban_data(self) -> Optional[X.SorobanTransactionData]:
+        return self.inner.soroban_data()
 
     def signature_payload(self) -> bytes:
         payload = X.TransactionSignaturePayload(

@@ -10,8 +10,9 @@ CreateClaimableBalance, ClaimClaimableBalance, Clawback,
 ClawbackClaimableBalance, SetTrustLineFlags, and the full CAP-33
 sponsorship set (Begin/End/RevokeSponsorship with per-entry and per-signer
 reserve bookkeeping — see sponsorship.py).  Offers, path payments and
-liquidity pools live in offer_exchange.py; Soroban ops return
-opNOT_SUPPORTED (capability gap per SURVEY.md §2.4 — no wasm host).
+liquidity pools live in offer_exchange.py; the Soroban trio
+(InvokeHostFunction / ExtendFootprintTTL / RestoreFootprint) lives in
+soroban/ops.py against the bounded built-in host (no wasm, SURVEY §2.4).
 """
 
 from __future__ import annotations
@@ -1343,3 +1344,6 @@ def register_op_class(op_type: OT, cls) -> None:
 # Offer/path-payment/pool frames register themselves on import (bottom of
 # module to avoid a circular import — offer_ops subclasses OperationFrame).
 from . import offer_ops  # noqa: E402,F401
+# Soroban frames likewise (soroban/ops.py subclasses OperationFrame and
+# registers InvokeHostFunction / ExtendFootprintTTL / RestoreFootprint).
+from ..soroban import ops as _soroban_ops  # noqa: E402,F401

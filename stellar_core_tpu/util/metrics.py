@@ -147,6 +147,18 @@ CANONICAL_METRICS = frozenset({
     # SLO burn tracking (util/slo)
     "slo.eval.windows",
     "slo.burn.flips",
+    # Soroban execution subsystem (ISSUE 17): bounded host, TTL
+    # archival, footprint-clustered parallel apply
+    "soroban.host.invoke",
+    "soroban.host.trap",
+    "soroban.host.budget-exceeded",
+    "soroban.host.cpu-insns",
+    "soroban.ttl.extend",
+    "soroban.ttl.restore",
+    "soroban.ttl.evicted",
+    "soroban.apply.clusters",
+    "soroban.apply.phase",
+    "soroban.transaction.apply",
 })
 
 # Prefixes for families whose tail is data-dependent (one meter per overlay

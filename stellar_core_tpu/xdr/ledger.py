@@ -133,6 +133,10 @@ GeneralizedTransactionSet = xdr_union("GeneralizedTransactionSet", Int32, {
     1: ("v1TxSet", TransactionSetV1),
 })
 
+# public aliases (the soroban tx-set builder constructs components, the
+# ledger manager builds generalized history-entry exts)
+TxSetComponentTxsMaybeDiscountedFee = _TxsMaybeDiscountedFee
+
 # --- history entries ---
 
 _THEExt = xdr_union("TransactionHistoryEntryExt", Int32, {
@@ -145,6 +149,8 @@ TransactionHistoryEntry = xdr_struct("TransactionHistoryEntry", [
     ("txSet", TransactionSet),
     ("ext", _THEExt),
 ], defaults={"ext": lambda: _THEExt.v0()})
+
+TransactionHistoryEntryExt = _THEExt
 
 TransactionResultSet = xdr_struct("TransactionResultSet", [
     ("results", VarArray(TransactionResultPair)),

@@ -80,6 +80,7 @@ MessageType = xdr_enum("MessageType", {
     "GET_SCP_STATE": 12,
     "HELLO": 13,
     "SEND_MORE": 16,
+    "GENERALIZED_TX_SET": 17,
     "FLOOD_ADVERT": 18,
     "FLOOD_DEMAND": 19,
     "SEND_MORE_EXTENDED": 20,
@@ -260,7 +261,7 @@ def _build_stellar_message():
     # here, but xdr/__init__ imports both
     from .scp import SCPEnvelope, SCPQuorumSet
     from .transaction import TransactionEnvelope
-    from .ledger import TransactionSet
+    from .ledger import GeneralizedTransactionSet, TransactionSet
 
     return xdr_union("StellarMessage", MessageType, {
         MessageType.ERROR_MSG: ("error", Error),
@@ -271,6 +272,8 @@ def _build_stellar_message():
         MessageType.PEERS: ("peers", VarArray(PeerAddress, 100)),
         MessageType.GET_TX_SET: ("txSetHash", Uint256),
         MessageType.TX_SET: ("txSet", TransactionSet),
+        MessageType.GENERALIZED_TX_SET:
+            ("generalizedTxSet", GeneralizedTransactionSet),
         MessageType.TRANSACTION: ("transaction", TransactionEnvelope),
         MessageType.GET_SCP_QUORUMSET: ("qSetHash", Uint256),
         MessageType.SCP_QUORUMSET: ("qSet", SCPQuorumSet),

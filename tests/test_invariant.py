@@ -41,7 +41,7 @@ def root(mgr):
 
 def test_enabled_by_default_and_pass_on_normal_close(mgr, root):
     assert mgr.invariants is not None
-    assert len(mgr.invariants.invariants) == 7
+    assert len(mgr.invariants.invariants) == 8
     from stellar_core_tpu.crypto.keys import SecretKey
     dest = SecretKey(b"\x07" * 32)
     mgr.close_ledger([root.tx([create_account_op(
@@ -52,7 +52,7 @@ def test_from_patterns_selects_by_regex():
     m = InvariantManager.from_patterns(["Conservation.*"])
     assert [i.NAME for i in m.invariants] == ["ConservationOfLumens"]
     assert InvariantManager.from_patterns([r"(?!.*)"]).invariants == []
-    assert len(InvariantManager.from_patterns([".*"]).invariants) == 7
+    assert len(InvariantManager.from_patterns([".*"]).invariants) == 8
 
 
 def test_conservation_of_lumens_catches_minting(mgr, root, monkeypatch):

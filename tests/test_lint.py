@@ -492,6 +492,32 @@ class TestMetricRegistry:
             """, ["metric-registry"])
         assert len(rule_hits(rep, "metric-registry")) == 1
 
+    def test_quiet_on_soroban_canonical_names(self, tmp_path):
+        # every metric the Soroban subsystem registers must be canonical
+        rep = lint_src(tmp_path, "m.py", """
+            def f(reg):
+                reg.timer("soroban.host.invoke")
+                reg.meter("soroban.host.trap")
+                reg.meter("soroban.host.budget-exceeded")
+                reg.histogram("soroban.host.cpu-insns")
+                reg.meter("soroban.ttl.extend")
+                reg.meter("soroban.ttl.restore")
+                reg.meter("soroban.ttl.evicted")
+                reg.histogram("soroban.apply.clusters")
+                with scoped_timer("soroban.apply.phase"):
+                    pass
+                reg.meter("soroban.transaction.apply")
+            """, ["metric-registry"])
+        assert not rule_hits(rep, "metric-registry")
+
+    def test_fires_on_unregistered_soroban_name(self, tmp_path):
+        # "soroban." is NOT a blanket canonical prefix: new names must be
+        # added to CANONICAL_METRICS explicitly
+        rep = lint_src(tmp_path, "m.py", """
+            registry().meter("soroban.host.made-up")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 1
+
 
 # ---------------------------------------------------------------------------
 # eventlog-partitions

@@ -1,0 +1,340 @@
+"""Soroban execution subsystem acceptance suite (ISSUE 17).
+
+Covers the bounded host's budget discipline (over-budget → structured
+failure, fee charged, state untouched — differential against the same
+tx with a sufficient budget), footprint enforcement (out-of-footprint
+access fail-stops the TX, never the node, with no crash bundle), TTL
+archival (temp eviction, persistent archive + RestoreFootprint,
+ExtendFootprintTTL), footprint clustering, and the mixed-traffic
+campaign: ≥50 classic+Soroban ledgers closed under serial AND
+footprint-parallel apply with byte-identical bucket-list hashes and at
+least one ledger fanning ≥4 disjoint write-set clusters.
+"""
+
+import os
+from dataclasses import replace
+
+import pytest
+
+from stellar_core_tpu import xdr as X
+from stellar_core_tpu.ledger.manager import LedgerManager
+from stellar_core_tpu.soroban import (cluster_footprints, network_config,
+                                      set_network_config)
+from stellar_core_tpu.soroban.storage import contract_data_key, ttl_key
+from stellar_core_tpu.testutils import (TestAccount, contract_address,
+                                        extend_ttl_op, invoke_op,
+                                        make_soroban_data, native_payment_op,
+                                        network_id, restore_footprint_op)
+
+NID = network_id("soroban test network")
+
+IHC = X.InvokeHostFunctionResultCode
+
+
+@pytest.fixture
+def mgr():
+    m = LedgerManager(NID)
+    m.start_new_ledger()
+    return m
+
+
+@pytest.fixture
+def root(mgr):
+    sk = mgr.root_account_secret()
+    acc = mgr.root.get_entry(
+        X.LedgerKey.account(X.LedgerKeyAccount(
+            accountID=X.AccountID.ed25519(sk.public_key.ed25519))).to_xdr())
+    return TestAccount(mgr, sk, acc.data.value.seqNum)
+
+
+@pytest.fixture
+def short_ttl():
+    """Shrink the TTL floors so archival paths run in a handful of
+    closes instead of 120."""
+    prev = network_config()
+    set_network_config(replace(prev, min_temp_entry_ttl=4,
+                               min_persistent_entry_ttl=6))
+    yield network_config()
+    set_network_config(prev)
+
+
+def _close(mgr, *frames, close_time=None):
+    if close_time is None:
+        close_time = int(mgr.lcl_header.scpValue.closeTime) + 5
+    return mgr.close_ledger(list(frames), close_time)
+
+
+def _result_of(arts, frame):
+    for pair in arts.result_entry.txResultSet.results:
+        if pair.transactionHash == frame.content_hash():
+            return pair.result
+    raise AssertionError("tx not in result set")
+
+
+def _balance(mgr, account_id: X.AccountID) -> int:
+    e = mgr.root.get_entry(X.LedgerKey.account(
+        X.LedgerKeyAccount(accountID=account_id)).to_xdr())
+    return e.data.value.balance
+
+
+def _data_entry(mgr, data_key: X.LedgerKey):
+    return mgr.root.get_entry(data_key.to_xdr())
+
+
+def _ttl_entry(mgr, data_key: X.LedgerKey):
+    return mgr.root.get_entry(ttl_key(data_key).to_xdr())
+
+
+def _put_frame(acct, contract, key, value, durability="persistent",
+               instructions=1_000_000, footprint=None):
+    dur = (X.ContractDataDurability.PERSISTENT
+           if durability == "persistent"
+           else X.ContractDataDurability.TEMPORARY)
+    dk = contract_data_key(contract, key, dur)
+    rw = [dk] if footprint is None else footprint
+    sd = make_soroban_data(read_write=rw, instructions=instructions)
+    return acct.tx([invoke_op(contract, "put",
+                              [key, value, X.SCVal.sym(durability)])],
+                   fee=1000 + sd.resourceFee, soroban_data=sd), dk
+
+
+# ---------------------------------------------------------------------------
+# bounded host: execution + budget discipline
+# ---------------------------------------------------------------------------
+
+class TestBoundedHost:
+    def test_put_writes_entry_and_ttl(self, mgr, root):
+        c = contract_address(1)
+        key = X.SCVal.sym("counter")
+        tx, dk = _put_frame(root, c, key, X.SCVal.u64(7))
+        arts = _close(mgr, tx)
+        res = _result_of(arts, tx)
+        assert res.result.switch == X.TransactionResultCode.txSUCCESS
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_SUCCESS
+        entry = _data_entry(mgr, dk)
+        assert entry.data.value.val.value == 7
+        ttl = _ttl_entry(mgr, dk)
+        assert int(ttl.data.value.liveUntilLedgerSeq) == \
+            mgr.last_closed_ledger_seq + \
+            network_config().min_persistent_entry_ttl - 1
+
+    def test_budget_differential_fee_charged_state_untouched(self, mgr,
+                                                             root):
+        """The SAME invoke succeeds under a sufficient declared budget
+        and yields the structured RESOURCE_LIMIT_EXCEEDED failure under
+        a starved one — full fee charged, state untouched either way
+        the ledger closes."""
+        c = contract_address(2)
+        key = X.SCVal.sym("v")
+        ok, dk = _put_frame(root, c, key, X.SCVal.u64(1))
+        _close(mgr, ok)
+        assert _data_entry(mgr, dk).data.value.val.value == 1
+
+        starved, _ = _put_frame(root, c, key, X.SCVal.u64(2),
+                                instructions=10)
+        before = _balance(mgr, root.account_id)
+        arts = _close(mgr, starved)
+        res = _result_of(arts, starved)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_RESOURCE_LIMIT_EXCEEDED
+        # fee charged in full (structured failure, not a free ride):
+        # the whole resource fee plus the base inclusion fee
+        assert before - _balance(mgr, root.account_id) == \
+            starved.tx.ext.value.resourceFee + 100
+        # state untouched: the first write survives, the second never
+        # landed
+        assert _data_entry(mgr, dk).data.value.val.value == 1
+
+    def test_burn_over_declared_instructions_fails_structured(self, mgr,
+                                                              root):
+        c = contract_address(3)
+        declared = 500_000
+        sd = make_soroban_data(instructions=declared)
+        tx = root.tx([invoke_op(c, "burn", [X.SCVal.u64(declared * 10)])],
+                     fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, tx), tx)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_RESOURCE_LIMIT_EXCEEDED
+
+    def test_out_of_footprint_traps_tx_not_node(self, mgr, root,
+                                                tmp_path, monkeypatch):
+        """A write to a key missing from the declared footprint traps
+        the TX (structured TRAPPED result), the ledger still closes,
+        the node closes the NEXT ledger too, and no crash bundle is
+        written."""
+        crash_dir = str(tmp_path / "crash")
+        monkeypatch.setenv("STPU_CRASH_DIR", crash_dir)
+        c = contract_address(4)
+        undeclared = X.SCVal.sym("sneaky")
+        # footprint declares a DIFFERENT key than the one written
+        decoy = contract_data_key(c, X.SCVal.sym("decoy"),
+                                  X.ContractDataDurability.PERSISTENT)
+        tx, _ = _put_frame(root, c, undeclared, X.SCVal.u64(9),
+                           footprint=[decoy])
+        arts = _close(mgr, tx)
+        res = _result_of(arts, tx)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_TRAPPED
+        assert _data_entry(mgr, contract_data_key(
+            c, undeclared, X.ContractDataDurability.PERSISTENT)) is None
+        # crash-bundle-free recovery: the node keeps closing ledgers
+        pay = root.tx([native_payment_op(root.account_id, 1)])
+        assert _result_of(_close(mgr, pay), pay).result.switch == \
+            X.TransactionResultCode.txSUCCESS
+        assert not os.path.isdir(crash_dir) or not os.listdir(crash_dir)
+
+    def test_explicit_fail_traps(self, mgr, root):
+        c = contract_address(5)
+        sd = make_soroban_data()
+        tx = root.tx([invoke_op(c, "fail", [])],
+                     fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, tx), tx)
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_TRAPPED
+
+
+# ---------------------------------------------------------------------------
+# TTL archival: eviction, archive, restore, extend
+# ---------------------------------------------------------------------------
+
+class TestTtlArchival:
+    def test_temporary_entry_evicted_at_expiry(self, mgr, root, short_ttl):
+        c = contract_address(6)
+        key = X.SCVal.sym("t")
+        tx, dk = _put_frame(root, c, key, X.SCVal.u64(1),
+                            durability="temp")
+        _close(mgr, tx)
+        live_until = int(_ttl_entry(mgr, dk).data.value.liveUntilLedgerSeq)
+        assert live_until == mgr.last_closed_ledger_seq + \
+            short_ttl.min_temp_entry_ttl - 1
+        while mgr.last_closed_ledger_seq <= live_until:
+            _close(mgr)
+        # evicted entirely: data AND its TTL entry
+        assert _data_entry(mgr, dk) is None
+        assert _ttl_entry(mgr, dk) is None
+
+    def test_persistent_archives_then_restores(self, mgr, root, short_ttl):
+        c = contract_address(7)
+        key = X.SCVal.sym("p")
+        tx, dk = _put_frame(root, c, key, X.SCVal.u64(5))
+        _close(mgr, tx)
+        live_until = int(_ttl_entry(mgr, dk).data.value.liveUntilLedgerSeq)
+        while mgr.last_closed_ledger_seq <= live_until:
+            _close(mgr)
+        # archived, not erased: the data entry stays, access reports
+        # ENTRY_ARCHIVED
+        assert _data_entry(mgr, dk) is not None
+        sd = make_soroban_data(read_write=[dk])
+        get = root.tx([invoke_op(c, "get",
+                                 [key, X.SCVal.sym("persistent")])],
+                      fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, get), get)
+        assert res.result.value[0].value.value.switch == \
+            IHC.INVOKE_HOST_FUNCTION_ENTRY_ARCHIVED
+        # RestoreFootprint brings it back to life with a fresh TTL
+        sd = make_soroban_data(read_write=[dk])
+        rest = root.tx([restore_footprint_op()],
+                       fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, rest), rest)
+        assert res.result.switch == X.TransactionResultCode.txSUCCESS
+        assert int(_ttl_entry(mgr, dk).data.value.liveUntilLedgerSeq) == \
+            mgr.last_closed_ledger_seq + \
+            short_ttl.min_persistent_entry_ttl - 1
+        # and the value survived archival
+        sd = make_soroban_data(read_write=[dk])
+        get2 = root.tx([invoke_op(c, "get",
+                                  [key, X.SCVal.sym("persistent")])],
+                       fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, get2), get2)
+        assert res.result.switch == X.TransactionResultCode.txSUCCESS
+
+    def test_extend_footprint_ttl(self, mgr, root, short_ttl):
+        c = contract_address(8)
+        key = X.SCVal.sym("e")
+        tx, dk = _put_frame(root, c, key, X.SCVal.u64(3))
+        _close(mgr, tx)
+        sd = make_soroban_data(read_only=[dk])
+        ext = root.tx([extend_ttl_op(extend_to=40)],
+                      fee=1000 + sd.resourceFee, soroban_data=sd)
+        arts = _close(mgr, ext)
+        assert _result_of(arts, ext).result.switch == \
+            X.TransactionResultCode.txSUCCESS
+        assert int(_ttl_entry(mgr, dk).data.value.liveUntilLedgerSeq) == \
+            mgr.last_closed_ledger_seq + 40
+
+    def test_extend_with_readwrite_footprint_is_malformed(self, mgr, root,
+                                                          short_ttl):
+        c = contract_address(9)
+        key = X.SCVal.sym("m")
+        tx, dk = _put_frame(root, c, key, X.SCVal.u64(3))
+        _close(mgr, tx)
+        sd = make_soroban_data(read_write=[dk])
+        bad = root.tx([extend_ttl_op(extend_to=40)],
+                      fee=1000 + sd.resourceFee, soroban_data=sd)
+        res = _result_of(_close(mgr, bad), bad)
+        assert res.result.switch == X.TransactionResultCode.txFAILED
+        assert res.result.value[0].value.value.switch == \
+            X.ExtendFootprintTTLResultCode.EXTEND_FOOTPRINT_TTL_MALFORMED
+
+
+# ---------------------------------------------------------------------------
+# footprint scheduler: clustering units + the acceptance campaign
+# ---------------------------------------------------------------------------
+
+class TestFootprintScheduler:
+    def test_disjoint_footprints_cluster_separately(self, mgr, root):
+        from stellar_core_tpu.crypto.keys import SecretKey
+        from stellar_core_tpu.testutils import create_account_op
+        sks = [SecretKey(bytes([50 + i]) * 32) for i in range(4)]
+        _close(mgr, root.tx([create_account_op(
+            X.AccountID.ed25519(sk.public_key.ed25519), 10 ** 11)
+            for sk in sks]))
+        accts = []
+        for sk in sks:
+            e = mgr.root.get_entry(X.account_key_xdr(sk.public_key.ed25519))
+            accts.append(TestAccount(mgr, sk, e.data.value.seqNum))
+        key = X.SCVal.sym("k")
+        frames = [
+            _put_frame(a, contract_address(20 + i), key, X.SCVal.u64(i))[0]
+            for i, a in enumerate(accts)]
+        assert len(cluster_footprints(frames)) == 4
+        # same contract key everywhere → one cluster
+        shared = [
+            _put_frame(a, contract_address(30), key, X.SCVal.u64(i))[0]
+            for i, a in enumerate(accts)]
+        assert len(cluster_footprints(shared)) == 1
+        # same SOURCE account → one cluster even with disjoint data keys
+        same_src = [
+            _put_frame(root, contract_address(40 + i), key,
+                       X.SCVal.u64(i))[0]
+            for i in range(3)]
+        assert len(cluster_footprints(same_src)) == 1
+
+    def test_mixed_campaign_50_ledgers_hash_identity(self):
+        """ISSUE 17 acceptance: ≥50 mixed classic+Soroban ledgers,
+        byte-identical bucket-list hashes serial vs footprint-parallel,
+        ≥4 disjoint clusters concurrent in at least one ledger."""
+        from stellar_core_tpu.simulation.loadgen import SorobanMixCampaign
+        rep = SorobanMixCampaign().run(n_ledgers=50)
+        assert rep["ledgers"] == 50
+        assert rep["hashes_identical"] is True
+        assert len(rep["bucket_hashes"]) == 50
+        assert rep["max_disjoint_clusters"] >= 4
+
+    def test_admission_campaign_soroban_mix(self, tmp_path):
+        """The paced admission path carries the Soroban mix end to end:
+        invokes are admitted, surge-priced in their own lane and closed
+        as the generalized set's second phase."""
+        from stellar_core_tpu.simulation.loadgen import AdmissionCampaign
+        camp = AdmissionCampaign(24, seed=3, soroban_mix=0.5)
+        try:
+            rep = camp.run(n_ledgers=5, offered_per_ledger=24)
+        finally:
+            camp.close()
+        assert rep["soroban_offered"] > 0
+        assert rep["applied"] > 0
+        assert rep["statuses"].get("pending", 0) > 0
