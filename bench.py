@@ -444,6 +444,216 @@ def bench_chaos(time_left_fn):
     return vals
 
 
+def bench_transport(time_left_fn):
+    """ISSUE 18 acceptance: the batched-authenticated-transport section.
+    Rows cheapest first under the global deadline:
+
+    1. MAC+codec µs/message at batch sizes {1,4,16,64} — the pure
+       compute saving of one-MAC frames (one HMAC + one splice per run
+       instead of one per message).
+    2. single-message latency floor — a lone message on a batched link
+       rides the run-of-one fast path (classic v0 frame, flushed within
+       the same crank): its loopback round trip must not regress vs an
+       unbatched link (ASSERTED, like the admission floor).
+    3. 51-node flagship campaign wall clock, batched vs unbatched —
+       the faster-or-equal headline row.
+    4. 150-node soak pair (budget-gated): the >=1.5x wall-clock row
+       with both campaigns' safety/liveness verdicts.
+
+    CPU-only: everything here is HMAC + splice + scheduler work."""
+    import logging as _pylogging
+    import struct
+
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.herder.herder import Herder
+    from stellar_core_tpu.ledger.manager import LedgerManager
+    from stellar_core_tpu.overlay import (OverlayManager, frame_encode,
+                                          make_loopback_pair)
+    from stellar_core_tpu.overlay.peer_auth import mac_message
+    from stellar_core_tpu.simulation import chaos as chaos_mod
+    from stellar_core_tpu.simulation.simulation import qset_of
+    from stellar_core_tpu.testutils import network_id
+    from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+    vals = {}
+
+    # --- 1. MAC+codec microbench -------------------------------------
+    _stage("transport MAC+codec microbench...")
+    key = b"\x5a" * 32
+    env = X.SCPEnvelope(
+        statement=X.SCPStatement(
+            nodeID=X.AccountID.ed25519(b"\x11" * 32),
+            slotIndex=12345,
+            pledges=X.SCPStatementPledges.nominate(X.SCPNomination(
+                quorumSetHash=b"\x22" * 32,
+                votes=[b"\x33" * 32], accepted=[b"\x44" * 32]))),
+        signature=b"\x55" * 64)
+    body = X.StellarMessage.envelope(env).to_xdr()
+    reps = 200
+    rows = {}
+    for bs in (1, 4, 16, 64):
+        t0 = time.perf_counter()
+        for r in range(reps):
+            for i in range(bs):
+                mac = mac_message(key, r, body)
+                frame_encode(b"\x00\x00\x00\x00" + struct.pack(">Q", r)
+                             + body + mac)
+        un_us = (time.perf_counter() - t0) / (reps * bs) * 1e6
+        t0 = time.perf_counter()
+        for r in range(reps):
+            payload = struct.pack(">I", bs) + (
+                struct.pack(">I", len(body)) + body) * bs
+            mac = mac_message(key, r, payload)
+            frame_encode(b"\x00\x00\x00\x01" + struct.pack(">Q", r)
+                         + payload + mac)
+        ba_us = (time.perf_counter() - t0) / (reps * bs) * 1e6
+        rows[str(bs)] = {"unbatched_us": round(un_us, 2),
+                         "batched_us": round(ba_us, 2),
+                         "speedup": round(un_us / ba_us, 2)}
+    vals["transport_mac_codec_us_per_msg"] = rows
+
+    # --- 2. single-message latency floor -----------------------------
+    _stage("transport single-message floor (loopback)...")
+    nid = network_id("transport bench net")
+
+    def loopback_pair(batching):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x31" * 32), SecretKey(b"\x32" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        overlays = []
+        for sk, seed in ((sk_a, b"t" * 32), (sk_b, b"u" * 32)):
+            lm = LedgerManager(nid)
+            lm.start_new_ledger()
+            h = Herder(clock, lm, sk, q)
+            overlays.append(OverlayManager(clock, h, nid, sk,
+                                           auth_seed=seed,
+                                           batching=batching))
+        pa, pb = make_loopback_pair(overlays[0], overlays[1])
+        for _ in range(50):
+            clock.crank()
+        assert pa.is_authenticated() and pb.is_authenticated()
+        return clock, pa, pb
+
+    # interleaved rounds + min-of-N per arm: the only stable estimator
+    # for small effects on this workload (PROFILE round 15) — a
+    # sequential cold-first comparison fakes a 1.4x "regression" out of
+    # interpreter warmup
+    floor_m, rounds = 150, 4
+    arms = {}
+    for mode, batching in (("batched", True), ("unbatched", False)):
+        clock, pa, pb = loopback_pair(batching)
+        got = [0]
+        orig = pb.overlay._message_received
+
+        def spy(p, m, body=None, _o=orig, _g=got):
+            _g[0] += 1
+            return _o(p, m, body=body)
+        pb.overlay._message_received = spy
+        arms[mode] = (clock, pa, got)
+
+    def floor_round(mode):
+        clock, pa, got = arms[mode]
+        n0 = got[0]
+        t0 = time.perf_counter()
+        for i in range(floor_m):
+            pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+            clock.crank()
+            clock.crank()
+        wall = time.perf_counter() - t0
+        assert got[0] - n0 >= floor_m, (mode, got[0] - n0)
+        return wall / floor_m * 1e6
+
+    for mode in arms:
+        floor_round(mode)          # warmup round, discarded
+    samples = {m: [] for m in arms}
+    for _ in range(rounds):
+        for mode in ("batched", "unbatched"):
+            samples[mode].append(floor_round(mode))
+    floor = {m: min(s) for m, s in samples.items()}
+    floor_ratio = floor["batched"] / floor["unbatched"]
+    vals["transport_floor_batched_us"] = round(floor["batched"], 1)
+    vals["transport_floor_unbatched_us"] = round(floor["unbatched"], 1)
+    vals["transport_floor_ratio"] = round(floor_ratio, 3)
+    # the no-flush-delay proof is in CRANKS: a lone message on a batched
+    # link must reach the partner within at most one extra crank (the
+    # posted crank-edge flush), never wait on a timer or more traffic
+    cranks = {}
+    for mode in ("batched", "unbatched"):
+        clock, pa, got = arms[mode]
+        n0 = got[0]
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(9999))
+        n = 0
+        while got[0] == n0 and n < 10:
+            clock.crank()
+            n += 1
+        cranks[mode] = n
+    vals["transport_floor_cranks_batched"] = cranks["batched"]
+    vals["transport_floor_cranks_unbatched"] = cranks["unbatched"]
+    assert cranks["batched"] <= cranks["unbatched"] + 1, cranks
+    # the CPU side: run-of-one emits the identical v0 frame, so the only
+    # extra work is one posted flush action — 1.5x bounds that plus
+    # single-core scheduler noise (measured ~1.1-1.2x)
+    assert floor_ratio <= 1.5, (
+        f"single-message latency regressed under batching: "
+        f"{floor['batched']:.1f}µs vs {floor['unbatched']:.1f}µs "
+        f"({floor_ratio:.2f}x > 1.5x)")
+    vals["transport_floor_ok"] = True
+
+    # --- 3. 51-node flagship, both transport modes -------------------
+    prev_level = _pylogging.getLogger("stellar").level
+    _pylogging.getLogger("stellar").setLevel(_pylogging.WARNING)
+    try:
+        est51 = 60.0
+        if time_left_fn() < est51 * 2.5 + 30.0:
+            vals["transport_flagship51"] = "SKIPPED(budget)"
+        else:
+            walls, ok = {}, True
+            for mode, batching in (("batched", True),
+                                   ("unbatched", False)):
+                _stage(f"transport flagship 51-node campaign "
+                       f"({mode})...")
+                sc = chaos_mod.scenario_partition_flap_heal(17, 3)
+                sc.batching = batching
+                t0 = time.perf_counter()
+                res = chaos_mod.run_scenario(sc)
+                walls[mode] = time.perf_counter() - t0
+                ok = ok and res.passed
+                vals[f"transport_flagship51_{mode}_wall_s"] = round(
+                    walls[mode], 1)
+                vals[f"transport_flagship51_{mode}_ledgers"] = \
+                    res.ledgers_closed
+            vals["transport_flagship51_speedup"] = round(
+                walls["unbatched"] / walls["batched"], 2)
+            vals["transport_flagship51_passed"] = ok
+
+        # --- 4. 150-node soak pair (the >=1.5x acceptance row) -------
+        est150 = 240.0
+        if time_left_fn() < est150 * 2 * 1.25 + 60.0:
+            vals["transport_soak150"] = "SKIPPED(budget)"
+        else:
+            walls, ok = {}, True
+            for mode, batching in (("batched", True),
+                                   ("unbatched", False)):
+                _stage(f"transport 150-node soak ({mode})...")
+                sc = chaos_mod.scenario_soak(50, 3)
+                sc.batching = batching
+                t0 = time.perf_counter()
+                res = chaos_mod.run_scenario(sc)
+                walls[mode] = time.perf_counter() - t0
+                ok = ok and res.passed
+                vals[f"transport_soak150_{mode}_wall_s"] = round(
+                    walls[mode], 1)
+                vals[f"transport_soak150_{mode}_ledgers"] = \
+                    res.ledgers_closed
+            vals["transport_soak150_speedup"] = round(
+                walls["unbatched"] / walls["batched"], 2)
+            vals["transport_soak150_passed"] = ok
+    finally:
+        _pylogging.getLogger("stellar").setLevel(prev_level)
+    return vals
+
+
 def bench_admission(time_left_fn):
     """ISSUE 7 acceptance: the sustained-ingestion section.  Three
     measurements, cheapest first under the global deadline:
@@ -1862,6 +2072,18 @@ def main():
     else:
         extra["chaos"] = "SKIPPED(budget)"
         _stale_fill(extra, "chaos")
+
+    # batched authenticated transport (ISSUE 18): MAC/codec microbench,
+    # single-message floor, then the flagship/soak campaign pairs —
+    # each tier budget-gated inside the section
+    if budget_fits("transport", 160):
+        _stage("transport bench (CPU-only)...")
+        tr_vals = bench_transport(time_left)
+        _cache_put("transport", _merge_last_good("transport", tr_vals))
+        extra.update(tr_vals)
+    else:
+        extra["transport"] = "SKIPPED(budget)"
+        _stale_fill(extra, "transport")
 
     # sustained-ingestion section (ISSUE 7): CPU-only like the two above,
     # degrades to floor-only then SKIPPED under the deadline

@@ -200,10 +200,12 @@ class Application:
                 batch_size=config.ADMISSION_BATCH_SIZE,
                 flush_delay_s=config.ADMISSION_FLUSH_DELAY_S,
                 max_backlog=config.ADMISSION_MAX_BACKLOG)
-        self.overlay = OverlayManager(self.clock, self.herder,
-                                      self.network_id, self.node_secret,
-                                      listening_port=config.PEER_PORT,
-                                      database=self.database)
+        self.overlay = OverlayManager(
+            self.clock, self.herder, self.network_id, self.node_secret,
+            listening_port=config.PEER_PORT, database=self.database,
+            batching=config.OVERLAY_BATCHING,
+            batch_max_messages=config.OVERLAY_BATCH_MAX_MESSAGES,
+            batch_max_bytes=config.OVERLAY_BATCH_MAX_BYTES)
         if self.herder.admission is not None:
             # backlog drained -> re-grant the flow-control capacity the
             # peers earned while the valve was closed

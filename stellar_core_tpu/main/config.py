@@ -111,6 +111,15 @@ class Config:
     # range's remaining checkpoints (the stitch proof covers the dynamic
     # seam).  false = static ranges only.
     CATCHUP_WORK_STEALING: bool = True
+    # Batched authenticated transport (overlay/peer.py): negotiate
+    # AUTH_FLAG_BATCH per link and coalesce batch-eligible sends into
+    # one-MAC BATCHED_AUTH frames.  Negotiation falls back to classic
+    # per-message frames against peers that don't advertise the flag, so
+    # the knob only ever changes this node's own links.  The caps bound
+    # one coalescing run (messages / encoded bytes) before a flush.
+    OVERLAY_BATCHING: bool = True
+    OVERLAY_BATCH_MAX_MESSAGES: int = 64
+    OVERLAY_BATCH_MAX_BYTES: int = 131072
     # Batched admission (herder/admission.py): /tx + overlay TRANSACTION
     # intake accumulates into accel-sized verification batches with
     # back-pressure wired to overlay flow control and surge pricing.
@@ -238,6 +247,8 @@ class Config:
             "LOG_LEVEL", "LOG_FORMAT", "WORKER_THREADS",
             "ADMISSION", "ADMISSION_BATCH_SIZE", "ADMISSION_FLUSH_DELAY_S",
             "ADMISSION_MAX_BACKLOG",
+            "OVERLAY_BATCHING", "OVERLAY_BATCH_MAX_MESSAGES",
+            "OVERLAY_BATCH_MAX_BYTES",
             "NODE_NAME", "SAMPLEPROF", "SLO_EVAL_CADENCE_S",
             "SLO_CLOSE_P99_S", "SLO_ADMISSION_P99_S", "SLO_CATCHUP_RATE",
             "SLO_BURN_BUDGET",

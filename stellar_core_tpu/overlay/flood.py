@@ -50,6 +50,18 @@ class Floodgate:
     def seen(self, msg_hash: bytes) -> bool:
         return msg_hash in self._records
 
+    def note_duplicate(self, msg_hash: bytes, from_peer) -> bool:
+        """Record a repeat sighting WITHOUT needing the ledger seq: True
+        when the hash is a known record (the peer is noted so broadcast
+        never echoes back), False when the record is unknown/expired and
+        the caller must take the full decode + add_record path."""
+        rec = self._records.get(msg_hash)
+        if rec is None:
+            return False
+        if from_peer is not None:
+            rec.peers_told.add(from_peer)
+        return True
+
     def note_told(self, msg_hash: bytes, peer) -> None:
         rec = self._records.get(msg_hash)
         if rec is not None:

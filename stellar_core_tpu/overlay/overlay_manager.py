@@ -39,10 +39,21 @@ _RECV_METER = {t: "overlay.recv." + t.name.lower().replace("_", "-")
 class OverlayManager:
     def __init__(self, clock, herder, network_id: bytes,
                  node_secret: SecretKey, listening_port: int = 0,
-                 auth_seed: Optional[bytes] = None, database=None):
+                 auth_seed: Optional[bytes] = None, database=None,
+                 batching: bool = True, batch_max_messages: int = 64,
+                 batch_max_bytes: int = 128 * 1024):
         self.clock = clock
         self.herder = herder
         self.network_id = network_id
+        # batched authenticated transport (overlay/peer.py): willingness
+        # to negotiate AUTH_FLAG_BATCH on every link this manager owns,
+        # plus the per-peer coalescing-run caps.  Config surface:
+        # OVERLAY_BATCHING / OVERLAY_BATCH_MAX_MESSAGES /
+        # OVERLAY_BATCH_MAX_BYTES.
+        self.batching = batching
+        self.batch_max_messages = max(
+            1, min(batch_max_messages, X.BATCH_WIRE_MAX_MESSAGES))
+        self.batch_max_bytes = max(1, batch_max_bytes)
         self.node_id = node_secret.public_key.ed25519
         self.listening_port = listening_port
         self.peer_auth = PeerAuth(node_secret, network_id,
@@ -267,7 +278,8 @@ class OverlayManager:
         return self.herder.lm.lcl_header.ledgerVersion
 
     def _message_received(self, peer: Peer, msg: X.StellarMessage,
-                          body: Optional[bytes] = None) -> None:
+                          body: Optional[bytes] = None,
+                          body_hash: Optional[bytes] = None) -> None:
         # `body` = the message's own XDR bytes as received (sliced from
         # the authenticated frame) — the SCP hot path hashes and
         # re-floods them without a re-encode
@@ -284,7 +296,7 @@ class OverlayManager:
         if t in (MT.SEND_MORE, MT.SEND_MORE_EXTENDED):
             return  # handled in Peer flow control
         if t == MT.SCP_MESSAGE:
-            self._recv_scp(peer, msg, body)
+            self._recv_scp(peer, msg, body, body_hash)
         elif t == MT.TRANSACTION:
             self._recv_transaction(peer, msg)
         elif t == MT.FLOOD_ADVERT:
@@ -347,12 +359,38 @@ class OverlayManager:
         if handler(peer, msg.value):
             self._broadcast(msg, h)
 
+    # -- transport-level duplicate fast path --------------------------------
+    # The batched receive path slices raw bodies before decoding them;
+    # for SCP traffic (dedup-keyed on sha256 of the body bytes) that seam
+    # lets a flood duplicate be recognised and dropped BEFORE paying the
+    # XDR decode — at fleet scale most deliveries are duplicates, so this
+    # is where the soak's receive-side codec time goes.
+    def flood_seen(self, body_hash: bytes) -> bool:
+        """Pure check (no mutation): is this body hash a known flood
+        record?  Peer uses it during batch validation, where nothing may
+        change observable state until the whole frame proves well-formed."""
+        return self.floodgate.seen(body_hash)
+
+    def _note_flood_duplicate(self, peer: Peer, body_hash: bytes) -> bool:
+        """Account a pre-decode duplicate drop: notes the sender on the
+        flood record (broadcast must not echo back) and marks the same
+        dedup stats the decoded path would.  False when the record was
+        GC'd between the frame's validation and dispatch phases (a ledger
+        close mid-run ran clear_below) — the caller falls back to the
+        full decode + dispatch path."""
+        if not self.floodgate.note_duplicate(body_hash, peer):
+            return False
+        self.stats["deduped"] += 1
+        _registry().meter("overlay.flood.duplicate").mark()
+        return True
+
     def _recv_scp(self, peer: Peer, msg: X.StellarMessage,
-                  body: Optional[bytes] = None) -> None:
+                  body: Optional[bytes] = None,
+                  body_hash: Optional[bytes] = None) -> None:
         env = msg.value
         if body is None:
             body = msg.to_xdr()
-        h = sha256(body)
+        h = body_hash if body_hash is not None else sha256(body)
         if not self.floodgate.add_record(h, env.statement.slotIndex, peer):
             self.stats["deduped"] += 1
             _registry().meter("overlay.flood.duplicate").mark()

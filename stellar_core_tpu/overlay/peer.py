@@ -10,6 +10,17 @@ header with the high bit set (single-fragment) and the payload length in
 the low 31 bits, followed by an AuthenticatedMessage XDR.  HELLO and
 ERROR_MSG travel with sequence 0 and a zero MAC (no keys yet); everything
 else is HMAC'd with per-direction keys and strictly increasing sequences.
+
+Batched transport (TPU extension): when both sides set AUTH_FLAG_BATCH in
+their AUTH, batch-eligible sends coalesce into a per-peer pending run that
+flushes on a message/byte cap or on the next crank edge as ONE
+BATCHED_AUTH frame — one sequence number + one MAC over the packed run
+(AuthenticatedMessage arm 1, spliced from the already-encoded bodies).
+Latency-sensitive types (AUTH, ERROR, SEND_MORE[_EXTENDED]) flush the run
+and go out immediately as classic per-message frames, as does a run of
+one, so a lone send keeps the unbatched wire format and latency.  Flow
+control stays PER MESSAGE: capacity is debited per contained message on
+send and earned per contained message on receive.
 """
 
 from __future__ import annotations
@@ -19,6 +30,7 @@ import struct
 from typing import Callable, List, Optional
 
 from .. import xdr as X
+from ..crypto.sha import sha256
 from ..util import logging as slog
 from ..util.metrics import registry as _registry
 from .peer_auth import PeerAuth, mac_message, mac_ok
@@ -37,12 +49,32 @@ PEER_FLOOD_READING_CAPACITY_BYTES = 300_000
 FLOW_CONTROL_BYTES_BATCH = 100_000
 
 _ZERO_MAC = b"\x00" * 32
-# AuthenticatedMessage union discriminant for V0 (see _send_authenticated)
+# AuthenticatedMessage union discriminants (see _emit_authenticated /
+# _emit_batch — both paths splice frames from pre-encoded bodies)
 _AM_V0_ARM = b"\x00\x00\x00\x00"
+_AM_BATCH_ARM = b"\x00\x00\x00\x01"
 
 _FLOOD_TYPES = frozenset((
     X.MessageType.TRANSACTION, X.MessageType.SCP_MESSAGE,
     X.MessageType.FLOOD_ADVERT, X.MessageType.FLOOD_DEMAND))
+
+# latency-sensitive types that must never wait in a coalescing run: the
+# handshake pair, errors and flow-control grants (a grant riding a full
+# batch would add exactly the stall it exists to break).  HELLO/ERROR
+# travel unauthenticated anyway; listed for the avoidance of doubt.
+_BATCH_IMMEDIATE = frozenset((
+    X.MessageType.HELLO, X.MessageType.AUTH, X.MessageType.ERROR_MSG,
+    X.MessageType.SEND_MORE, X.MessageType.SEND_MORE_EXTENDED))
+
+# a batch is authenticated traffic between two completed handshakes:
+# handshake/error messages inside one are protocol violations
+_BATCH_FORBIDDEN = frozenset((
+    X.MessageType.HELLO, X.MessageType.AUTH, X.MessageType.ERROR_MSG))
+
+# StellarMessage's union discriminant is its first 4 XDR bytes — the batch
+# receive path peeks it from the raw body to route SCP traffic through the
+# pre-decode duplicate drop (flood dedup keys SCP on sha256 of the body)
+_SCP_MESSAGE_SWITCH = int(X.MessageType.SCP_MESSAGE)
 
 
 def frame_encode(payload: bytes) -> bytes:
@@ -108,12 +140,27 @@ class Peer:
         # back-pressure: grants the admission pipeline told us to hold —
         # (messages, bytes) owed to the peer once the backlog drains
         self._deferred_grant: Optional[List[int]] = None
+        # batched transport: local willingness (flipped per-peer by tests;
+        # seeded from the overlay's config knob), what we advertised in
+        # our AUTH, what the remote advertised in theirs, and the pending
+        # coalescing run of pre-encoded bodies
+        self.batching_enabled: bool = bool(overlay.batching)
+        self._advertised_batch = False
+        self._remote_batch = False
+        self._batch_run: List[bytes] = []
+        self._batch_bytes = 0
+        self._batch_flush_armed = False
+        self._batch_max_msgs = overlay.batch_max_messages
+        self._batch_max_bytes = overlay.batch_max_bytes
         # wire accounting metric objects, cached for the peer's lifetime
         reg = _registry()
         self._ctr_byte_read = reg.counter("overlay.byte.read")
         self._ctr_byte_write = reg.counter("overlay.byte.write")
         self._met_msg_read = reg.meter("overlay.message.read")
         self._met_msg_write = reg.meter("overlay.message.write")
+        self._met_batch_msgs = reg.meter("overlay.batch.messages")
+        self._met_batch_flush = reg.meter("overlay.batch.flush")
+        self._ctr_batch_bytes = reg.counter("overlay.batch.bytes")
 
     # -- transport interface (subclass-provided) ----------------------------
     def _write_bytes(self, data: bytes) -> None:
@@ -135,6 +182,8 @@ class Peer:
             return
         self.drop_reason = reason
         self.state = Peer.CLOSING
+        self._batch_run = []
+        self._batch_bytes = 0
         log.info("dropping peer %s: %s",
                  self.peer_id.hex()[:8] if self.peer_id else "?", reason)
         self._close_transport()
@@ -163,6 +212,9 @@ class Peer:
             X.Error(code=code, msg=text)))
 
     def _send_unauthenticated(self, msg: X.StellarMessage) -> None:
+        # an ERROR racing a pending run must land AFTER it (frame order =
+        # send order); HELLO happens before keys exist, run empty
+        self._flush_batch()
         am = X.AuthenticatedMessage.v0(X.AuthenticatedMessageV0(
             sequence=0, message=msg, mac=X.HmacSha256Mac(mac=_ZERO_MAC)))
         self._write_frame(frame_encode(am.to_xdr()))
@@ -204,6 +256,17 @@ class Peer:
             return
         if body is None:
             body = msg.to_xdr()
+        if self._remote_batch and self.batching_enabled \
+                and msg.switch not in _BATCH_IMMEDIATE:
+            self._batch_append(body)
+            return
+        # immediate types (and everything on an unbatched link) preserve
+        # send order: drain the pending run before emitting — which is
+        # also how a deferred-grant release rides a batch flush
+        self._flush_batch()
+        self._emit_authenticated(body)
+
+    def _emit_authenticated(self, body: bytes) -> None:
         mac = mac_message(self._send_key, self._send_seq, body)
         # splice the AuthenticatedMessage from the already-encoded body
         # instead of re-packing the whole message through the codec:
@@ -214,6 +277,64 @@ class Peer:
         am_xdr = _AM_V0_ARM + struct.pack(">Q", self._send_seq) + body + mac
         self._send_seq += 1
         self._write_frame(frame_encode(am_xdr))
+
+    # -- batched transport (send side) --------------------------------------
+    def _batch_append(self, body: bytes) -> None:
+        self._batch_run.append(body)
+        self._batch_bytes += len(body)
+        if len(self._batch_run) >= self._batch_max_msgs \
+                or self._batch_bytes >= self._batch_max_bytes:
+            self._flush_batch()
+        elif not self._batch_flush_armed:
+            # crank-edge flush: ONE posted action per empty->nonempty
+            # edge.  A lone message still leaves within the current crank
+            # round (no flush-delay regression), while a broadcast storm
+            # appending N bodies this crank rides out as one frame.
+            self._batch_flush_armed = True
+            self.overlay.clock.post_action(self._crank_flush,
+                                           name="overlay-batch-flush")
+
+    def _crank_flush(self) -> None:
+        self._batch_flush_armed = False
+        if self.state != Peer.CLOSING:
+            self._flush_batch()
+
+    def _flush_batch(self) -> None:
+        run = self._batch_run
+        if not run:
+            return
+        self._batch_run = []
+        self._batch_bytes = 0
+        if len(run) == 1:
+            # a run of one goes out as a classic per-message frame: the
+            # batched link's quiet-path wire bytes and latency are
+            # identical to an unbatched link's
+            self._emit_authenticated(run[0])
+            return
+        self._emit_batch(run)
+
+    def _emit_batch(self, run: List[bytes]) -> None:
+        self._write_frame(self._build_batch_frame(run))
+
+    def _build_batch_frame(self, run: List[bytes]) -> bytes:
+        """Splice one BATCHED_AUTH frame: union arm 1 + sequence + packed
+        run (count + per-body length prefix; bodies are XDR encodings so
+        they are already 4-aligned) + one MAC over the packed run.
+        Byte-identical to the BatchedAuthenticatedMessage codec path
+        (unit-tested) without re-encoding any body."""
+        payload = bytearray(struct.pack(">I", len(run)))
+        for body in run:
+            payload += struct.pack(">I", len(body))
+            payload += body
+        payload = bytes(payload)
+        mac = mac_message(self._send_key, self._send_seq, payload)
+        am_xdr = _AM_BATCH_ARM + struct.pack(">Q", self._send_seq) \
+            + payload + mac
+        self._send_seq += 1
+        self._met_batch_msgs.mark(len(run))
+        self._met_batch_flush.mark()
+        self._ctr_batch_bytes.inc(len(am_xdr))
+        return frame_encode(am_xdr)
 
     def _flush_flood_queue(self) -> None:
         while self._flood_queue and self._outbound_capacity > 0:
@@ -244,6 +365,9 @@ class Peer:
             self._frame_received(frame)
 
     def _frame_received(self, frame: bytes) -> None:
+        if frame[:4] == _AM_BATCH_ARM:
+            self._batch_frame_received(frame)
+            return
         try:
             am = X.AuthenticatedMessage.from_xdr(frame)
         except Exception:
@@ -277,13 +401,112 @@ class Peer:
             return
         self._recv_seq += 1
         if msg.switch == X.MessageType.AUTH:
-            self._recv_auth()
+            self._recv_auth(msg.value)
             return
         if not self.is_authenticated():
             self.drop("message before AUTH")
             return
         self._account_flood_processing(msg, len(body))
         self.overlay._message_received(self, msg, body=body)
+
+    def _batch_frame_received(self, frame: bytes) -> None:
+        """One BATCHED_AUTH frame: verify the single sequence + MAC over
+        the packed run, then slice and dispatch each contained body
+        through the exact per-message recv path.  EVERY body is decoded
+        before ANY is dispatched — a malformed run from a keyed peer
+        fail-stops with nothing partially delivered."""
+        if self._recv_key is None:
+            self.drop("authenticated message before HELLO exchange")
+            return
+        if not self._advertised_batch:
+            # we never offered AUTH_FLAG_BATCH on this link: a compliant
+            # peer cannot send arm-1 frames here
+            self.drop("unnegotiated batch frame")
+            return
+        if len(frame) < 48:   # arm + sequence + count + MAC
+            self.drop("bad batch framing")
+            return
+        sequence = struct.unpack_from(">Q", frame, 4)[0]
+        payload = frame[12:len(frame) - 32]
+        if sequence != self._recv_seq or not mac_ok(
+                self._recv_key, sequence, payload, frame[len(frame) - 32:]):
+            self.drop("bad MAC or sequence")
+            return
+        self._recv_seq += 1
+        if not self.is_authenticated():
+            self.drop("message before AUTH")
+            return
+        count = struct.unpack_from(">I", payload, 0)[0]
+        if count == 0 or count > X.BATCH_WIRE_MAX_MESSAGES:
+            self.drop("bad batch framing")
+            return
+        msgs = []
+        off, end = 4, len(payload)
+        for _ in range(count):
+            if off + 4 > end:
+                self.drop("bad batch framing")
+                return
+            ln = struct.unpack_from(">I", payload, off)[0]
+            off += 4
+            if ln > end - off:
+                self.drop("bad batch framing")
+                return
+            body = payload[off:off + ln]
+            off += ln
+            h = None
+            if ln >= 4 \
+                    and struct.unpack_from(">I", body, 0)[0] \
+                    == _SCP_MESSAGE_SWITCH:
+                # pre-decode duplicate drop: SCP flood dedup keys on
+                # sha256 of exactly these bytes, so a hash hit means the
+                # body is byte-identical to a message that already
+                # decoded cleanly — validity holds without re-decoding,
+                # and at fleet scale most deliveries land here
+                h = sha256(body)
+                if self.overlay.flood_seen(h):
+                    msgs.append((None, body, h))
+                    continue
+            try:
+                msg = X.StellarMessage.from_xdr(body)
+            except Exception:
+                self.drop("undecodable message")
+                return
+            if msg.switch in _BATCH_FORBIDDEN:
+                self.drop("bad batch framing")
+                return
+            msgs.append((msg, body, h))
+        if off != end:
+            self.drop("bad batch framing")
+            return
+        # data_received marked message.read once for the frame; make the
+        # meter count contained messages, not frames
+        self._met_msg_read.mark(len(msgs) - 1)
+        for msg, body, h in msgs:
+            if self.state == Peer.CLOSING:
+                return   # a handler dropped us mid-run: stop dispatching
+            if msg is None:
+                # duplicate fast path: flow-control capacity is still
+                # earned per contained message and the sender is noted
+                # on the flood record so broadcast never echoes back
+                self._account_flood_switch(X.MessageType.SCP_MESSAGE,
+                                           len(body))
+                if self.overlay._note_flood_duplicate(self, h):
+                    continue
+                # record GC'd between validation and dispatch (a ledger
+                # close mid-run ran clear_below): take the decoded path
+                try:
+                    msg = X.StellarMessage.from_xdr(body)
+                except Exception:
+                    self.drop("undecodable message")
+                    return
+                self.overlay._message_received(self, msg, body=body,
+                                               body_hash=h)
+                continue
+            # flow-control capacity is earned PER CONTAINED MESSAGE —
+            # grants under batching account identically to per-frame mode
+            self._account_flood_processing(msg, len(body))
+            self.overlay._message_received(self, msg, body=body,
+                                           body_hash=h)
 
     def _recv_hello(self, hello) -> None:
         if self.state not in (Peer.CONNECTED, Peer.CONNECTING):
@@ -314,15 +537,27 @@ class Peer:
         if not self.we_called_remote:
             self.send_hello()
         else:
-            self._send_authenticated(X.StellarMessage.auth(X.Auth(flags=0)))
+            self._send_auth()
 
-    def _recv_auth(self) -> None:
+    def _send_auth(self) -> None:
+        """Our half of the AUTH exchange; advertises AUTH_FLAG_BATCH when
+        this side is willing to speak the batched transport.  The flag is
+        informational to peers that predate it (they read flags as 0-or-
+        whatever and ignore it), so the handshake stays byte-compatible."""
+        flags = X.AUTH_FLAG_BATCH if self.batching_enabled else 0
+        self._advertised_batch = bool(flags)
+        self._send_authenticated(X.StellarMessage.auth(X.Auth(flags=flags)))
+
+    def _recv_auth(self, auth: X.Auth) -> None:
         if self.state != Peer.GOT_HELLO:
             self.drop("AUTH out of order")
             return
+        # batching is active only when BOTH sides advertised the flag —
+        # a flags=0 peer keeps today's per-message wire format verbatim
+        self._remote_batch = bool(auth.flags & X.AUTH_FLAG_BATCH)
         if not self.we_called_remote:
             # acceptor completes the handshake with its own AUTH
-            self._send_authenticated(X.StellarMessage.auth(X.Auth(flags=0)))
+            self._send_auth()
         self.state = Peer.GOT_AUTH
         self._grant_capacity(initial=True)
         self.overlay._peer_authenticated(self)
@@ -353,7 +588,13 @@ class Peer:
             self._outbound_capacity_bytes += msg.value.numBytes
             self._flush_flood_queue()
             return
-        if msg.switch in _FLOOD_TYPES:
+        self._account_flood_switch(msg.switch, size)
+
+    def _account_flood_switch(self, switch, size: int) -> None:
+        """Grant-earning half of flow accounting, keyed on the message
+        type discriminant alone — the batch path's pre-decode duplicate
+        drop accounts here without ever materialising the message."""
+        if switch in _FLOOD_TYPES:
             self._processed_since_grant += 1
             self._processed_bytes_since_grant += size
             if (self._processed_since_grant >= FLOW_CONTROL_SEND_MORE_BATCH
@@ -392,7 +633,15 @@ class Peer:
 class LoopbackPeer(Peer):
     """In-process transport for deterministic tests (reference:
     src/overlay/test/LoopbackPeer) — bytes are delivered to the partner via
-    clock-posted actions, so delivery interleaves with timers."""
+    clock-posted actions, so delivery interleaves with timers.
+
+    Delivery is COALESCED per crank: every frame surviving fault injection
+    joins a pending run, and one posted action per crank hands the whole
+    run to the partner through a single data_received call — one scheduler
+    dispatch per link-direction per crank instead of one per message,
+    which is the sim-level half of the batched-transport speedup.  Fault
+    semantics are unchanged: drop/damage/reorder draws stay per frame (and
+    per contained message for BATCHED_AUTH frames, see _emit_batch)."""
 
     def __init__(self, overlay, we_called_remote: bool,
                  fault_rng=None):
@@ -413,6 +662,8 @@ class LoopbackPeer(Peer):
         self.fault_rng = fault_rng
         self._held_back: Optional[bytes] = None
         self._backstop_gen = 0
+        self._pending_out: List[bytes] = []
+        self._delivery_armed = False
 
     def _write_bytes(self, data: bytes) -> None:
         if self.partner is None or self.drop_outbound:
@@ -447,20 +698,117 @@ class LoopbackPeer(Peer):
             # reorder) — and even if this frame was dropped, the held one
             # must not be silently lost
             frames.append(held)
-        partner = self.partner
         for frame in frames:
-            self.overlay.clock.post_action(
-                lambda f=frame: partner.data_received(f),
-                name="loopback-delivery")
+            self._enqueue_delivery(frame)
+
+    def _enqueue_delivery(self, data: bytes) -> None:
+        """Join the per-crank delivery run; the first frame of a run arms
+        ONE posted action that delivers everything pending at once."""
+        self._pending_out.append(data)
+        if not self._delivery_armed:
+            self._delivery_armed = True
+            self.overlay.clock.post_action(self._deliver_pending,
+                                           name="loopback-delivery")
+
+    def _deliver_pending(self) -> None:
+        self._delivery_armed = False
+        pending, self._pending_out = self._pending_out, []
+        partner = self.partner
+        if not pending or partner is None:
+            return
+        partner.data_received(
+            pending[0] if len(pending) == 1 else b"".join(pending))
 
     def _flush_held(self) -> None:
         """Deliver a reorder-held frame that nothing has overtaken."""
         held, self._held_back = self._held_back, None
         if held is not None and self.partner is not None:
-            partner = self.partner
-            self.overlay.clock.post_action(
-                lambda: partner.data_received(held),
-                name="loopback-delivery")
+            self._enqueue_delivery(held)
+
+    def _emit_batch(self, run: List[bytes]) -> None:
+        """Fault-aware BATCHED_AUTH emission: with any fault probability
+        set, the drop/damage/reorder draws happen PER CONTAINED MESSAGE in
+        send order — the same conditional draw sequence (and therefore the
+        same RNG stream consumption per message) as the unbatched
+        per-frame path in _write_bytes, so a seeded campaign replays
+        identically in either transport mode.
+
+        Outcome mapping keeps unbatched fail-stop semantics:
+        - drop: any dropped message loses the WHOLE frame (the sequence
+          number still advances), so the receiver hits the same seq-gap
+          fail-stop a dropped per-message frame causes — and P(link
+          survives k messages) is (1-p)^k in both modes;
+        - damage: the flip lands in that message's body bytes when the
+          drawn offset maps there (same randrange span as a per-message
+          frame), else in the frame MAC — either way the one-MAC check
+          fails and the link fail-stops, like an unbatched damaged frame;
+        - reorder: the held message lands behind its successor INSIDE the
+          run.  This is the one intentional semantic delta: intra-batch
+          reordering is benign (one frame, one sequence number), whereas
+          reordered per-message frames break the sequence chain.  The
+          frame bypasses _write_bytes so nothing double-draws."""
+        if not (self.drop_probability or self.damage_probability
+                or self.reorder_probability):
+            super()._emit_batch(run)
+            return
+        rng = self.fault_rng
+        entries = []   # (body, flip-or-None) in final intra-run order
+        held = None
+        any_dropped = False
+        for body in run:
+            if self.drop_probability \
+                    and rng.random() < self.drop_probability:
+                any_dropped = True
+                if held is not None:
+                    entries.append(held)
+                    held = None
+                continue
+            flip = None
+            if self.damage_probability \
+                    and rng.random() < self.damage_probability:
+                # same span a per-message frame would offer _write_bytes:
+                # 4-byte record mark excluded, arm+seq+body+mac included
+                flip = (rng.randrange(4, len(body) + 48), rng.randrange(8))
+            if self.reorder_probability \
+                    and rng.random() < self.reorder_probability \
+                    and held is None:
+                held = (body, flip)
+                continue
+            entries.append((body, flip))
+            if held is not None:
+                entries.append(held)
+                held = None
+        if held is not None:
+            entries.append(held)
+        # the sender MACs what it sent: build the valid frame first (the
+        # sequence number advances and the batch metrics mark even for a
+        # frame the link then loses, like any transport), then corrupt it
+        # in transit
+        if not entries:
+            # every message dropped: burn the sequence number the frame
+            # would have consumed so the seq-gap fail-stop still fires
+            self._send_seq += 1
+            return
+        frame = self._build_batch_frame([body for body, _ in entries])
+        if any_dropped or self.partner is None or self.drop_outbound:
+            return
+        buf = bytearray(frame)
+        # frame layout: 4 record mark + 4 arm + 8 seq + 4 count, then per
+        # body: 4-byte length + body; MAC is the trailing 32 bytes
+        off = 20
+        for body, flip in entries:
+            off += 4
+            if flip is not None:
+                pos, bit = flip
+                if 16 <= pos < 16 + len(body):
+                    # maps into the message body: flip that exact byte
+                    buf[off + (pos - 16)] ^= 1 << bit
+                else:
+                    # arm/seq/MAC region of a per-message frame: flip a
+                    # frame-MAC byte — same MAC-failure fail-stop class
+                    buf[len(buf) - 32 + (pos % 32)] ^= 1 << bit
+            off += len(body)
+        self._enqueue_delivery(bytes(buf))
 
     def _arm_backstop(self) -> None:
         """Flush a still-held frame after a grace round — frames posted

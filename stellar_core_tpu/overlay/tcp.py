@@ -87,6 +87,10 @@ class TCPPeer(Peer):
                 self.drop(f"connect failed: {errno.errorcode.get(err, err)}")
                 return
             self.connect_handler()
+        # IO-ready edge: a pending coalescing run rides this writability
+        # event as one frame/one syscall instead of waiting for the next
+        # crank-edge flush
+        self._flush_batch()
         self._try_flush()
         self.transport.update_interest(self)
 

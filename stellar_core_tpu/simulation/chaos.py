@@ -317,6 +317,7 @@ class ChaosScenario:
                  allow_stall: Sequence[Tuple[float, float]] = (),
                  expect_failure: Optional[str] = None,
                  checkpoint_frequency: Optional[int] = None,
+                 batching: bool = True,
                  description: str = ""):
         self.name = name
         self.build = build
@@ -333,6 +334,11 @@ class ChaosScenario:
         # (the cadence is archive FORMAT: runner sets it process-wide for
         # the campaign and restores it after)
         self.checkpoint_frequency = checkpoint_frequency
+        # batched authenticated transport for the whole fleet (the runner
+        # applies it to every node before any link is dialed); False
+        # replays a campaign over classic per-message frames — the
+        # replay-identity and bench comparisons run both modes
+        self.batching = batching
         self.description = description
         # optional teardown the runner invokes after the campaign —
         # scenarios that provision on-disk state (a shared history
@@ -982,6 +988,11 @@ class ChaosRunner:
         sc = self.scenario
         self.sim, self.base_links = sc.build(sc.seed)
         sim = self.sim
+        # transport mode is campaign-scoped: set before any link is
+        # dialed so every peer negotiates (or declines) batching
+        sim.batching = sc.batching
+        for node in sim.nodes:
+            node.overlay.batching = sc.batching
         n = len(sim.nodes)
         self.result.nodes = n
         self._checked_upto = [0] * n

@@ -59,7 +59,7 @@ class SimNode:
         # the OverlayManager rewires herder.broadcast / tx_flood / fetch_*
         # onto the real flood/fetch machinery
         self.overlay = OverlayManager(sim.clock, self.herder, sim.network_id,
-                                      secret)
+                                      secret, batching=sim.batching)
         self.partition = 0   # connection-group tag (see partition_nodes)
         self.closed: Dict[int, bytes] = {}  # seq -> ledger hash
         # per-category status lines, same manager a full Application runs
@@ -253,7 +253,12 @@ class Simulation:
 
     def __init__(self, network_passphrase: bytes = b"sim network",
                  mode: str = OVER_LOOPBACK,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 batching: bool = True):
+        # batched authenticated transport for every node this sim creates
+        # (chaos campaigns and benches flip it to compare modes; links
+        # negotiate per-pair so mixed fleets also work)
+        self.batching = batching
         self.network_passphrase = network_passphrase
         self.network_id = sha256(network_passphrase)
         self.clock = VirtualClock(ClockMode.VIRTUAL_TIME)

@@ -78,6 +78,12 @@ CANONICAL_METRICS = frozenset({
     "overlay.message.write",
     "overlay.flood.duplicate",
     "overlay.flood.grant-deferred",
+    # batched authenticated transport (overlay/peer.py): messages carried
+    # in BATCHED_AUTH frames, coalesced-run flushes, and batch frame
+    # bytes on the wire
+    "overlay.batch.messages",
+    "overlay.batch.flush",
+    "overlay.batch.bytes",
     # catchup / historywork
     "catchup.download.checkpoint",
     "catchup.apply.checkpoint",

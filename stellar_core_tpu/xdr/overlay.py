@@ -47,7 +47,14 @@ Hello = xdr_struct("Hello", [
 ])
 
 # AUTH_MSG_FLAG_FLOW_CONTROL_BYTES_REQUESTED = 200 in the reference; we
-# always speak flow control so the flag is informational
+# always speak flow control so the flag is informational.
+# AUTH_FLAG_BATCH is a TPU extension bit: a node that sets it in its own
+# AUTH accepts (and, if the remote also set it, emits) BATCHED_AUTH
+# frames — AuthenticatedMessage arm 1 below.  Peers that never sent the
+# flag never see arm-1 frames, so flags=0 links stay byte-compatible
+# with the per-message wire format.
+AUTH_FLAG_BATCH = 1
+
 Auth = xdr_struct("Auth", [
     ("flags", Int32),
 ], defaults={"flags": 0})
@@ -307,6 +314,23 @@ AuthenticatedMessageV0 = xdr_struct("AuthenticatedMessageV0", [
     ("mac", HmacSha256Mac),
 ])
 
+# BATCHED_AUTH (TPU extension, negotiated via AUTH_FLAG_BATCH): one
+# sequence number + one MAC authenticate a packed run of StellarMessage
+# encodings.  Each element of `messages` is one message's own XDR bytes
+# (already 4-aligned, so the var-opaque padding is empty and the wire
+# layout is exactly count + N x (u32 length + body)); the MAC covers
+# everything between the sequence and the MAC itself.  The overlay
+# splices these frames from pre-encoded bodies (overlay/peer.py) — this
+# codec type exists for layout tests and debugging tools.
+BATCH_WIRE_MAX_MESSAGES = 4096
+
+BatchedAuthenticatedMessage = xdr_struct("BatchedAuthenticatedMessage", [
+    ("sequence", Uint64),
+    ("messages", VarArray(VarOpaque(0x7FFFFFFF), BATCH_WIRE_MAX_MESSAGES)),
+    ("mac", HmacSha256Mac),
+])
+
 AuthenticatedMessage = xdr_union("AuthenticatedMessage", Uint32, {
     0: ("v0", AuthenticatedMessageV0),
+    1: ("batch", BatchedAuthenticatedMessage),
 })

@@ -283,6 +283,10 @@ class TestBackpressureValve:
         class FakeOverlay:
             network_id = lm.network_id
             node_id = b"\x01" * 32
+            # batched-transport knobs Peer snapshots at construction
+            batching = False
+            batch_max_messages = 64
+            batch_max_bytes = 128 * 1024
 
             def __init__(self):
                 self.herder = type("H", (), {"admission": adm})()

@@ -175,12 +175,20 @@ class TestDeterministicReplay:
         assert r1.random() != other.random()
         assert Simulation(b"rng net")._pair_rng(a, b) is None
 
-    def test_same_seed_replays_identical_event_log(self):
+    @pytest.mark.parametrize("batching", [True, False],
+                             ids=["batched", "unbatched"])
+    def test_same_seed_replays_identical_event_log(self, batching):
+        """Replay identity must hold in BOTH transport modes: the batched
+        loopback path draws its per-message fault RNG in the same order
+        as the per-frame path, so a seeded campaign is bit-identical
+        regardless of envelope coalescing."""
         sched = lambda: [LinkFault(4.0, drop=0.05, reorder=0.10),  # noqa: E731
                          LinkFault(10.0, damage=0.01),
                          LinkFault(16.0)]
-        r1 = run_scenario(_mini_core_scenario(42, sched(), n=6))
-        r2 = run_scenario(_mini_core_scenario(42, sched(), n=6))
+        r1 = run_scenario(_mini_core_scenario(42, sched(), n=6,
+                                              batching=batching))
+        r2 = run_scenario(_mini_core_scenario(42, sched(), n=6,
+                                              batching=batching))
         assert r1.event_trace == r2.event_trace
         assert r1.slot_hashes == r2.slot_hashes
         assert r1.ledgers_closed == r2.ledgers_closed

@@ -823,8 +823,12 @@ class TestLoopbackFaultInjection:
 
     def test_reordered_frames_break_auth_sequence(self):
         """Authenticated streams are sequence-numbered: reordering must be
-        detected (reference: per-message sequence in the HMAC)."""
+        detected (reference: per-message sequence in the HMAC).  Batching
+        is disabled on the sender — coalesced, these two messages would
+        legally share one frame (intra-batch order is covered by the
+        batch's single MAC; see TestBatchedTransport)."""
         clock, pa, pb = self._pair()
+        pa.batching_enabled = False
         pa.reorder_probability = 1.0
         from stellar_core_tpu import xdr as X
         pa.send_message(X.StellarMessage.getSCPLedgerSeq(1))
@@ -887,3 +891,441 @@ class TestItemFetcherRetry:
         clock.crank_for((f.RETRY_LIMIT + 2) * f.RETRY_PERIOD_S)
         assert f.wanted() == []
         clock.stop()
+
+
+# ---------------------------------------------------------------------------
+# batched authenticated transport
+
+class TestBatchedTransport:
+    """BATCHED_AUTH frames: one sequence number + one MAC authenticate a
+    packed run of message bodies.  Covers the splice/codec byte identity,
+    per-link negotiation, coalescing + the single-message floor, MAC/seq
+    fail-stop with NO partial dispatch, and per-contained-message flow
+    control."""
+
+    def _pair(self, batch_a=True, batch_b=True):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        sk_a, sk_b = SecretKey(b"\x91" * 32), SecretKey(b"\x92" * 32)
+        q = qset_of([sk_a.public_key.ed25519, sk_b.public_key.ed25519], 2)
+        ha, oa = _make_node(clock, sk_a, q, b"r" * 32)
+        hb, ob = _make_node(clock, sk_b, q, b"s" * 32)
+        oa.batching, ob.batching = batch_a, batch_b
+        pa, pb = make_loopback_pair(oa, ob)
+        _crank(clock)
+        assert pa.is_authenticated() and pb.is_authenticated()
+        return clock, pa, pb
+
+    @staticmethod
+    def _capture_frames(peer):
+        sent = []
+        orig = peer._write_frame
+
+        def spy(frame):
+            sent.append(frame)
+            orig(frame)
+        peer._write_frame = spy
+        return sent
+
+    @staticmethod
+    def _capture_received(peer):
+        got = []
+        orig = peer.overlay._message_received
+
+        def spy(p, msg, body=None, **kw):
+            if p is peer:
+                got.append(msg.switch)
+            return orig(p, msg, body=body, **kw)
+        peer.overlay._message_received = spy
+        return got
+
+    @staticmethod
+    def _batch_frame(key, seq, bodies, mac=None, count=None,
+                     chop=0):
+        """Hand-craft a BATCHED_AUTH frame the way the sender splices it;
+        `count`/`mac`/`chop` let tests lie about the run."""
+        import struct
+        from stellar_core_tpu.overlay.peer_auth import mac_message
+        payload = struct.pack(
+            ">I", len(bodies) if count is None else count)
+        for b in bodies:
+            payload += struct.pack(">I", len(b)) + b
+        if chop:
+            payload = payload[:-chop]
+        if mac is None:
+            mac = mac_message(key, seq, payload)
+        return frame_encode(b"\x00\x00\x00\x01"
+                            + struct.pack(">Q", seq) + payload + mac)
+
+    def test_batch_splice_matches_codec_path(self):
+        """The spliced batch frame must be byte-identical to encoding a
+        BatchedAuthenticatedMessage through the codec (XDR bodies are
+        4-aligned, so the var-opaque padding is empty)."""
+        import struct
+        bodies = [X.StellarMessage.getPeers().to_xdr(),
+                  X.StellarMessage.getSCPLedgerSeq(5).to_xdr()]
+        mac = b"\xab" * 32
+        for seq in (0, 7, 2**40):
+            am = X.AuthenticatedMessage.batch(X.BatchedAuthenticatedMessage(
+                sequence=seq, messages=bodies,
+                mac=X.HmacSha256Mac(mac=mac)))
+            spliced = (b"\x00\x00\x00\x01" + struct.pack(">Q", seq)
+                       + struct.pack(">I", len(bodies))
+                       + b"".join(struct.pack(">I", len(b)) + b
+                                  for b in bodies)
+                       + mac)
+            assert am.to_xdr() == spliced
+
+    def test_coalescing_one_frame_per_crank_edge(self):
+        clock, pa, pb = self._pair()
+        sent = self._capture_frames(pa)
+        got = self._capture_received(pb)
+        for i in range(3):
+            pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+        assert sent == []            # run pending until the crank edge
+        _crank(clock, 2)
+        batch = [f for f in sent if f[4:8] == b"\x00\x00\x00\x01"]
+        assert len(batch) == 1       # ONE arm-1 frame carried all three
+        assert got.count(X.MessageType.GET_SCP_STATE) == 3
+        assert pa.is_authenticated() and pb.is_authenticated()
+
+    def test_single_message_floor_emits_plain_v0(self):
+        """A run of one goes out as a classic per-message frame — the
+        quiet path has zero wire or latency delta vs an unbatched link."""
+        clock, pa, pb = self._pair()
+        sent = self._capture_frames(pa)
+        got = self._capture_received(pb)
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(9))
+        _crank(clock, 2)
+        assert len(sent) == 1
+        assert sent[0][4:8] == b"\x00\x00\x00\x00"   # v0 arm, not batch
+        assert got.count(X.MessageType.GET_SCP_STATE) == 1
+
+    def test_unbatched_peer_negotiates_plain_frames(self):
+        """flags=0 on either side keeps today's per-message wire format
+        verbatim in BOTH directions."""
+        clock, pa, pb = self._pair(batch_a=True, batch_b=False)
+        assert not pa._remote_batch          # B never advertised
+        sent_a = self._capture_frames(pa)
+        sent_b = self._capture_frames(pb)
+        got_b = self._capture_received(pb)
+        got_a = self._capture_received(pa)
+        for i in range(3):
+            pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+            pb.send_message(X.StellarMessage.getSCPLedgerSeq(i + 10))
+        _crank(clock, 2)
+        assert all(f[4:8] == b"\x00\x00\x00\x00" for f in sent_a)
+        assert all(f[4:8] == b"\x00\x00\x00\x00" for f in sent_b)
+        assert got_b.count(X.MessageType.GET_SCP_STATE) == 3
+        assert got_a.count(X.MessageType.GET_SCP_STATE) == 3
+
+    def test_unnegotiated_batch_frame_dropped(self):
+        """A batch frame on a link where we never offered the flag is a
+        protocol violation — fail-stop before touching the payload."""
+        clock, pa, pb = self._pair(batch_a=False, batch_b=True)
+        frame = self._batch_frame(
+            pb._send_key, pa._recv_seq,
+            [X.StellarMessage.getPeers().to_xdr()])
+        pa.data_received(frame)
+        assert pa.drop_reason == "unnegotiated batch frame"
+
+    def test_tampered_byte_mid_batch_no_partial_dispatch(self):
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        bodies = [X.StellarMessage.getSCPLedgerSeq(1).to_xdr(),
+                  X.StellarMessage.getSCPLedgerSeq(2).to_xdr()]
+        frame = bytearray(self._batch_frame(
+            pa._send_key, pb._recv_seq, bodies))
+        frame[20] ^= 0x01            # flip a byte inside the first body
+        pb.data_received(bytes(frame))
+        assert pb.drop_reason == "bad MAC or sequence"
+        assert got == []             # nothing dispatched, not even msg 1
+
+    def test_truncated_trailing_body_fail_stop(self):
+        """count says 2, run carries 1 — even with a valid MAC over the
+        truncated payload the framing check fail-stops with zero
+        dispatch."""
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        frame = self._batch_frame(
+            pa._send_key, pb._recv_seq,
+            [X.StellarMessage.getSCPLedgerSeq(1).to_xdr()], count=2)
+        pb.data_received(frame)
+        assert pb.drop_reason == "bad batch framing"
+        assert got == []
+
+    def test_truncated_mid_body_fails_mac(self):
+        """Truncation in transit (MAC computed over the full run) is a
+        MAC failure, like any damaged frame."""
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        import struct
+        from stellar_core_tpu.overlay.peer_auth import mac_message
+        bodies = [X.StellarMessage.getSCPLedgerSeq(1).to_xdr(),
+                  X.StellarMessage.getSCPLedgerSeq(2).to_xdr()]
+        payload = struct.pack(">I", 2) + b"".join(
+            struct.pack(">I", len(b)) + b for b in bodies)
+        mac = mac_message(pa._send_key, pb._recv_seq, payload)
+        frame = frame_encode(b"\x00\x00\x00\x01"
+                             + struct.pack(">Q", pb._recv_seq)
+                             + payload[:-8] + mac)
+        pb.data_received(frame)
+        assert pb.drop_reason == "bad MAC or sequence"
+        assert got == []
+
+    def test_whole_batch_replay_drops_peer(self):
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        frame = self._batch_frame(
+            pa._send_key, pb._recv_seq,
+            [X.StellarMessage.getSCPLedgerSeq(1).to_xdr(),
+             X.StellarMessage.getSCPLedgerSeq(2).to_xdr()])
+        pb.data_received(frame)
+        assert pb.drop_reason is None
+        assert got.count(X.MessageType.GET_SCP_STATE) == 2
+        pb.data_received(frame)      # replay the whole batch
+        assert pb.drop_reason == "bad MAC or sequence"
+        assert got.count(X.MessageType.GET_SCP_STATE) == 2
+
+    def test_forbidden_types_inside_batch_rejected(self):
+        """Handshake/teardown messages never ride inside a batch; every
+        body is decoded before any is dispatched, so the legal first
+        message must NOT be delivered either."""
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        auth_body = X.StellarMessage.auth(X.Auth(flags=0)).to_xdr()
+        frame = self._batch_frame(
+            pa._send_key, pb._recv_seq,
+            [X.StellarMessage.getSCPLedgerSeq(1).to_xdr(), auth_body])
+        pb.data_received(frame)
+        assert pb.drop_reason == "bad batch framing"
+        assert got == []
+
+    def _envelope(self, sk, slot):
+        return X.SCPEnvelope(
+            statement=X.SCPStatement(
+                nodeID=X.AccountID.ed25519(sk.public_key.ed25519),
+                slotIndex=slot,
+                pledges=X.SCPStatementPledges.nominate(X.SCPNomination(
+                    quorumSetHash=b"\x02" * 32, votes=[], accepted=[]))),
+            signature=b"\x03" * 64)
+
+    def test_duplicate_envelope_fast_drop_skips_decode(self, monkeypatch):
+        """A flood duplicate arriving in a batch is recognised by its raw
+        body hash BEFORE XDR decode (the dedup key is sha256 of exactly
+        those bytes): no re-decode, no dispatch — but flow-control
+        capacity is still earned per contained message and the sender is
+        noted on the flood record so broadcast never echoes back."""
+        from stellar_core_tpu.crypto.sha import sha256
+        clock, pa, pb = self._pair()
+        sk_a = SecretKey(b"\x91" * 32)
+        msg = X.StellarMessage.envelope(self._envelope(sk_a, 1))
+        h = sha256(msg.to_xdr())
+        pa.send_message(msg)
+        _crank(clock, 2)
+        ob = pb.overlay
+        assert ob.floodgate.seen(h)           # first copy recorded
+        dedup0 = ob.stats["deduped"]
+        earned0 = pb._processed_since_grant
+        got = self._capture_received(pb)
+        sent = self._capture_frames(pa)
+        decoded = []
+        orig = X.StellarMessage.from_xdr
+        monkeypatch.setattr(
+            X.StellarMessage, "from_xdr",
+            staticmethod(lambda b: (decoded.append(sha256(b)), orig(b))[1]))
+        fresh = X.StellarMessage.envelope(self._envelope(sk_a, 2))
+        pa.send_message(msg)                  # byte-identical duplicate...
+        pa.send_message(fresh)                # ...sharing a coalescing run
+        _crank(clock, 2)
+        assert [f[4:8] for f in sent] == [b"\x00\x00\x00\x01"]
+        assert h not in decoded               # duplicate dropped pre-decode
+        assert got == [X.MessageType.SCP_MESSAGE]   # only the fresh one
+        assert ob.stats["deduped"] == dedup0 + 1
+        assert pb._processed_since_grant == earned0 + 2  # both debited
+        assert pb in ob.floodgate.peers_told(h)
+        assert pa.state == pa.GOT_AUTH and pb.state == pb.GOT_AUTH
+
+    def test_flow_control_debits_per_message_not_per_frame(self):
+        clock, pa, pb = self._pair()
+        sk_a = SecretKey(b"\x91" * 32)
+        cap0 = pa._outbound_capacity
+        for slot in range(3):
+            pa.send_message(X.StellarMessage.envelope(
+                self._envelope(sk_a, slot)))
+        # all three ride one pending run, yet capacity fell by three
+        assert pa._outbound_capacity == cap0 - 3
+        pa._outbound_capacity = 0
+        pa.send_message(X.StellarMessage.envelope(self._envelope(sk_a, 9)))
+        assert pa.flood_queue_len == 1       # over-cap message queued
+
+    def test_receiver_earns_grant_credit_per_contained_message(self):
+        clock, pa, pb = self._pair()
+        sk_a = SecretKey(b"\x91" * 32)
+        before = pb._processed_since_grant
+        for slot in range(3):
+            pa.send_message(X.StellarMessage.envelope(
+                self._envelope(sk_a, slot)))
+        _crank(clock, 3)
+        assert pb._processed_since_grant == before + 3
+
+    def test_send_more_flushes_pending_run_first(self):
+        """SEND_MORE[_EXTENDED] is latency-immediate: a (deferred) grant
+        release drains the coalescing queue ahead of itself, keeping
+        frame order == send order."""
+        clock, pa, pb = self._pair()
+        sent = self._capture_frames(pa)
+        sk_a = SecretKey(b"\x91" * 32)
+        for slot in range(2):
+            pa.send_message(X.StellarMessage.envelope(
+                self._envelope(sk_a, slot)))
+        assert sent == []                    # still coalescing
+        pa.send_message(X.StellarMessage.sendMoreMessage(
+            X.SendMore(numMessages=5)))
+        assert len(sent) == 2
+        assert sent[0][4:8] == b"\x00\x00\x00\x01"   # the batch, first
+        assert sent[1][4:8] == b"\x00\x00\x00\x00"   # then the grant
+
+    def test_size_cap_forces_flush(self):
+        clock, pa, pb = self._pair()
+        pa._batch_max_msgs = 4
+        sent = self._capture_frames(pa)
+        got = self._capture_received(pb)
+        for i in range(9):
+            pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+        # two full runs of 4 flushed at the cap, the ninth rides the edge
+        assert len(sent) == 2
+        _crank(clock, 2)
+        assert len(sent) == 3
+        assert [f[4:8] for f in sent] == [b"\x00\x00\x00\x01"] * 2 \
+            + [b"\x00\x00\x00\x00"]
+        assert got.count(X.MessageType.GET_SCP_STATE) == 9
+
+    def test_batched_reorder_is_benign_intra_batch(self):
+        """Companion to test_reordered_frames_break_auth_sequence: inside
+        one batch frame a reorder draw only swaps contained bodies — one
+        frame, one sequence number, link stays healthy."""
+        clock, pa, pb = self._pair()
+        got = self._capture_received(pb)
+        pa.reorder_probability = 1.0
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(1))
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(2))
+        pa.reorder_probability = 0.0
+        _crank(clock, 5)
+        assert pa.is_authenticated() and pb.is_authenticated()
+        assert got.count(X.MessageType.GET_SCP_STATE) == 2
+
+    def test_batch_drop_burns_sequence_and_fail_stops(self):
+        """A dropped batch loses the whole frame but still advances the
+        sender's sequence — the next frame hits the same seq-gap
+        fail-stop a dropped per-message frame causes."""
+        clock, pa, pb = self._pair()
+        pa.drop_probability = 1.0
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(1))
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(2))
+        _crank(clock, 2)                 # flush draws drop per message
+        pa.drop_probability = 0.0
+        assert pa._send_seq > pb._recv_seq       # the gap exists
+        pa.send_message(X.StellarMessage.getSCPLedgerSeq(3))
+        _crank(clock, 2)
+        assert pb.state == pb.CLOSING or pa.state == pa.CLOSING
+
+
+class TestBatchMetrics:
+    """overlay.batch.{messages,flush,bytes} are canonical and send-side
+    only: they FIRE when a run coalesces and stay QUIET on an unbatched
+    link (run-of-one floor frames are classic v0, so they never mark)."""
+
+    def _deltas(self, fn):
+        from stellar_core_tpu.util import metrics
+        reg = metrics.registry()
+        names = ("overlay.batch.messages", "overlay.batch.flush",
+                 "overlay.batch.bytes")
+        def counts():
+            return {"overlay.batch.bytes": reg.counter(
+                        "overlay.batch.bytes").value,
+                    "overlay.batch.messages": reg.meter(
+                        "overlay.batch.messages").count,
+                    "overlay.batch.flush": reg.meter(
+                        "overlay.batch.flush").count}
+        before = counts()
+        fn()
+        after = counts()
+        return {n: after[n] - before[n] for n in names}
+
+    def test_batch_metric_names_are_canonical(self):
+        from stellar_core_tpu.util import metrics
+        for n in ("overlay.batch.messages", "overlay.batch.flush",
+                  "overlay.batch.bytes"):
+            assert n in metrics.CANONICAL_METRICS
+            assert metrics.METRIC_NAME_RE.match(n)
+
+    def test_metrics_fire_on_coalesced_flush(self):
+        helper = TestBatchedTransport()
+        clock, pa, pb = helper._pair()
+
+        def burst():
+            for i in range(3):
+                pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+            _crank(clock, 2)
+        d = self._deltas(burst)
+        assert d["overlay.batch.messages"] >= 3
+        assert d["overlay.batch.flush"] >= 1
+        assert d["overlay.batch.bytes"] > 0
+
+    def test_metrics_quiet_on_unbatched_link_and_floor(self):
+        helper = TestBatchedTransport()
+        clock, pa, pb = helper._pair(batch_a=True, batch_b=False)
+        clock2, pa2, pb2 = helper._pair()
+
+        def quiet_traffic():
+            # unbatched link: plain frames only
+            for i in range(3):
+                pa.send_message(X.StellarMessage.getSCPLedgerSeq(i + 1))
+            _crank(clock, 2)
+            # batched link, lone message: the run-of-one floor emits a
+            # classic v0 frame — batch metrics must not mark
+            pa2.send_message(X.StellarMessage.getSCPLedgerSeq(7))
+            _crank(clock2, 2)
+        d = self._deltas(quiet_traffic)
+        assert d == {"overlay.batch.messages": 0,
+                     "overlay.batch.flush": 0,
+                     "overlay.batch.bytes": 0}
+
+
+class TestBatchedTransportOverTCP:
+    def test_mixed_mode_fleet_interoperates(self, monkeypatch):
+        """A batching node must close ledgers with an unbatched peer over
+        real TCP — the AUTH flag downgrade is per-link, so a mixed fleet
+        reaches externalize with no fork."""
+        from stellar_core_tpu.herder import herder as herder_mod
+        monkeypatch.setattr(herder_mod, "EXP_LEDGER_TIMESPAN_SECONDS", 0.3)
+        clock = VirtualClock(ClockMode.REAL_TIME)
+        sks = [SecretKey(bytes([0x1a + i]) * 32) for i in range(3)]
+        ids = [s.public_key.ed25519 for s in sks]
+        q = qset_of(ids, 2)
+        nodes, transports = [], []
+        for i, s in enumerate(sks):
+            h, o = _make_node(clock, s, q, bytes([0x51 + i]) * 32)
+            o.batching = (i != 2)    # node 2 runs the unbatched HEAD mode
+            transports.append(TCPTransport(o, listen_port=0))
+            nodes.append((h, o))
+        try:
+            for i in range(3):
+                for j in range(i + 1, 3):
+                    transports[i].connect("127.0.0.1",
+                                          nodes[j][1].listening_port)
+            ok = clock.crank_until(
+                lambda: all(o.num_authenticated() >= 2 for _, o in nodes),
+                timeout=10)
+            assert ok, [o.num_authenticated() for _, o in nodes]
+            for h, _ in nodes:
+                h.bootstrap()
+            ok = clock.crank_until(
+                lambda: all(h.lm.last_closed_ledger_seq >= 3
+                            for h, _ in nodes), timeout=30)
+            assert ok, [h.lm.last_closed_ledger_seq for h, _ in nodes]
+            hashes = {h.lm.lcl_hash for h, _ in nodes}
+            assert len(hashes) == 1, "fork in mixed-mode fleet"
+        finally:
+            for t in transports:
+                t.close()
