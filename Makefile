@@ -1,6 +1,6 @@
 .PHONY: native native-live native-asan test lint race metrics obs bucketdb \
 	bucketdb-slow chaos chaos-byz chaos-soak loadgen loadgen-slow \
-	catchup-par catchup-mesh fleet fleet-soak soroban clean
+	catchup-par catchup-mesh fleet fleet-soak soroban determinism clean
 
 native:
 	python setup.py build_ext --inplace
@@ -46,8 +46,28 @@ lint:
 		--baseline LINT_BASELINE.json
 	python -m stellar_core_tpu._native_build --warn-check
 
-test: lint
+test: lint determinism
 	python -m pytest tests/ -q
+
+# determinism tier (ISSUE 19): (1) the four consensus-path determinism
+# rules alone, tree-wide (iteration-order / float-discipline /
+# hash-order / rng-discipline — also part of `make lint` via the full
+# rule set); (2) the chaos small tier with the detguard runtime guard
+# armed (STPU_DETGUARD=1): any wall-clock read, unseeded RNG draw or
+# str/bytes hash() inside a guarded consensus region — ledger close,
+# nomination, Soroban apply — fail-stops with DeterminismError + crash
+# bundle; (3) the hash-seed divergence differential: the 51-node
+# flagship chaos campaign AND the Soroban mixed campaign in paired
+# subprocesses under two different PYTHONHASHSEED values, canonical
+# slot→hash tables and bucket hashes asserted byte-identical, detguard
+# armed in every child with zero trips.
+determinism:
+	env JAX_PLATFORMS=cpu python -m stellar_core_tpu.lint --rules \
+		iteration-order,float-discipline,hash-order,rng-discipline
+	env JAX_PLATFORMS=cpu STPU_DETGUARD=1 python -m pytest \
+		tests/test_chaos.py -q -m 'not slow' \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu python -m stellar_core_tpu.simulation.hashseed_diff
 
 # race-sanitizer soak (ISSUE 9): the threaded test subset — admission
 # (incl. the loopback-flood hysteresis soak and the http-style marshalled

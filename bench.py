@@ -444,6 +444,72 @@ def bench_chaos(time_left_fn):
     return vals
 
 
+def bench_determinism(time_left_fn):
+    """Determinism tier (ISSUE 19).  The four consensus-path lint rules
+    ride the corelint section automatically (bench_lint enumerates every
+    registered rule); this section measures the *dynamic* half:
+
+    - detguard overhead: the Soroban mixed campaign with the guard
+      disarmed vs armed in-process (enable()/disable()) — the
+      `make determinism` / STPU_DETGUARD=1 tax, reported like the
+      racetrace overhead row;
+    - the hash-seed differential: the 51-node flagship chaos campaign
+      in paired subprocesses under two PYTHONHASHSEED values (children
+      detguard-armed), divergence asserted zero — deadline-aware with
+      SKIPPED(budget) + last-good semantics like every section."""
+    import logging as _pylogging
+
+    from stellar_core_tpu.simulation import hashseed_diff
+    from stellar_core_tpu.simulation.loadgen import SorobanMixCampaign
+    from stellar_core_tpu.util import detguard
+
+    vals = {}
+    prev_level = _pylogging.getLogger("stellar").level
+    _pylogging.getLogger("stellar").setLevel(_pylogging.WARNING)
+    try:
+        n_ledgers = 20
+        # untimed warm-up: first campaign pays import/JIT/caches and
+        # would inflate whichever arm runs first
+        SorobanMixCampaign().run(n_ledgers=5)
+        t0 = time.perf_counter()
+        SorobanMixCampaign().run(n_ledgers=n_ledgers)
+        off_s = time.perf_counter() - t0
+        detguard.reset_stats()
+        detguard.enable()
+        try:
+            t0 = time.perf_counter()
+            SorobanMixCampaign().run(n_ledgers=n_ledgers)
+            on_s = time.perf_counter() - t0
+        finally:
+            detguard.disable()
+        st = detguard.stats()
+        vals["detguard_off_wall_s"] = round(off_s, 3)
+        vals["detguard_on_wall_s"] = round(on_s, 3)
+        vals["detguard_overhead_ratio"] = round(on_s / max(off_s, 1e-9), 3)
+        vals["detguard_regions"] = st["regions"]
+        vals["detguard_trips"] = st["trips"]
+    finally:
+        _pylogging.getLogger("stellar").setLevel(prev_level)
+
+    # paired-subprocess flagship differential: the two children run
+    # concurrently, so the wall cost is ~one detguard-armed campaign
+    est_flagship = 110.0
+    if time_left_fn() >= est_flagship * 1.25 + 30.0:
+        _stage("hash-seed differential (51-node flagship pair)...")
+        rep = hashseed_diff.run_pair(
+            "flagship", timeout_s=max(300.0, time_left_fn()))
+        vals["hashseed_flagship_wall_s"] = (
+            round(rep["wall_s"], 1) if rep["ok"]
+            else f"FAILED({rep['divergence'] or rep['errors']})")
+        vals["hashseed_flagship_identical"] = rep["identical"]
+        vals["hashseed_flagship_trips"] = sum(
+            g.get("trips", 0) for g in rep["detguard"]) \
+            if rep["detguard"] else None
+    else:
+        vals["hashseed_flagship_wall_s"] = "SKIPPED(budget)"
+    return vals
+
+
 def bench_transport(time_left_fn):
     """ISSUE 18 acceptance: the batched-authenticated-transport section.
     Rows cheapest first under the global deadline:
@@ -2072,6 +2138,17 @@ def main():
     else:
         extra["chaos"] = "SKIPPED(budget)"
         _stale_fill(extra, "chaos")
+
+    # determinism tier (ISSUE 19): detguard overhead (in-process) + the
+    # hash-seed differential flagship pair (subprocesses) — CPU-only
+    if budget_fits("determinism", 140):
+        _stage("determinism bench (CPU-only)...")
+        det_vals = bench_determinism(time_left)
+        _cache_put("determinism", _merge_last_good("determinism", det_vals))
+        extra.update(det_vals)
+    else:
+        extra["determinism"] = "SKIPPED(budget)"
+        _stale_fill(extra, "determinism")
 
     # batched authenticated transport (ISSUE 18): MAC/codec microbench,
     # single-message floor, then the flagship/soak campaign pairs —

@@ -44,11 +44,11 @@ class BucketIndex:
     def maybe_contains(self, key_bytes: bytes) -> bool:
         """False ⇒ definitely absent (the fast negative path); True ⇒ must
         bisect (no false negatives, same contract as the fuse filter)."""
-        return hash(key_bytes) in self._filter
+        return hash(key_bytes) in self._filter  # corelint: disable=hash-order -- process-local membership filter; fingerprints never serialized
 
     def find(self, key_bytes: bytes) -> Optional[int]:
         """Position of the entry with this exact LedgerKey, or None."""
-        if hash(key_bytes) not in self._filter:
+        if hash(key_bytes) not in self._filter:  # corelint: disable=hash-order -- process-local membership filter; fingerprints never serialized
             return None
         i = bisect_left(self._keys, key_bytes)
         if i < len(self._keys) and self._keys[i] == key_bytes:
@@ -153,12 +153,12 @@ class DiskBucketIndex:
         return len(self._keys)
 
     def maybe_contains(self, key_bytes: bytes) -> bool:
-        return hash(key_bytes) in self._filter
+        return hash(key_bytes) in self._filter  # corelint: disable=hash-order -- process-local membership filter; fingerprints never serialized
 
     def find(self, key_bytes: bytes) -> Optional[Tuple[int, int, bool]]:
         """(offset, end, is_dead) of the record with this exact LedgerKey,
         or None — the reference's getOffsetBounds point-lookup."""
-        if hash(key_bytes) not in self._filter:
+        if hash(key_bytes) not in self._filter:  # corelint: disable=hash-order -- process-local membership filter; fingerprints never serialized
             return None
         i = bisect_left(self._keys, key_bytes)
         if i < len(self._keys) and self._keys[i] == key_bytes:

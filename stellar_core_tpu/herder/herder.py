@@ -24,6 +24,7 @@ from ..scp.driver import SCPDriver, ValidationLevel
 from ..scp.scp import SCP, EnvelopeState
 from ..soroban import (decode_tx_set, tx_set_envelopes,
                        tx_set_previous_hash)
+from ..util import detguard
 from ..util import eventlog
 from ..util import logging as slog
 from ..util import tracing
@@ -335,19 +336,21 @@ class Herder(SCPDriver):
         # consensus latency IS virtual (timeout-driven); wall time would
         # report crank speed instead
         self._nominate_started.setdefault(seq, self.clock.now())
-        frames = self.tx_queue.tx_set_frames()
-        tracing.mark_phase("nominate", seq, node=self.trace_node(),
-                           txs=len(frames))
-        tx_set, tx_set_hash, ordered = self.lm.make_tx_set_any(frames)
-        self.pending.add_txset(tx_set_hash, tx_set, ordered)
+        with detguard.region("nomination"):
+            frames = self.tx_queue.tx_set_frames()
+            tracing.mark_phase("nominate", seq, node=self.trace_node(),
+                               txs=len(frames))
+            tx_set, tx_set_hash, ordered = self.lm.make_tx_set_any(frames)
+            self.pending.add_txset(tx_set_hash, tx_set, ordered)
 
-        lcl = self.lm.lcl_header
-        close_time = max(self.clock.system_now(), lcl.scpValue.closeTime + 1)
-        ups = self.upgrades.create_upgrades_for(lcl, close_time)
-        sv = X.StellarValue(txSetHash=tx_set_hash, closeTime=close_time,
-                            upgrades=ups)
-        prev = lcl.scpValue.to_xdr()
-        self.scp.nominate(seq, sv.to_xdr(), prev)
+            lcl = self.lm.lcl_header
+            close_time = max(self.clock.system_now(),
+                             lcl.scpValue.closeTime + 1)
+            ups = self.upgrades.create_upgrades_for(lcl, close_time)
+            sv = X.StellarValue(txSetHash=tx_set_hash, closeTime=close_time,
+                                upgrades=ups)
+            prev = lcl.scpValue.to_xdr()
+            self.scp.nominate(seq, sv.to_xdr(), prev)
 
     # ------------------------------------------------------------------
     # SCPDriver: value semantics
@@ -619,7 +622,7 @@ class Herder(SCPDriver):
         if self._trigger_timer is not None:
             self._trigger_timer.cancel()
         due = self._last_trigger_at + self.ledger_timespan
-        delay = max(0.0, due - self.clock.now())
+        delay = max(0.0, due - self.clock.now())  # corelint: disable=float-discipline -- local timer pacing; close time stays integer
         self._trigger_timer = VirtualTimer(self.clock)
         self._trigger_timer.expires_from_now(
             delay, lambda: self.trigger_next_ledger(next_seq))

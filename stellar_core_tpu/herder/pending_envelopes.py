@@ -193,5 +193,5 @@ class PendingEnvelopes:
                 for _env, env_hash in d[s]:
                     self.queued_index.pop(env_hash, None)
                 del d[s]
-        for h in [h for h, s in self.processed_index.items() if s < slot]:
+        for h in [h for h, s in self.processed_index.items() if s < slot]:  # corelint: disable=iteration-order -- collects keys for keyed deletion; order-free
             del self.processed_index[h]

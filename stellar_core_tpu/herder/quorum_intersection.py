@@ -404,7 +404,7 @@ def _try_symmetric_org_contraction(qmap: Dict[NodeIDb, object]
       each side yields two disjoint validator-level quorums.
     Requires 2*thr_o > n_o for every org; returns None (fall back to full
     enumeration) when any condition fails."""
-    values = list(qmap.values())
+    values = list(qmap.values())  # corelint: disable=iteration-order -- all-equal homogeneity check, order-free
     if not values or any(q is None for q in values):
         return None  # nodes with unknown qsets: full checker handles them
     first = values[0]

@@ -247,7 +247,7 @@ class TransactionQueue:
 
     # ------------------------------------------------------------------
     def get_transactions(self) -> List[TransactionFrame]:
-        return list(self.by_hash.values())
+        return list(self.by_hash.values())  # corelint: disable=iteration-order -- arrival-order inspection snapshot; canonical order is tx_set_frames()
 
     def tx_set_frames(self, max_ops: Optional[int] = None
                       ) -> List[TransactionFrame]:

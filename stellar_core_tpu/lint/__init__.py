@@ -10,11 +10,18 @@ Encodes the repo's cross-PR invariants as AST checks (see rules/):
   lock-order         cycle-free static lock-acquisition graph
   thread-safety      cross-thread fields lock-guarded or owned-by annotated
   raw-lock           threading.Lock/RLock only via util.lockorder.make_lock
+  iteration-order    no set/dict-view iteration into order-sensitive sinks
+                     (XDR, hashing, escaping lists, broadcast) unsorted
+  float-discipline   no floats/true division on protocol-visible values
+  hash-order         no builtin hash() / id()-keyed ordering in consensus
+  rng-discipline     randomness only via an injected seeded random.Random
 
 Run `python -m stellar_core_tpu.lint` (or `make lint`); suppress a
 finding with `# corelint: disable=<rule> -- reason` — suppressions are
 ratcheted by LINT_BASELINE.json.  The thread-safety rule's runtime twin
-is util/racetrace.py (`make race`).
+is util/racetrace.py (`make race`); the determinism rules' runtime twin
+is util/detguard.py and their differential proof is
+simulation/hashseed_diff.py (both under `make determinism`).
 """
 
 from .core import (FileContext, LintReport, Rule, Violation,  # noqa: F401

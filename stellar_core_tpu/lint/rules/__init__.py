@@ -7,6 +7,8 @@ from typing import List
 from ..core import Rule
 from .clock import ClockDisciplineRule
 from .decode_free import DecodeFreeSeamRule
+from .determinism import (FloatDisciplineRule, HashOrderRule,
+                          IterationOrderRule, RngDisciplineRule)
 from .eventlog import EventlogPartitionRule
 from .exceptions import ExceptionHygieneRule
 from .ledger_txn import LedgerTxnPathsRule
@@ -25,6 +27,10 @@ ALL_RULE_CLASSES = (
     LockOrderRule,
     ThreadSafetyRule,
     RawLockRule,
+    IterationOrderRule,
+    FloatDisciplineRule,
+    HashOrderRule,
+    RngDisciplineRule,
 ) + NATIVE_C_RULE_CLASSES
 
 

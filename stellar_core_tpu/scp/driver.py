@@ -97,12 +97,12 @@ class SCPDriver:
         raise NotImplementedError
 
     def stop_timer(self, slot_index: int, timer_id: int) -> None:
-        self.setup_timer(slot_index, timer_id, 0.0, None)
+        self.setup_timer(slot_index, timer_id, 0.0, None)  # corelint: disable=float-discipline -- timer-cancel sentinel delay, local pacing
 
     def compute_timeout(self, round_number: int,
                         is_nomination: bool = False) -> float:
         """Reference: SCPDriver::computeTimeout — linear backoff, capped."""
-        return float(min(round_number + 1, MAX_TIMEOUT_SECONDS))
+        return float(min(round_number + 1, MAX_TIMEOUT_SECONDS))  # corelint: disable=float-discipline -- timer backoff seconds, local pacing; float(int) exact
 
     # --- deterministic hashing for leader election ------------------------
     def _hash_expr(self, slot_index: int, prev: bytes, tag: int,

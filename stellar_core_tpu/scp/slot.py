@@ -101,8 +101,10 @@ class Slot:
 
     def get_current_state(self) -> List:
         out = []
-        for n in set(self.nomination.latest_nominations) | set(
-                self.ballot.latest_envelopes):
+        # sorted(): the union iterates in hash order, and this list is
+        # handed to the overlay as broadcast/pull order
+        for n in sorted(set(self.nomination.latest_nominations) | set(
+                self.ballot.latest_envelopes)):
             e = self.ballot.latest_envelopes.get(n)
             if e is not None:
                 out.append(e)

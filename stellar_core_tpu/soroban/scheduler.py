@@ -153,11 +153,14 @@ def _apply_cluster(base: "_ClusterBase", cluster: Sequence,
     LedgerTxn over `base`; record per-tx results and the serial
     key-insertion order (first-writer order) for the merge."""
     from ..ledger.ledger_txn import LedgerTxn
+    from ..util import detguard
     results = []
     insertion: List[Tuple[int, List[bytes]]] = []
     seen = set()
     try:
-        with LedgerTxn(base) as ltx:       # exit without commit == rollback
+        # regions are thread-local: each cluster worker arms its own
+        with detguard.region("soroban-cluster"), \
+                LedgerTxn(base) as ltx:    # exit without commit == rollback
             for j, frame in enumerate(cluster):
                 results.append(apply_fn(frame, ltx))
                 new_keys = [k for k in ltx._delta if k not in seen]
