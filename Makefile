@@ -103,11 +103,14 @@ bucketdb-slow:
 # incident-observability suite: flight recorder + crash bundles, /health
 # + StatusManager, trace-correlated JSON logging, admin error paths, the
 # metrics/trace exposition surface, and the fleet observability plane
-# (cross-node trace merge, sampling profiler, SLO burn tracking)
+# (cross-node trace merge, sampling profiler, SLO burn tracking,
+# historical time-series store + anomaly detection)
 obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
 		tests/test_eventlog.py tests/test_fleettrace.py \
-		tests/test_sampleprof.py tests/test_slo.py -q -m 'not slow' \
+		tests/test_sampleprof.py tests/test_slo.py \
+		tests/test_timeseries.py tests/test_anomaly.py \
+		-q -m 'not slow' \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # chaos campaigns: the small-topology scenario tier (12-51 nodes —

@@ -141,7 +141,8 @@ def _degraded_report(detail: str) -> dict:
         vs = round(value / base, 2) if base else 0.0
     for section in ("sigs", "replay", "quorum", "bucketlistdb", "chaos",
                     "admission", "catchup_parallel", "catchup_mesh",
-                    "native_close", "fleet", "sampleprof", "fleettrace"):
+                    "native_close", "fleet", "sampleprof", "fleettrace",
+                    "telemetry"):
         got = cache.get(section)
         if not got:
             continue
@@ -1474,6 +1475,194 @@ def bench_fleettrace(time_left_fn):
     }
 
 
+def bench_telemetry(time_left_fn):
+    """Historical telemetry (ISSUE 20), two measurements:
+
+    1. capture ride-along — the wall-cadence TimeSeriesStore thread
+       snapshotting the whole process registry while the 51-node flagship
+       chaos scenario runs.  Interleaved off/on rounds after a discarded
+       warmup, min-of-each arm; the <2% overhead claim is ASSERTED (a
+       capture plane that taxes consensus more than its budget fails the
+       bench before shipping).  The direct accounting (tick count x mean
+       tick cost) rides along for diagnosis when the ratio moves.
+    2. close-p99 vs read-QPS — concurrent snapshot bulk readers
+       (`load_keys` over pinned disk views) at stepped offered rates
+       against live closes over a 100k-account BucketListDB; one curve
+       row per step so a read-path contention regression shows up as a
+       bent curve, not a vague soak slowdown.
+
+    Deadline-aware at every seam: rounds and steps each check the global
+    budget and report partial results with an explicit note."""
+    import logging as _pylogging
+    import random as _random
+    import threading
+
+    from stellar_core_tpu.simulation import chaos as chaos_mod
+    from stellar_core_tpu.util.metrics import registry
+    from stellar_core_tpu.util.timeseries import TimeSeriesStore
+
+    vals = {}
+    rounds = int(os.environ.get("BENCH_TELEMETRY_ROUNDS", "2"))
+
+    # --- 1. capture-thread overhead on the 51-node flagship ----------
+    def flagship():
+        sc = chaos_mod.scenario_partition_flap_heal(17, 3)
+        t0 = time.perf_counter()
+        res = chaos_mod.run_scenario(sc)
+        return time.perf_counter() - t0, res
+
+    est_run = 60.0
+    prev_level = _pylogging.getLogger("stellar").level
+    _pylogging.getLogger("stellar").setLevel(_pylogging.WARNING)
+    off_s, on_s = [], []
+    passed = True
+    ticks = 0
+    try:
+        if time_left_fn() < est_run * 3:
+            vals["telemetry_capture"] = \
+                "SKIPPED(budget, pre-empted mid-section)"
+        else:
+            flagship()    # warmup: import/jit/page-in costs, discarded
+            for _ in range(rounds):
+                if time_left_fn() < est_run * 2.5:
+                    break
+                w, res = flagship()
+                off_s.append(w)
+                passed = passed and res.passed
+                # production cadence (1s), production payload: the whole
+                # registry, which at this point carries all 51 nodes
+                ts = TimeSeriesStore(cadence_s=1.0)
+                ts.start()
+                try:
+                    w, res = flagship()
+                finally:
+                    ts.stop()
+                on_s.append(w)
+                passed = passed and res.passed
+                ticks = ts.seq
+    finally:
+        _pylogging.getLogger("stellar").setLevel(prev_level)
+    if on_s:
+        base, with_ts = min(off_s), min(on_s)
+        overhead = with_ts / base
+        tick = registry().snapshot(prefix="timeseries.").get(
+            "timeseries.capture.tick-time", {})
+        vals.update({
+            "telemetry_capture_off_s": round(base, 2),
+            "telemetry_capture_on_s": round(with_ts, 2),
+            "telemetry_capture_overhead_ratio": round(overhead, 4),
+            "telemetry_capture_rounds": len(on_s),
+            "telemetry_capture_ticks": ticks,
+            "telemetry_capture_tick_ms": round(
+                tick.get("mean_s", 0.0) * 1e3, 3),
+            "telemetry_flagship_nodes": 51,
+            "telemetry_flagship_passed": passed,
+        })
+        # the always-on claim: historical capture rides along under 2%
+        assert overhead < 1.02, (
+            f"telemetry capture overhead {overhead:.3f}x exceeds the 2% "
+            f"ride-along budget (off={base:.2f}s on={with_ts:.2f}s)")
+    elif "telemetry_capture" not in vals:
+        vals["telemetry_capture"] = "SKIPPED(budget, pre-empted mid-section)"
+
+    # --- 2. close-p99 vs read-QPS over a 100k-account BucketListDB ---
+    if time_left_fn() < 180.0:
+        vals["telemetry_curve"] = "SKIPPED(budget, pre-empted mid-section)"
+        return vals
+    from stellar_core_tpu import xdr as X
+    from stellar_core_tpu.simulation.loadgen import AdmissionCampaign
+
+    accounts = int(os.environ.get("BENCH_TELEMETRY_ACCOUNTS", "100000"))
+    cap = 200
+    _stage(f"telemetry contention curve ({accounts} accounts over "
+           "BucketListDB)...")
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.perf_counter()
+        c = AdmissionCampaign(n_accounts=accounts, workdir=d,
+                              max_tx_set_ops=cap, max_backlog=2000)
+        vals["telemetry_curve_accounts"] = accounts
+        vals["telemetry_curve_install_s"] = round(
+            time.perf_counter() - t0, 1)
+        try:
+            c.run(n_ledgers=1, offered_per_ledger=cap)   # page-in round
+            rng = _random.Random(23)
+            keys = [X.account_key_xdr(
+                c.pool.secret(rng.randrange(c.pool.n)).public_key.ed25519)
+                for _ in range(2048)]
+            n_threads, batch = 4, 64
+            curve = []
+            for target_qps in (0, 5_000, 20_000, 80_000):
+                if time_left_fn() < 45.0:
+                    vals["telemetry_curve_note"] = \
+                        "pre-empted mid-curve (budget); rows above stand"
+                    break
+                stop = threading.Event()
+                reads = []
+                threads = []
+                snaps = []
+                for t in range(n_threads if target_qps else 0):
+                    # snapshots built between steps (main thread only);
+                    # immutable buckets + store pins make the concurrent
+                    # reads safe while closes advance the live list
+                    snap = c.mgr.bucket_list.snapshot(
+                        c.mgr.last_closed_ledger_seq, store=c.store)
+                    snaps.append(snap)
+                    box = [0]
+                    reads.append(box)
+                    trng = _random.Random(100 + t)
+                    interval = batch / (target_qps / n_threads)
+
+                    def read_loop(snap=snap, box=box, trng=trng,
+                                  interval=interval):
+                        nxt = time.perf_counter()
+                        while not stop.is_set():
+                            snap.load_keys([
+                                keys[trng.randrange(len(keys))]
+                                for _ in range(batch)])
+                            box[0] += batch
+                            nxt += interval
+                            delay = nxt - time.perf_counter()
+                            if delay > 0:
+                                time.sleep(delay)
+                            else:
+                                nxt = time.perf_counter()  # saturated
+                    th = threading.Thread(target=read_loop,
+                                          name=f"bench-reader-{t}",
+                                          daemon=True)
+                    threads.append(th)
+                    th.start()
+                registry().timer("ledger.ledger.close").reset()
+                t0 = time.perf_counter()
+                c.run(n_ledgers=3, offered_per_ledger=cap)
+                step_wall = time.perf_counter() - t0
+                stop.set()
+                for th in threads:
+                    th.join()
+                for snap in snaps:
+                    snap.release()
+                cl = registry().snapshot(prefix="ledger.ledger.").get(
+                    "ledger.ledger.close", {})
+                curve.append({
+                    "target_read_qps": target_qps,
+                    "achieved_read_qps": round(
+                        sum(b[0] for b in reads) / step_wall, 1),
+                    "close_p50_ms": round(cl.get("p50_s", 0.0) * 1e3, 2),
+                    "close_p99_ms": round(cl.get("p99_s", 0.0) * 1e3, 2),
+                    "ledgers": 3,
+                })
+            vals["telemetry_curve"] = curve
+            if curve:
+                vals["telemetry_read_peak_qps"] = max(
+                    row["achieved_read_qps"] for row in curve)
+                vals["telemetry_curve_baseline_p99_ms"] = \
+                    curve[0]["close_p99_ms"]
+                vals["telemetry_curve_loaded_p99_ms"] = \
+                    curve[-1]["close_p99_ms"]
+        finally:
+            c.close()
+    return vals
+
+
 def bench_merge_throughput(workdir):
     """ISSUE 3 acceptance: streaming-merge throughput.  Two synthetic
     buckets (disjoint + colliding keys) merged by the decoded path and by
@@ -2259,6 +2448,18 @@ def main():
     else:
         extra["fleettrace"] = "SKIPPED(budget)"
         _stale_fill(extra, "fleettrace")
+
+    # historical telemetry (ISSUE 20): capture ride-along on the 51-node
+    # flagship (<2% asserted) + close-p99-vs-read-QPS contention curve
+    # over a 100k-account BucketListDB — CPU-only
+    if budget_fits("telemetry", 420):
+        _stage("telemetry capture + read-contention bench (CPU-only)...")
+        tl_vals = bench_telemetry(time_left)
+        _cache_put("telemetry", _merge_last_good("telemetry", tl_vals))
+        extra.update(tl_vals)
+    else:
+        extra["telemetry"] = "SKIPPED(budget)"
+        _stale_fill(extra, "telemetry")
 
     if not budget_fits("device probe + accel sections", 240):
         # nothing device-side fits anymore: emit what the CPU sections

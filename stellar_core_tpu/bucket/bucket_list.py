@@ -16,6 +16,7 @@ whether merges run synchronously (executor=None) or on a thread pool.
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, List, Optional
 
 from ..crypto.sha import SHA256
@@ -106,6 +107,11 @@ class BucketList:
         self.store = None
         self.resident_levels = NUM_LEVELS
         self.peak_decoded_entries = 0
+        # close-blocked-on-merge seconds for the most recent add_batch
+        # (ISSUE 20 contention observability: read by the close path's
+        # CloseCostRecord; 0.0 when every spill commit found its merge
+        # already resolved)
+        self.last_add_stall_s = 0.0  # corelint: disable=float-discipline -- contention telemetry, never ledger state
 
     # -- residency (BucketListDB phase 2) ------------------------------------
     def configure_residency(self, store, resident_levels: int) -> None:
@@ -170,11 +176,20 @@ class BucketList:
         level above, commit the previously prepared merge and prepare the
         next one (reference: BucketListBase::addBatch)."""
         release_assert(ledger_seq > 0, "ledger_seq must be positive")
+        stall_s = 0.0  # corelint: disable=float-discipline -- contention telemetry, never ledger state
         with _registry().timer("bucket.batch.addtime").time():
             for i in range(NUM_LEVELS - 1, 0, -1):
                 if level_should_spill(ledger_seq, i - 1):
                     spill = self.levels[i - 1].snap_curr()
+                    # contention seam (ISSUE 20): a spill commit whose
+                    # background merge is still running blocks the close
+                    # right here — time exactly that wait
+                    nxt = self.levels[i].next
+                    blocked = nxt is not None and not nxt.done
+                    t0 = time.perf_counter() if blocked else 0.0  # corelint: disable=float-discipline -- contention telemetry, never ledger state
                     self.levels[i].commit()
+                    if blocked:
+                        stall_s += time.perf_counter() - t0
                     # deep levels merge decode-free, file-to-file
                     raw = self.store if (self.store is not None
                                          and i >= self.resident_levels) \
@@ -191,6 +206,11 @@ class BucketList:
             self.levels[0].commit()
             if self.store is not None:
                 self._note_decoded_peak()
+        # recorded every batch (0.0 included): the merge-stall series
+        # must baseline at "no stall" so the anomaly detector sees a
+        # stall APPEARING, not only stalls getting worse
+        self.last_add_stall_s = stall_s
+        _registry().timer("bucket.merge.stall").update(stall_s)
 
     def hash(self) -> bytes:
         """bucketListHash in the ledger header: SHA-256 over level hashes

@@ -22,6 +22,7 @@ from typing import Dict, Iterable, List, Optional, Set
 from ..crypto.sha import SHA256
 from ..util import eventlog
 from ..util.lockorder import make_rlock
+from ..util.metrics import registry as _registry
 from ..util.racetrace import race_checked
 from .bucket import DEAD_TAG, Bucket, pack_meta
 from .index import DiskBucketIndex
@@ -340,6 +341,10 @@ class BucketListStore(BucketDir):
         # because gc() holds it across the scan and _protected_hashes()
         # re-acquires
         self._lock = make_rlock("bucket.store")
+        # weak source: a torn-down store reads as null, never pins the
+        # store graph in the process-global registry
+        _registry().weak_gauge("bucketlistdb.pin.active", self,
+                               BucketListStore.pin_count)
 
     # -- streaming merge output ----------------------------------------------
     def stream_writer(self, protocol_version: int) -> BucketStreamWriter:
@@ -440,6 +445,13 @@ class BucketListStore(BucketDir):
         return idx
 
     # -- snapshot pinning ----------------------------------------------------
+    def pin_count(self) -> int:
+        """Distinct bucket files currently pinned (snapshot readers +
+        in-flight merge outputs) — the bucketlistdb.pin.active gauge and
+        the CloseCostRecord pin column."""
+        with self._lock:
+            return len(self._pins)
+
     def pin(self, hex_hashes: Iterable[str]) -> None:
         with self._lock:
             for hh in hex_hashes:

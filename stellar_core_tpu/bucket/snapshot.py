@@ -172,7 +172,8 @@ class _DiskView:
 @race_checked
 class SearchableBucketListSnapshot:
     __slots__ = ("ledger_seq", "_views", "_store", "_pinned", "_load_timer",
-                 "_probe_counters", "_live_count", "_race_fields_")
+                 "_probe_counters", "_live_count", "_pin_t0", "_pin_timer",
+                 "_read_meter", "_race_fields_")
 
     def __init__(self, bucket_list, ledger_seq: int = 0, store=None):
         self.ledger_seq = ledger_seq
@@ -208,6 +209,12 @@ class SearchableBucketListSnapshot:
         self._probe_counters = {
             level: reg.counter(f"bucketlistdb.probe.level-{level}")
             for level in {lv for lv, _ in self._views}}
+        # contention observability (ISSUE 20): how long readers hold GC
+        # pins (recorded at release) and bulk-read key volume — the two
+        # series the close-p99-vs-read-QPS curve correlates against
+        self._pin_t0 = time.perf_counter()
+        self._pin_timer = reg.timer("bucketlistdb.pin.held")
+        self._read_meter = reg.meter("bucketlistdb.read.keys")
 
     # -- lifecycle -----------------------------------------------------------
     def release(self) -> None:
@@ -217,6 +224,9 @@ class SearchableBucketListSnapshot:
         if self._store is not None and self._pinned:
             self._store.unpin(self._pinned)
             self._pinned = []
+            # reader-held pin time: init (pin) to release (unpin); only
+            # recorded for snapshots that actually held store pins
+            self._pin_timer.update(time.perf_counter() - self._pin_t0)
         for _, view in self._views:
             if isinstance(view, _DiskView):
                 view.close()
@@ -253,6 +263,8 @@ class SearchableBucketListSnapshot:
         prefetch path for whole tx sets."""
         remaining = {key if isinstance(key, bytes) else key.to_xdr()
                      for key in keys}
+        if remaining:
+            self._read_meter.mark(len(remaining))
         out: Dict[bytes, LedgerEntry] = {}
         for level, view in self._views:
             if not remaining:

@@ -30,6 +30,7 @@ from ..util import logging as slog
 from ..util import tracing
 from ..util.assertions import release_assert
 from ..util.metrics import registry as _registry
+from .costs import CloseCostLedger
 from .ledger_txn import LedgerTxn, LedgerTxnRoot
 
 log = slog.get("Ledger")
@@ -187,6 +188,20 @@ class LedgerManager:
         # known-empty (fresh chain).
         self.soroban_parallel_apply = True
         self._ttl_expiry: Optional[dict] = {}
+        # per-close cost ledger (ISSUE 20): one CloseCostRecord per
+        # sealed ledger, served at /closecosts.  The Python close fills
+        # every field; native closes record seq/txs/total and the
+        # bucket-side fields (phase splits and cache traffic are engine-
+        # internal there and read as 0).
+        self.close_costs = CloseCostLedger()
+        _registry().weak_gauge("closecost.records.retained",
+                               self.close_costs, len)
+        self._last_gc_seq = 0
+        # injected-regression seam (ISSUE 20 anomaly proof): > 0 spins
+        # the close for this many extra seconds.  Spins on perf_counter,
+        # which detguard leaves unpatched, so the throttle is legal
+        # inside the guarded close region.
+        self.debug_close_throttle_s = 0.0  # corelint: disable=float-discipline -- test-only throttle knob, never ledger state
 
     # -- genesis ------------------------------------------------------------
     def start_new_ledger(self,
@@ -263,6 +278,7 @@ class LedgerManager:
         hashes; snapshot-pinned files survive regardless."""
         if ledger_seq % self.BUCKET_GC_INTERVAL == 0:
             self.bucket_store.gc(self.bucket_list.referenced_hashes())
+            self._last_gc_seq = ledger_seq
 
     def build_root(self, header: X.LedgerHeader,
                    raw_entries) -> LedgerTxnRoot:
@@ -432,6 +448,12 @@ class LedgerManager:
             close_time = stellar_value.closeTime
 
         seq = self.lcl_header.ledgerSeq + 1
+        # cost-ledger baselines (ISSUE 20): entry-cache traffic and
+        # resident footprint are reported as per-close deltas; taken
+        # before the prefetch so its cache fills count toward this close
+        _hits0 = _registry().meter("bucketlistdb.cache.hit").count
+        _miss0 = _registry().meter("bucketlistdb.cache.miss").count
+        _resident0 = self.bucket_list.decoded_entry_count()
         if self.root.disk_backed and ordered:
             # bulk prefetch the tx set's account entries into the entry
             # cache: one batched, file-order snapshot pass instead of a
@@ -458,18 +480,21 @@ class LedgerManager:
         ltx.commit_header(header)
 
         # phase 1: fees + seq nums for every tx, before any applies
+        _fee_t0 = time.perf_counter()
         with tracing.span("ledger.fee-process"), \
                 _registry().timer("ledger.fee.process").time():
             for f in ordered:
                 with LedgerTxn(ltx) as fee_ltx:
                     f.process_fee_seq_num(fee_ltx)
                     fee_ltx.commit()
+        _fee_s = time.perf_counter() - _fee_t0
 
         # phase 2: apply — classic serially, then the Soroban phase
         # (footprint-clustered, optionally parallel)
         result_pairs: List[X.TransactionResultPair] = []
         split = len(ordered) - len(soroban_frames) if soroban_frames \
             else len(ordered)
+        _apply_t0 = time.perf_counter()
         with tracing.span("ledger.tx-apply"):
             for f in ordered[:split]:
                 with tracing.span("tx.apply"):
@@ -481,6 +506,7 @@ class LedgerManager:
                         ltx, ordered[split:], close_time, seq):
                     result_pairs.append(X.TransactionResultPair(
                         transactionHash=f.content_hash(), result=res))
+        _apply_s = time.perf_counter() - _apply_t0
 
         # state archival: expired TTLs evict at the close edge (before
         # the delta is split for the bucket list)
@@ -533,6 +559,7 @@ class LedgerManager:
             self.invariants.check_on_ledger_close(inv_ctx,
                                                   needs_buckets=False)
 
+        _seal_t0 = time.perf_counter()
         with tracing.span("ledger.seal"):
             self.bucket_list.add_batch(seq, header.ledgerVersion,
                                        init_entries, live_entries, dead_keys)
@@ -550,6 +577,7 @@ class LedgerManager:
             header.bucketListHash = self.bucket_list.hash()
             self._update_skip_list(header)
             ltx.commit_header(header)
+        _seal_s = time.perf_counter() - _seal_t0
 
         if inv_ctx is not None:
             # post-bucket phase: a violation means the bucket list is
@@ -586,6 +614,13 @@ class LedgerManager:
         result_entry = X.TransactionHistoryResultEntry(
             ledgerSeq=seq, txResultSet=result_set)
 
+        if self.debug_close_throttle_s > 0.0:  # corelint: disable=float-discipline -- test-only throttle knob, never ledger state
+            # injected-regression seam: spin out the close so the
+            # anomaly detector has a real sustained departure to catch
+            _spin_until = time.perf_counter() + self.debug_close_throttle_s
+            while time.perf_counter() < _spin_until:
+                pass
+
         # registry lookups are NOT cached across the close: /clearmetrics
         # resets metrics in place, but reset_registry() (tests) swaps the
         # whole registry — a cached reference would feed a dead object
@@ -600,6 +635,25 @@ class LedgerManager:
         tracing.mark_phase("close-seal", seq, txs=len(ordered),
                            dur_ms=round(dur_s * 1e3, 3))
         _registry().meter("ledger.transaction.apply").mark(len(ordered))
+        # per-close cost row (ISSUE 20): the post-mortem unit /closecosts
+        # serves and anomaly bundles ship.  Deltas close over the whole
+        # close (prefetch, apply loads and seal-phase snapshot churn all
+        # land in the cache counters).
+        _resident1 = self.bucket_list.decoded_entry_count()
+        self.close_costs.add(
+            seq=seq, txs=len(ordered), total_s=dur_s,
+            fee_s=_fee_s, apply_s=_apply_s, seal_s=_seal_s,
+            merge_stall_s=self.bucket_list.last_add_stall_s,
+            cache_hits=_registry().meter(
+                "bucketlistdb.cache.hit").count - _hits0,
+            cache_misses=_registry().meter(
+                "bucketlistdb.cache.miss").count - _miss0,
+            pin_count=self.bucket_store.pin_count()
+            if self.bucket_store is not None else 0,
+            resident_entries=_resident1,
+            resident_delta=_resident1 - _resident0,
+            gc_backlog=seq - self._last_gc_seq
+            if self.bucket_store is not None else 0)
         if self.meta_stream is not None:
             self._emit_close_meta(header_entry, meta_tx_set, result_pairs)
         return ClosedLedgerArtifacts(header_entry, tx_entry, result_entry)

@@ -200,8 +200,28 @@ class NativeLedgerCloser:
         reg = _registry()
         reg.meter("ledger.native.closes").mark()
         reg.meter("ledger.transaction.apply").mark(len(result_set.results))
+        # injected-regression seam (ISSUE 20): same spin as the Python
+        # close so anomaly proof tests work regardless of engine
+        if mgr.debug_close_throttle_s > 0.0:  # corelint: disable=float-discipline -- test-only throttle knob, never ledger state
+            _spin_until = time.perf_counter() + mgr.debug_close_throttle_s
+            while time.perf_counter() < _spin_until:
+                pass
         dur_s = time.perf_counter() - t0
         reg.timer("ledger.ledger.close").update(dur_s)
+        # close cost record (ISSUE 20): phase splits and entry-cache
+        # traffic are engine-internal on this path — 0 marks "not
+        # attributable", total_s still carries the close cost
+        mgr.close_costs.add(
+            seq=seq, txs=len(result_set.results), total_s=dur_s,
+            fee_s=0.0, apply_s=0.0, seal_s=0.0,  # corelint: disable=float-discipline -- cost-record "not attributable" sentinels, monitoring-only
+            merge_stall_s=mgr.bucket_list.last_add_stall_s,
+            cache_hits=0, cache_misses=0,
+            pin_count=(mgr.bucket_store.pin_count()
+                       if mgr.bucket_store is not None else 0),
+            resident_entries=mgr.bucket_list.decoded_entry_count(),
+            resident_delta=0,
+            gc_backlog=(seq - mgr._last_gc_seq
+                        if mgr.bucket_store is not None else 0))
         # same flight-event name as the Python close (post-mortem greps
         # key on it); the engine field tells the paths apart
         eventlog.record("Ledger", "INFO", "ledger close sealed",

@@ -68,6 +68,28 @@ def _config_fingerprint(app) -> dict:
     }
 
 
+def _timeseries_bundle(app) -> dict:
+    """Trailing time-series window for crash bundles (weakref-fed)."""
+    if app is None or app.timeseries is None:
+        return {"gone": True}
+    return app.timeseries.bundle()
+
+
+def _anomaly_bundle(app) -> dict:
+    """Anomaly verdicts for crash bundles (weakref-fed)."""
+    if app is None or app.anomaly is None:
+        return {"gone": True}
+    return app.anomaly.report()
+
+
+def _app_timeseries(app):
+    return app.timeseries if app is not None else None
+
+
+def _app_closecosts(app):
+    return app.lm.close_costs if app is not None else None
+
+
 class Application:
     def __init__(self, config: Config,
                  clock: Optional[VirtualClock] = None,
@@ -263,6 +285,40 @@ class Application:
         from .maintainer import Maintainer
         self.maintainer = Maintainer(self)
 
+        # retrospective telemetry (ISSUE 20) --------------------------------
+        # time-series capture + adaptive anomaly baselines.  Both run on
+        # the observability plane OUTSIDE detguard regions: a VirtualTimer
+        # on the crank loop under VIRTUAL_TIME (tests drive them
+        # deterministically), a wall-cadence daemon thread on real nodes.
+        self.timeseries = None
+        self._ts_timer = None
+        self.anomaly = None
+        self._anomaly_timer = None
+        if config.TIMESERIES_CADENCE_S > 0:
+            from ..util.timeseries import TimeSeriesStore
+            self.timeseries = TimeSeriesStore(
+                cadence_s=config.TIMESERIES_CADENCE_S)
+            if self.clock.mode is ClockMode.VIRTUAL_TIME:
+                self._arm_ts_timer()
+            else:
+                self.timeseries.start()
+            eventlog.register_bundle_source(
+                "timeseries", lambda: _timeseries_bundle(ref()))
+        if config.ANOMALY_EVAL_CADENCE_S > 0:
+            from ..util.anomaly import AnomalyDetector, default_tracked
+            self.anomaly = AnomalyDetector(
+                default_tracked(),
+                timeseries=lambda: _app_timeseries(ref()),
+                closecosts=lambda: _app_closecosts(ref()),
+                source=config.NODE_NAME or "local")
+            self._arm_anomaly_timer()
+            eventlog.register_bundle_source(
+                "anomaly", lambda: _anomaly_bundle(ref()))
+            if self.slo_tracker is not None:
+                # leading indicator: /slo reports active anomalies before
+                # the burn budget trips
+                self.slo_tracker.attach_anomaly_source(self.anomaly.active)
+
         # http admin --------------------------------------------------------
         self.http = None
         if config.HTTP_PORT:
@@ -428,10 +484,48 @@ class Application:
         t.expires_from_now(self.config.SLO_EVAL_CADENCE_S, tick)
         self._slo_timer = t
 
+    def _arm_ts_timer(self) -> None:
+        """Repeating time-series capture under VIRTUAL_TIME (real nodes
+        use the store's own wall-cadence daemon instead).  Capture
+        stamps virtual seconds so exported curves line up with the
+        simulation's close cadence."""
+        from ..util.clock import VirtualTimer
+        t = VirtualTimer(self.clock)
+
+        def tick() -> None:
+            if self._stopped:
+                return
+            self.timeseries.capture(now=self.clock.now())
+            t.expires_from_now(self.config.TIMESERIES_CADENCE_S, tick)
+
+        t.expires_from_now(self.config.TIMESERIES_CADENCE_S, tick)
+        self._ts_timer = t
+
+    def _arm_anomaly_timer(self) -> None:
+        """Repeating anomaly evaluation on the clock loop (same shape as
+        the SLO timer; works under both clock modes)."""
+        from ..util.clock import VirtualTimer
+        t = VirtualTimer(self.clock)
+
+        def tick() -> None:
+            if self._stopped:
+                return
+            self.anomaly.evaluate()
+            t.expires_from_now(self.config.ANOMALY_EVAL_CADENCE_S, tick)
+
+        t.expires_from_now(self.config.ANOMALY_EVAL_CADENCE_S, tick)
+        self._anomaly_timer = t
+
     def stop(self) -> None:
         self._stopped = True
         if self._slo_timer is not None:
             self._slo_timer.cancel()
+        if self._ts_timer is not None:
+            self._ts_timer.cancel()
+        if self._anomaly_timer is not None:
+            self._anomaly_timer.cancel()
+        if self.timeseries is not None:
+            self.timeseries.stop()
         if self.lm.native_closer is not None:
             # move ledger authority back to Python (rebuilds buckets and,
             # with a database attached, persists the final LCL durably)

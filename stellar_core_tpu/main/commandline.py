@@ -477,6 +477,44 @@ def cmd_dump_xdr(args) -> int:
     return 0
 
 
+def cmd_tsdump(args) -> int:
+    """Summarize a persisted time-series dump (util/timeseries crash
+    artifact): per-series point counts and last values, or the raw
+    points of one series with --metric."""
+    from ..util.timeseries import load_dump
+    try:
+        doc = load_dump(args.path)
+    except (OSError, ValueError) as exc:
+        print(f"tsdump: {exc}", file=sys.stderr)
+        return 1
+    series = doc["series"]
+    if args.metric:
+        points = series.get(args.metric)
+        if points is None:
+            print(f"tsdump: no series {args.metric!r} in dump "
+                  f"(has {len(series)})", file=sys.stderr)
+            return 1
+        for p in points:
+            if p["seq"] > args.since:
+                print(json.dumps(p))
+        return 0
+    rows = []
+    for name in sorted(series):
+        points = [p for p in series[name] if p["seq"] > args.since]
+        if not points:
+            continue
+        last = points[-1]
+        rows.append({"metric": name, "points": len(points),
+                     "first_seq": points[0]["seq"],
+                     "last_seq": last["seq"], "last": last["v"]})
+    print(json.dumps({"kind": doc.get("kind"),
+                      "reason": doc.get("reason"),
+                      "cadence_s": doc.get("cadence_s"),
+                      "next_since": doc.get("next_since"),
+                      "series": rows}, indent=2))
+    return 0
+
+
 def cmd_diag_bucket_stats(args) -> int:
     """Per-level bucket statistics (reference: `stellar-core
     diag-bucket-stats` — entry counts by type and size per level)."""
@@ -874,6 +912,14 @@ def main(argv=None) -> int:
     s.add_argument("--filetype", choices=sorted(_XDR_TYPES),
                    default="ledger-header")
     s.set_defaults(fn=cmd_dump_xdr)
+
+    s = sub.add_parser("tsdump", help="summarize a time-series dump file")
+    s.add_argument("path")
+    s.add_argument("--metric", default="",
+                   help="print the raw points of ONE series")
+    s.add_argument("--since", type=int, default=0,
+                   help="only points with capture seq > SINCE")
+    s.set_defaults(fn=cmd_tsdump)
 
     s = sub.add_parser("dump-ledger", help="dump live ledger entries")
     s.add_argument("--conf", required=True)

@@ -150,6 +150,16 @@ class Config:
     SLO_ADMISSION_P99_S: float = 0.5         # admission-latency objective
     SLO_CATCHUP_RATE: float = 20.0           # ledgers/s replay objective
     SLO_BURN_BUDGET: float = 0.10            # breach fraction allowed
+    # Retrospective telemetry (ISSUE 20).  The in-process time-series
+    # store (util/timeseries) snapshots the metric registry every
+    # TIMESERIES_CADENCE_S seconds — a VirtualTimer under VIRTUAL_TIME
+    # (tests crank it), a wall-cadence daemon on real nodes — and serves
+    # /timeseries + tsdump.  0 = off.
+    TIMESERIES_CADENCE_S: float = 0.0
+    # Adaptive anomaly baselines (util/anomaly): EWMA+MAD regression
+    # watch over close p99 / admission latency / merge stall / cache hit
+    # rate, evaluated every ANOMALY_EVAL_CADENCE_S seconds.  0 = off.
+    ANOMALY_EVAL_CADENCE_S: float = 0.0
     # Soroban execution subsystem (ISSUE 17).  These override the
     # process-wide SorobanNetworkConfig (soroban/config.py) — resource
     # limits live OFF-ledger here, so enabling them never perturbs
@@ -252,6 +262,7 @@ class Config:
             "NODE_NAME", "SAMPLEPROF", "SLO_EVAL_CADENCE_S",
             "SLO_CLOSE_P99_S", "SLO_ADMISSION_P99_S", "SLO_CATCHUP_RATE",
             "SLO_BURN_BUDGET",
+            "TIMESERIES_CADENCE_S", "ANOMALY_EVAL_CADENCE_S",
             "SOROBAN_PARALLEL_APPLY", "SOROBAN_TX_MAX_INSTRUCTIONS",
             "SOROBAN_TX_MAX_MEMORY_BYTES", "SOROBAN_LEDGER_MAX_TX_COUNT",
             "SOROBAN_LEDGER_MAX_INSTRUCTIONS",

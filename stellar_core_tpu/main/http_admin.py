@@ -209,6 +209,41 @@ class CommandHandler:
                                          "configured"}, 404)
                         else:
                             self._reply(self._snap(tracker.report))
+                    elif url.path == "/timeseries":
+                        # retrospective telemetry (util/timeseries):
+                        # watermark-incremental history of the metric
+                        # registry, same since/next_since contract as
+                        # /tracespans
+                        store = getattr(app, "timeseries", None)
+                        if store is None:
+                            self._reply({"error": "no time-series store "
+                                         "configured"}, 404)
+                        else:
+                            from ..util.metrics import METRIC_NAME_RE
+                            qs = parse_qs(url.query)
+                            since = _int_param(qs, "since", default=0)
+                            metric = qs.get("metric", [""])[0]
+                            if metric and not METRIC_NAME_RE.match(metric):
+                                raise _BadRequest(
+                                    f"malformed metric name {metric!r}")
+                            doc = self._snap(lambda: store.doc(
+                                since, metric=metric or None))
+                            self._reply_raw(json.dumps(doc).encode(),
+                                            "application/json")
+                    elif url.path == "/closecosts":
+                        # per-close cost ledger (ledger/costs): one row
+                        # per sealed ledger past the caller's watermark
+                        ring = getattr(getattr(app, "lm", None),
+                                       "close_costs", None)
+                        if ring is None:
+                            self._reply({"error": "no close-cost ledger "
+                                         "configured"}, 404)
+                        else:
+                            qs = parse_qs(url.query)
+                            since = _int_param(qs, "since", default=0)
+                            doc = self._snap(lambda: ring.doc(since))
+                            self._reply_raw(json.dumps(doc).encode(),
+                                            "application/json")
                     elif url.path == "/quorum":
                         transitive = parse_qs(url.query).get(
                             "transitive", ["false"])[0] == "true"
@@ -395,7 +430,8 @@ class CommandHandler:
 
 _ENDPOINTS = [
     "/info", "/health", "/dumpflight", "/metrics", "/trace",
-    "/tracespans", "/profile", "/slo", "/quorum",
+    "/tracespans", "/profile", "/slo", "/timeseries", "/closecosts",
+    "/quorum",
     "/peers", "/scp", "/tx", "/ll",
     "/logrotate", "/manualclose", "/bans", "/ban", "/unban", "/connect",
     "/droppeer", "/maintenance", "/clearmetrics", "/self-check",

@@ -842,7 +842,10 @@ class Fleet:
         self.scraper = FleetScraper(
             {f"node-{i}": self._metrics_fetcher(self.nodes[i])
              for i in range(self.n_nodes)},
-            tracker=self.slo_tracker).start()
+            tracker=self.slo_tracker,
+            # per-node regression verdicts in fleet-report.json; bound
+            # ring memory against nodes that leave the fleet for good
+            anomaly=True, retention_s=600.0).start()
         self.note(f"launched {self.n_nodes} run processes")
 
     def _metrics_fetcher(self, node: FleetNode):

@@ -246,25 +246,52 @@ class FleetScraper:
     """Polls every node's metric snapshot on a cadence into a bounded
     ring per node (timestamped), derives SLO curves and per-node
     divergence deltas, and optionally drives a util/slo.SLOTracker with
-    every node's snapshot (fleet-wide burn windows)."""
+    every node's snapshot (fleet-wide burn windows).
 
-    # the standing fleet curves: (label, metric, field)
+    With ``anomaly=True`` every scraped node gets its OWN
+    util/anomaly.AnomalyDetector (gauge registration off — N nodes in
+    one coordinator process must not fight over anomaly.active.*), fed
+    each sweep; ``report()`` then carries per-node anomaly verdicts.
+    ``retention_s`` bounds memory against nodes that leave the fleet
+    for good: a node whose last successful scrape is older than the
+    window is EVICTED (ring + detector dropped, ``fleet.scrape.evicted``
+    counted).  A node that merely restarts inside the window keeps its
+    history; an evicted node that re-appears starts a fresh ring."""
+
+    # the standing fleet curves: (label, metric, field).  The last three
+    # are the read-path contention axes (ISSUE 20): merge stall inside
+    # close, reader-held pin time, and bulk-read key throughput — the
+    # inputs to the close-p99-vs-read-QPS story.
     CURVES = (
         ("close_p99_s", "ledger.ledger.close", "p99_s"),
         ("admission_depth", "herder.admission.depth", "value"),
         ("shed_count", "herder.admission.overload", "count"),
+        ("merge_stall_p99_s", "bucket.merge.stall", "p99_s"),
+        ("pin_held_p99_s", "bucketlistdb.pin.held", "p99_s"),
+        ("read_qps", "bucketlistdb.read.keys", "recent_rate"),
     )
 
     def __init__(self,
                  fetchers: Dict[str, Callable[[], dict]],
                  cadence_s: float = SCRAPE_CADENCE_S,
                  ring: int = SCRAPE_RING,
-                 tracker=None):
+                 tracker=None,
+                 retention_s: Optional[float] = None,
+                 anomaly: bool = False):
         self._fetchers = dict(fetchers)
         self.cadence_s = cadence_s
         self.tracker = tracker
+        self.retention_s = retention_s
+        self.anomaly = anomaly
+        self._ring_len = ring
         self._rings: Dict[str, deque] = {
             name: deque(maxlen=ring) for name in self._fetchers}
+        # per-node last SUCCESSFUL scrape time (scraper-relative);
+        # retention measures from scraper start for never-seen nodes
+        self._last_ok: Dict[str, float] = {
+            name: 0.0 for name in self._fetchers}
+        self._detectors: Dict[str, object] = {}
+        self._evicted = 0
         self._lock = make_lock("fleettrace.scraper")
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -300,7 +327,8 @@ class FleetScraper:
     def sweep(self) -> int:
         """One pass over every node; returns the number of successful
         scrapes.  A node that fails to answer (killed by chaos, mid-
-        restart) counts an error and keeps its ring as-is."""
+        restart) counts an error and keeps its ring as-is until the
+        retention window (when set) expires it."""
         ok = 0
         reg = _registry()
         for name, fetch in self._fetchers.items():
@@ -312,14 +340,53 @@ class FleetScraper:
                 reg.counter("fleet.scrape.errors").inc()
                 continue
             now = monotonic_now() - self._t0
+            det = None
             with self._lock:
-                self._rings[name].append((now, snap))
+                # setdefault: an evicted node that re-appears rebuilds
+                # its ring (and detector) from scratch
+                self._rings.setdefault(
+                    name, deque(maxlen=self._ring_len)).append((now, snap))
+                self._last_ok[name] = now
                 self._polls += 1
+                if self.anomaly:
+                    det = self._detectors.get(name)
+                    if det is None:
+                        from .anomaly import (AnomalyDetector,
+                                              default_tracked)
+                        det = AnomalyDetector(default_tracked(),
+                                              source=name,
+                                              register_gauges=False)
+                        self._detectors[name] = det
             reg.counter("fleet.scrape.polls").inc()
             ok += 1
+            # detector + tracker evaluate OUTSIDE the scraper lock (each
+            # takes its own lock; ours must stay above theirs, not hold
+            # them nested through fetch-heavy sweeps)
+            if det is not None:
+                det.evaluate(snap, now=now)
             if self.tracker is not None:
                 self.tracker.evaluate(snap, now=now)
+        self._evict_stale(monotonic_now() - self._t0)
         return ok
+
+    def _evict_stale(self, now: float) -> None:
+        """Drop ring + detector state for nodes whose last successful
+        scrape is beyond the retention window — the memory bound against
+        permanently-departed fleet members."""
+        if self.retention_s is None:
+            return
+        reg = _registry()
+        with self._lock:
+            stale = [name for name, ring in self._rings.items()
+                     if now - self._last_ok.get(name, 0.0)
+                     > self.retention_s]
+            for name in stale:
+                del self._rings[name]
+                self._detectors.pop(name, None)
+                self._last_ok.pop(name, None)
+                self._evicted += 1
+        for _ in stale:
+            reg.counter("fleet.scrape.evicted").inc()
 
     # -- readers ------------------------------------------------------------
     def ring(self, node: str) -> List[tuple]:
@@ -377,18 +444,40 @@ class FleetScraper:
         with self._lock:
             return self._errors
 
+    @property
+    def evicted(self) -> int:
+        with self._lock:
+            return self._evicted
+
+    def tracked_nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def node_anomalies(self) -> Dict[str, dict]:
+        """Per-node anomaly verdicts (empty when anomaly=False)."""
+        with self._lock:
+            detectors = dict(self._detectors)
+        # report() takes each detector's own lock — outside ours
+        return {name: det.report()
+                for name, det in sorted(detectors.items())}
+
     def report(self) -> dict:
         """The fleet-report section: curves, divergence deltas, scrape
-        accounting, and (when a tracker is attached) the SLO report."""
+        accounting, per-node anomaly verdicts, and (when a tracker is
+        attached) the SLO report."""
         out = {
             "cadence_s": self.cadence_s,
             "polls": self.polls,
             "errors": self.errors,
+            "evicted": self.evicted,
+            "nodes": self.tracked_nodes(),
             "curves": self.curves(),
             "divergence": {
                 label: self.divergence(metric, field)
                 for label, metric, field in self.CURVES},
         }
+        if self.anomaly:
+            out["anomalies"] = self.node_anomalies()
         if self.tracker is not None:
             out["slo"] = self.tracker.report()
         return out

@@ -114,6 +114,10 @@ CANONICAL_METRICS = frozenset({
     "bucket.merge.time",
     "bucket.merge.stream",
     "bucket.merge.bytes",
+    # close-blocked-on-merge: time add_batch spent waiting for an
+    # unresolved background merge before a spill commit (ISSUE 20
+    # read-path contention observability)
+    "bucket.merge.stall",
     "bucket.batch.addtime",
     "bucket.rehydrate",
     "bucket.rehydrate.entries",
@@ -123,6 +127,11 @@ CANONICAL_METRICS = frozenset({
     "bucketlistdb.prefetch",
     "bucketlistdb.cache.hit",
     "bucketlistdb.cache.miss",
+    # read-path contention counters (ISSUE 20): reader-held pin time per
+    # snapshot, live pin count, and bulk-read key volume
+    "bucketlistdb.pin.held",
+    "bucketlistdb.pin.active",
+    "bucketlistdb.read.keys",
     # accel
     "accel.ed25519.batch-size",
     "accel.ed25519.table-sigs",
@@ -146,6 +155,9 @@ CANONICAL_METRICS = frozenset({
     "fleet.trace.merge",
     "fleet.scrape.polls",
     "fleet.scrape.errors",
+    # retention bound (ISSUE 20): nodes absent beyond the scraper's
+    # retention window get their history evicted
+    "fleet.scrape.evicted",
     # always-on sampling profiler (util/sampleprof)
     "profile.sampler.samples",
     "profile.sampler.dropped",
@@ -169,9 +181,12 @@ CANONICAL_METRICS = frozenset({
 
 # Prefixes for families whose tail is data-dependent (one meter per overlay
 # message type; one probe counter per bucket-list level; one burn-rate
-# gauge per declared SLO objective).
+# gauge per declared SLO objective; the retrospective-telemetry plane —
+# time-series store, per-close cost ledger, anomaly detector — whose
+# gauge tails carry series names).
 CANONICAL_PREFIXES = ("overlay.recv.", "bucketlistdb.probe.",
-                      "slo.objective.")
+                      "slo.objective.", "timeseries.", "closecost.",
+                      "anomaly.")
 
 
 class Counter:
@@ -465,6 +480,13 @@ class MetricsRegistry:
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._metrics)
+
+    def items(self) -> List[tuple]:
+        """Sorted (name, metric) pairs — the change-aware capture path
+        (util/timeseries) walks metric objects directly so it can skip
+        snapshot recompute for provably-unchanged reservoirs."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     def snapshot(self, prefix: Optional[str] = None) -> Dict[str, dict]:
         with self._lock:

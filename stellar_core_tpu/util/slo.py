@@ -82,6 +82,11 @@ class SLOTracker:
         self._states: Dict[str, _ObjectiveState] = {
             o.name: _ObjectiveState(o.window) for o in self.objectives}
         self._lock = make_lock("slo.tracker")
+        # optional leading indicator: a zero-arg callable returning the
+        # currently-active anomaly series names (util/anomaly) — anomalies
+        # flag departures from the node's OWN baseline, usually before an
+        # absolute SLO threshold is crossed
+        self._anomaly_source = None
         reg = _registry()
         reg.counter("slo.eval.windows")
         reg.counter("slo.burn.flips")
@@ -89,6 +94,13 @@ class SLOTracker:
             # weak source: a torn-down tracker reads as null, never pins
             reg.weak_gauge(f"slo.objective.{o.name}", self,
                            _burn_gauge_source(o.name))
+
+    def attach_anomaly_source(self, fn) -> None:
+        """Wire an anomaly reader (e.g. AnomalyDetector.active) as a
+        leading indicator: report() surfaces the active series so a /slo
+        read shows WHY budget is about to burn, not just that it did."""
+        with self._lock:
+            self._anomaly_source = fn
 
     # -- evaluation ---------------------------------------------------------
     def evaluate(self, snapshot: Optional[Dict[str, dict]] = None,
@@ -183,8 +195,17 @@ class SLOTracker:
                               for t, v in st.values],
                 }
             ok = not any(st.burning for st in self._states.values())
-        return {"source": self.source, "ok": ok,
-                "objectives": objectives}
+            anomaly_source = self._anomaly_source
+        doc = {"source": self.source, "ok": ok,
+               "objectives": objectives}
+        if anomaly_source is not None:
+            # read OUTSIDE our lock: the detector takes its own lock and
+            # must stay a leaf relative to slo.tracker
+            try:
+                doc["anomalies"] = list(anomaly_source())
+            except Exception:  # corelint: disable=exception-hygiene -- a torn-down detector must not break /slo
+                doc["anomalies"] = []
+        return doc
 
 
 def _burn_gauge_source(name: str):

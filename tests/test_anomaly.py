@@ -1,0 +1,315 @@
+"""Adaptive anomaly detection (ISSUE 20): the EWMA+MAD band state
+machine, derived series injection, bundle writes, and the end-to-end
+injected-regression proof — a throttled close degrades mid-soak, the
+close-p99 anomaly flags within the window, the bundle carries the
+breaching telemetry, and the flag clears after recovery.
+"""
+
+import glob
+import json
+
+import pytest
+
+from stellar_core_tpu.main.config import Config
+from stellar_core_tpu.util import eventlog, metrics
+from stellar_core_tpu.util.anomaly import (AnomalyDetector, TrackedSeries,
+                                           default_tracked)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    metrics.reset_registry()
+    eventlog.event_log().clear()
+    yield
+    metrics.reset_registry()
+    eventlog.event_log().clear()
+
+
+def _series(**kw):
+    kw.setdefault("name", "lat")
+    kw.setdefault("metric", "ledger.ledger.close")
+    kw.setdefault("field", "p99_s")
+    kw.setdefault("floor", 0.01)
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("breach_n", 3)
+    kw.setdefault("clear_n", 3)
+    return TrackedSeries(**kw)
+
+
+class TestStateMachine:
+    def test_warmup_never_flags(self):
+        det = AnomalyDetector([_series(min_samples=8)])
+        for v in (0.01, 5.0, 0.01, 9.0, 0.02, 7.0, 0.01, 8.0):
+            assert det.observe("lat", v) is False
+        assert det.active() == []
+
+    def test_sustained_breach_flags_spike_does_not(self):
+        det = AnomalyDetector([_series()])
+        for _ in range(8):
+            det.observe("lat", 0.01)
+        # one-tick spike: breach_n=3 consecutive required
+        det.observe("lat", 5.0)
+        det.observe("lat", 0.01)
+        assert not det.is_active("lat")
+        # sustained departure flips the latch
+        det.observe("lat", 5.0)
+        det.observe("lat", 5.0)
+        assert not det.is_active("lat")
+        det.observe("lat", 5.0)
+        assert det.is_active("lat")
+        assert det.active() == ["lat"]
+
+    def test_clears_after_consecutive_inband(self):
+        det = AnomalyDetector([_series()])
+        for _ in range(8):
+            det.observe("lat", 0.01)
+        for _ in range(3):
+            det.observe("lat", 5.0)
+        assert det.is_active("lat")
+        det.observe("lat", 0.01)
+        det.observe("lat", 0.01)
+        assert det.is_active("lat")
+        det.observe("lat", 0.01)
+        assert not det.is_active("lat")
+        rep = det.report()["series"]["lat"]
+        assert rep["episodes"] == 1
+
+    def test_low_direction_flags_downward(self):
+        det = AnomalyDetector([_series(name="hit", direction="low",
+                                       floor=0.05)])
+        for _ in range(8):
+            det.observe("hit", 0.95)
+        for _ in range(3):
+            det.observe("hit", 0.10)
+        assert det.is_active("hit")
+        # upward departure on a low-direction series is fine
+        det2 = AnomalyDetector([_series(name="hit", direction="low",
+                                        floor=0.05)])
+        for _ in range(8):
+            det2.observe("hit", 0.5)
+        for _ in range(5):
+            det2.observe("hit", 0.99)
+        assert not det2.is_active("hit")
+
+    def test_floor_suppresses_constant_series_noise(self):
+        """A near-constant warm-up (MAD ~ 0) must not make every later
+        wiggle an anomaly — the floor keeps a minimum band width."""
+        det = AnomalyDetector([_series(floor=0.01)])
+        for _ in range(10):
+            det.observe("lat", 0.002)
+        for _ in range(10):
+            det.observe("lat", 0.004)  # wiggle far inside the floor band
+        assert not det.is_active("lat")
+
+    def test_baseline_freezes_while_breaching(self):
+        """A sustained regression must NOT drag its own baseline along
+        and self-clear without recovering."""
+        det = AnomalyDetector([_series()])
+        for _ in range(8):
+            det.observe("lat", 0.01)
+        for _ in range(50):
+            det.observe("lat", 5.0)
+        assert det.is_active("lat")
+        assert det.report()["series"]["lat"]["mean"] < 0.1
+
+    def test_flag_clear_counters(self):
+        det = AnomalyDetector([_series()])
+        for _ in range(8):
+            det.observe("lat", 0.01)
+        for _ in range(3):
+            det.observe("lat", 5.0)
+        for _ in range(3):
+            det.observe("lat", 0.01)
+        snap = metrics.registry().snapshot()
+        assert snap["anomaly.flags"]["count"] == 1
+        assert snap["anomaly.clears"]["count"] == 1
+        msgs = [e.msg for e in eventlog.event_log().events()]
+        assert "anomaly-detected" in msgs
+        assert "anomaly-cleared" in msgs
+
+
+class TestEvaluate:
+    def test_pull_mode_reads_snapshot_fields(self):
+        det = AnomalyDetector([_series()])
+        for _ in range(8):
+            det.evaluate({"ledger.ledger.close": {"p99_s": 0.01}})
+        for _ in range(3):
+            out = det.evaluate({"ledger.ledger.close": {"p99_s": 5.0}})
+        assert out == {"lat": True}
+
+    def test_absent_metric_is_skipped(self):
+        det = AnomalyDetector([_series()])
+        out = det.evaluate({"scp.value.sign": {"count": 1}})
+        assert out == {}
+        assert det.report()["series"]["lat"]["samples"] == 0
+
+    def test_derived_cache_hit_rate(self):
+        """The hit-rate series is synthesized from per-eval hit/miss
+        count deltas; a sustained drop flags cache-hit-rate."""
+        det = AnomalyDetector(default_tracked())
+        hits, misses = 0, 0
+        for _ in range(12):
+            hits += 95
+            misses += 5
+            det.evaluate({
+                "bucketlistdb.cache.hit": {"count": hits},
+                "bucketlistdb.cache.miss": {"count": misses}})
+        st = det.report()["series"]["cache-hit-rate"]
+        assert st["samples"] > 0
+        assert st["last_value"] == pytest.approx(0.95)
+        for _ in range(4):
+            hits += 5
+            misses += 95
+            det.evaluate({
+                "bucketlistdb.cache.hit": {"count": hits},
+                "bucketlistdb.cache.miss": {"count": misses}})
+        assert det.is_active("cache-hit-rate")
+
+    def test_no_traffic_skips_hit_rate(self):
+        det = AnomalyDetector(default_tracked())
+        for _ in range(3):
+            det.evaluate({"bucketlistdb.cache.hit": {"count": 10},
+                          "bucketlistdb.cache.miss": {"count": 10}})
+        # first eval seeds the delta base; later no-traffic evals skip
+        assert det.report()["series"]["cache-hit-rate"]["samples"] == 0
+
+
+class TestBundles:
+    def test_bundle_carries_window_costs_and_state(self, tmp_path):
+        from stellar_core_tpu.ledger.costs import CloseCostLedger
+        from stellar_core_tpu.util.timeseries import TimeSeriesStore
+        c = metrics.registry().counter("ledger.ledger.close")
+        ts = TimeSeriesStore()
+        cc = CloseCostLedger()
+        for i in range(10):
+            c.inc()
+            ts.capture(now=float(i))
+            cc.add(seq=i + 1, txs=1, total_s=0.01, fee_s=0.001,
+                   apply_s=0.005, seal_s=0.002, merge_stall_s=0.0,
+                   cache_hits=1, cache_misses=0, pin_count=0,
+                   resident_entries=5, resident_delta=0, gc_backlog=0)
+        det = AnomalyDetector([_series()], timeseries=lambda: ts,
+                              closecosts=lambda: cc, source="n1")
+        path = det.write_bundle("lat", reason="test",
+                                out_dir=str(tmp_path))
+        doc = json.loads(open(path).read())
+        assert doc["kind"] == "anomaly-bundle"
+        assert doc["series"] == "lat"
+        assert doc["source"] == "n1"
+        pts = doc["timeseries"]["ledger.ledger.close"]
+        assert pts and all("seq" in p and "v" in p for p in pts)
+        assert len(doc["closecosts"]) == 10
+        assert doc["closecosts"][-1]["seq"] == 10
+        assert "state" in doc
+
+    def test_bundle_without_providers(self, tmp_path):
+        det = AnomalyDetector([_series()])
+        path = det.write_bundle("lat", out_dir=str(tmp_path))
+        doc = json.loads(open(path).read())
+        assert "timeseries" not in doc
+        assert "closecosts" not in doc
+
+
+class TestRegressionProof:
+    """The acceptance proof: a throttle seam degrades close latency
+    mid-soak; the anomaly flags within the detection window, writes a
+    bundle holding the breaching telemetry, and clears after the
+    throttle lifts and enough healthy closes dilute the p99 tail."""
+
+    def test_injected_close_regression_flags_and_clears(
+            self, tmp_path, monkeypatch):
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+        monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "anomaly proof net",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+            "TIMESERIES_CADENCE_S": 1.0,
+            "ANOMALY_EVAL_CADENCE_S": 1.0,
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        app.start()
+        try:
+            det = app.anomaly
+            assert det is not None and app.timeseries is not None
+            # healthy baseline: enough evals to warm the close-p99 series
+            assert clock.crank_until(
+                lambda: det.report()["series"]["close-p99"]["samples"]
+                >= 10, timeout=120)
+            assert not det.is_active("close-p99")
+            baseline_seq = app.lm.last_closed_ledger_seq
+
+            # inject the regression: every close spins an extra 150 ms
+            app.lm.debug_close_throttle_s = 0.15
+            assert clock.crank_until(
+                lambda: det.is_active("close-p99"), timeout=120), \
+                "throttled closes never flagged the close-p99 anomaly"
+            assert app.lm.last_closed_ledger_seq > baseline_seq
+
+            # the detection wrote a bundle with the breaching evidence
+            bundles = glob.glob(str(tmp_path / "anomaly-close-p99-*.json"))
+            assert bundles, "no anomaly bundle written at detection"
+            doc = json.loads(open(bundles[0]).read())
+            assert doc["kind"] == "anomaly-bundle"
+            assert doc["reason"] == "anomaly-detected"
+            pts = doc["timeseries"]["ledger.ledger.close"]
+            assert pts, "bundle missing the breaching time-series window"
+            assert any(p["v"].get("p99_s", 0) > 0.1 for p in pts)
+            costs = doc["closecosts"]
+            assert costs, "bundle missing the CloseCostRecords"
+            assert any(r["total_s"] > 0.1 for r in costs)
+
+            # flight events + gauges carry the episode
+            msgs = [e.msg for e in eventlog.event_log().events()]
+            assert "anomaly-detected" in msgs
+            assert metrics.registry().snapshot()[
+                "anomaly.active"]["value"] >= 1
+
+            # recovery: lift the throttle; healthy closes dilute the
+            # decaying p99 reservoir until the series re-enters band,
+            # then clear_n consecutive in-band evals clear the latch
+            app.lm.debug_close_throttle_s = 0.0
+            assert clock.crank_until(
+                lambda: not det.is_active("close-p99"), timeout=3600), \
+                "anomaly never cleared after the throttle lifted"
+            msgs = [e.msg for e in eventlog.event_log().events()]
+            assert "anomaly-cleared" in msgs
+        finally:
+            app.stop()
+
+    def test_close_costs_recorded_during_soak(self):
+        """The per-close cost ledger fills during a normal standalone
+        soak (either close engine) and serves watermarked reads."""
+        from stellar_core_tpu.main.application import Application
+        from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "closecost net",
+            "RUN_STANDALONE": True,
+            "PEER_PORT": 0,
+        })
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app = Application(cfg, clock=clock, listen=False)
+        app.start()
+        try:
+            assert clock.crank_until(
+                lambda: len(app.lm.close_costs) >= 5, timeout=60)
+            doc = app.lm.close_costs.doc()
+            recs = doc["records"]
+            assert [r["export_seq"] for r in recs] \
+                == sorted(r["export_seq"] for r in recs)
+            assert all(r["total_s"] > 0 for r in recs)
+            # ledger seqs are consecutive closes
+            seqs = [r["seq"] for r in recs]
+            assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+            # watermark: incremental read picks up only new rows
+            mark = doc["next_since"]
+            assert clock.crank_until(
+                lambda: app.lm.close_costs.next_since > mark, timeout=60)
+            incr = app.lm.close_costs.doc(since=mark)
+            assert incr["records"]
+            assert all(r["export_seq"] > mark for r in incr["records"])
+        finally:
+            app.stop()

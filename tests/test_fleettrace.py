@@ -285,6 +285,137 @@ class TestFleetScraper:
         assert "slo" in sc.report()
 
 
+class TestScraperRetention:
+    """Satellite (ISSUE 20): rings for nodes absent beyond the
+    retention window are evicted (memory bound against permanently-
+    departed fleet members); a returning node starts fresh."""
+
+    def _mk(self, snaps, retention_s, **kw):
+        dead = kw.pop("dead", set())
+
+        def fetcher(name):
+            def fetch():
+                if name in dead:
+                    raise RuntimeError("down")
+                return snaps[name]
+            return fetch
+        sc = FleetScraper({n: fetcher(n) for n in snaps},
+                          cadence_s=0.01, retention_s=retention_s, **kw)
+        return sc, dead
+
+    @staticmethod
+    def _advance(monkeypatch, by_s):
+        import stellar_core_tpu.util.fleettrace as ft
+        real = ft.monotonic_now
+        monkeypatch.setattr(ft, "monotonic_now", lambda: real() + by_s)
+
+    def test_absent_node_evicted_after_window(self, monkeypatch):
+        snaps = {"a": {"ledger.ledger.close": {"p99_s": 0.1}},
+                 "b": {"ledger.ledger.close": {"p99_s": 0.1}}}
+        sc, dead = self._mk(snaps, retention_s=5.0)
+        sc.sweep()
+        assert sc.tracked_nodes() == ["a", "b"]
+        dead.add("b")
+        self._advance(monkeypatch, 10.0)
+        sc.sweep()
+        assert sc.tracked_nodes() == ["a"]
+        assert sc.ring("b") == []
+        assert sc.evicted == 1
+        assert sc.report()["evicted"] == 1
+        assert metrics.registry().snapshot()[
+            "fleet.scrape.evicted"]["count"] == 1
+
+    def test_absence_inside_window_keeps_history(self, monkeypatch):
+        snaps = {"a": {"m": {"value": 1}}}
+        sc, dead = self._mk(snaps, retention_s=60.0)
+        sc.sweep()
+        dead.add("a")
+        self._advance(monkeypatch, 5.0)
+        sc.sweep()  # error, but well inside the window
+        assert sc.tracked_nodes() == ["a"]
+        assert len(sc.ring("a")) == 1
+        assert sc.evicted == 0
+
+    def test_returning_node_rebuilds_fresh_ring(self, monkeypatch):
+        snaps = {"a": {"m": {"value": 1}}}
+        sc, dead = self._mk(snaps, retention_s=5.0)
+        for _ in range(4):
+            sc.sweep()
+        dead.add("a")
+        self._advance(monkeypatch, 10.0)
+        sc.sweep()
+        assert sc.tracked_nodes() == []
+        dead.discard("a")
+        sc.sweep()
+        assert sc.tracked_nodes() == ["a"]
+        assert len(sc.ring("a")) == 1  # fresh, not the old 4-deep ring
+
+    def test_no_retention_means_no_eviction(self, monkeypatch):
+        snaps = {"a": {"m": {"value": 1}}}
+        sc, dead = self._mk(snaps, retention_s=None)
+        sc.sweep()
+        dead.add("a")
+        self._advance(monkeypatch, 10_000.0)
+        sc.sweep()
+        assert sc.tracked_nodes() == ["a"]
+        assert sc.evicted == 0
+
+
+class TestScraperAnomalies:
+    """Satellite (ISSUE 20): one AnomalyDetector per scraped node,
+    gauge registration off, verdicts in the fleet report."""
+
+    def test_per_node_verdicts_in_report(self):
+        vals = {"a": 0.01, "b": 0.01}
+        sc = FleetScraper(
+            {n: (lambda n=n: {
+                "ledger.ledger.close": {"p99_s": vals[n]}})
+             for n in vals},
+            cadence_s=0.01, anomaly=True)
+        for _ in range(10):
+            sc.sweep()   # healthy baseline for both nodes
+        vals["b"] = 5.0  # node b regresses; node a stays healthy
+        for _ in range(4):
+            sc.sweep()
+        rep = sc.report()
+        assert rep["anomalies"]["b"]["series"]["close-p99"]["active"]
+        assert not rep["anomalies"]["a"]["series"]["close-p99"]["active"]
+        assert rep["anomalies"]["b"]["source"] == "b"
+
+    def test_per_node_detectors_do_not_register_gauges(self):
+        sc = FleetScraper(
+            {"a": lambda: {"ledger.ledger.close": {"p99_s": 0.01}}},
+            cadence_s=0.01, anomaly=True)
+        sc.sweep()
+        names = metrics.registry().names()
+        assert "anomaly.active" not in names
+        assert not any(n.startswith("anomaly.active.") for n in names)
+
+    def test_eviction_drops_detector_state(self, monkeypatch):
+        dead = set()
+
+        def fetch():
+            if "a" in dead:
+                raise RuntimeError("down")
+            return {"ledger.ledger.close": {"p99_s": 0.01}}
+        sc = FleetScraper({"a": fetch}, cadence_s=0.01,
+                          retention_s=5.0, anomaly=True)
+        for _ in range(6):
+            sc.sweep()
+        assert sc.node_anomalies()["a"]["series"]["close-p99"]["samples"] > 0
+        dead.add("a")
+        import stellar_core_tpu.util.fleettrace as ft
+        real = ft.monotonic_now
+        monkeypatch.setattr(ft, "monotonic_now", lambda: real() + 10.0)
+        sc.sweep()
+        assert sc.node_anomalies() == {}
+        dead.discard("a")
+        sc.sweep()
+        # fresh detector: baseline restarts from zero samples
+        assert sc.node_anomalies()["a"]["series"]["close-p99"]["samples"] \
+            <= 1
+
+
 class TestEndpoints:
     """Round-trips through the live admin HTTP server (the app_http
     fixture shape from test_observability)."""

@@ -518,6 +518,29 @@ class TestMetricRegistry:
             """, ["metric-registry"])
         assert len(rule_hits(rep, "metric-registry")) == 1
 
+    def test_quiet_on_telemetry_prefixes(self, tmp_path):
+        # ISSUE 20: the historical-telemetry plane mints per-series
+        # names under blanket prefixes (timeseries./closecost./anomaly.)
+        rep = lint_src(tmp_path, "m.py", """
+            def f(reg, name):
+                reg.counter("timeseries.capture.ticks")
+                reg.timer("timeseries.capture.tick-time")
+                reg.gauge("closecost.records.retained")
+                reg.counter("anomaly.flags")
+                reg.gauge(f"anomaly.active.{name}")
+            """, ["metric-registry"])
+        assert not rule_hits(rep, "metric-registry")
+
+    def test_fires_on_near_miss_telemetry_names(self, tmp_path):
+        # prefix matching is exact: sibling spellings stay undocumented
+        rep = lint_src(tmp_path, "m.py", """
+            def f(reg):
+                reg.counter("timeserieses.capture.ticks")
+                reg.gauge("closecosts.records.retained")
+                reg.counter("anomalies.active.total")
+            """, ["metric-registry"])
+        assert len(rule_hits(rep, "metric-registry")) == 3
+
 
 # ---------------------------------------------------------------------------
 # eventlog-partitions

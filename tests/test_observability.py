@@ -384,3 +384,158 @@ class TestScopedTimerThresholds:
                            for r in caplog.records)
         finally:
             perf.set_slow_threshold("obs-hot-scope", None)
+
+
+class TestPrometheusCompleteness:
+    """Satellite (ISSUE 20): the Prometheus exposition drops nothing —
+    every name the registry holds (canonical list or prefix family)
+    appears in /metrics?format=prometheus, whatever its type."""
+
+    def test_every_registered_name_is_exported(self, app_http):
+        app, clock, port = app_http
+        names = metrics.registry().names()
+        assert names
+        body, _ = _http_get(port, "/metrics?format=prometheus")
+        text = body.decode()
+        missing = [
+            n for n in names
+            if f"stellar_core_tpu_{metrics._prom_name(n)}" not in text]
+        assert not missing, \
+            f"registered metrics absent from exposition: {missing}"
+        # the canonical list itself is exercised, not vacuously empty
+        assert any(n in metrics.CANONICAL_METRICS for n in names)
+
+    def test_dead_gauges_export_as_nan_not_dropped(self):
+        reg = metrics.MetricsRegistry()
+
+        class _Obj:
+            pass
+
+        obj = _Obj()
+        obj.v = 1.0
+        reg.weak_gauge("herder.tx-queue.depth", obj, lambda o: o.v)
+        del obj
+        import gc
+        gc.collect()
+        text = metrics.render_prometheus(reg.snapshot())
+        assert "stellar_core_tpu_herder_tx_queue_depth NaN" in text
+
+
+@pytest.fixture()
+def telemetry_http(tmp_path, monkeypatch):
+    """app_http with the historical-telemetry plane enabled: capture
+    timer, anomaly evaluation timer, close-cost ledger reads."""
+    from stellar_core_tpu.main.application import Application
+    from stellar_core_tpu.main.http_admin import CommandHandler
+    from stellar_core_tpu.util.clock import ClockMode, VirtualClock
+
+    monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+    metrics.reset_registry()
+    cfg = Config.from_dict({
+        "NETWORK_PASSPHRASE": "telemetry test net",
+        "RUN_STANDALONE": True,
+        "PEER_PORT": 0,
+        "TIMESERIES_CADENCE_S": 1.0,
+        "ANOMALY_EVAL_CADENCE_S": 1.0,
+    })
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application(cfg, clock=clock, listen=False)
+    http = CommandHandler(app, 0)
+    http.start()
+    app.start()
+    assert clock.crank_until(
+        lambda: app.lm.last_closed_ledger_seq >= 4
+        and app.timeseries.seq >= 4, timeout=120)
+    try:
+        yield app, clock, http.port
+    finally:
+        http.stop()
+        app.stop()
+
+
+class TestTimeseriesEndpoint:
+    """Satellite (ISSUE 20): /timeseries round-trips with the
+    /tracespans watermark contract."""
+
+    def test_roundtrip_serves_reconstructed_history(self, telemetry_http):
+        app, clock, port = telemetry_http
+        doc = json.loads(_http_get(port, "/timeseries")[0])
+        assert doc["next_since"] == app.timeseries.seq
+        assert doc["cadence_s"] == 1.0
+        pts = doc["series"]["ledger.ledger.close"]
+        assert len(pts) >= 4
+        seqs = [p["seq"] for p in pts]
+        assert seqs == sorted(seqs)
+        assert all("count" in p["v"] for p in pts)
+
+    def test_watermark_incremental(self, telemetry_http):
+        app, clock, port = telemetry_http
+        mark = json.loads(_http_get(port, "/timeseries")[0])["next_since"]
+        assert clock.crank_until(
+            lambda: app.timeseries.seq > mark, timeout=60)
+        incr = json.loads(
+            _http_get(port, f"/timeseries?since={mark}")[0])
+        assert incr["series"], "no new points past the watermark"
+        for pts in incr["series"].values():
+            assert all(p["seq"] > mark for p in pts)
+        # fully caught up: empty document, stable watermark
+        done = json.loads(_http_get(
+            port, f"/timeseries?since={incr['next_since']}")[0])
+        assert done["series"] == {}
+
+    def test_metric_filter(self, telemetry_http):
+        app, clock, port = telemetry_http
+        doc = json.loads(_http_get(
+            port, "/timeseries?metric=ledger.ledger.close")[0])
+        assert list(doc["series"]) == ["ledger.ledger.close"]
+
+    def test_404_without_store(self, app_http):
+        app, clock, port = app_http
+        assert app.timeseries is None
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http_get(port, "/timeseries")
+        assert ei.value.code == 404
+
+    @pytest.mark.parametrize("path", [
+        "/timeseries?since=bogus",
+        "/timeseries?metric=NotALegalName",
+        "/timeseries?metric=nodots",
+    ])
+    def test_malformed_params_answer_400(self, telemetry_http, path):
+        app, clock, port = telemetry_http
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http_get(port, path)
+        assert ei.value.code == 400
+        assert "error" in json.loads(ei.value.read())
+
+
+class TestClosecostsEndpoint:
+    """Satellite (ISSUE 20): the per-close cost ledger's admin read."""
+
+    def test_roundtrip_and_watermark(self, telemetry_http):
+        app, clock, port = telemetry_http
+        doc = json.loads(_http_get(port, "/closecosts")[0])
+        recs = doc["records"]
+        assert recs, "no close-cost records after closed ledgers"
+        for field in ("export_seq", "seq", "txs", "total_s", "fee_s",
+                      "apply_s", "seal_s", "merge_stall_s", "cache_hits",
+                      "cache_misses", "pin_count", "resident_entries",
+                      "resident_delta", "gc_backlog"):
+            assert field in recs[0], field
+        mark = doc["next_since"]
+        assert mark == recs[-1]["export_seq"]
+        assert clock.crank_until(
+            lambda: app.lm.close_costs.next_since > mark, timeout=60)
+        incr = json.loads(
+            _http_get(port, f"/closecosts?since={mark}")[0])
+        assert incr["records"]
+        assert all(r["export_seq"] > mark for r in incr["records"])
+
+    def test_malformed_since_answers_400(self, telemetry_http):
+        app, clock, port = telemetry_http
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http_get(port, "/closecosts?since=xyz")
+        assert ei.value.code == 400

@@ -1,0 +1,259 @@
+"""Historical telemetry store (ISSUE 20): capture-tick delta encoding,
+tiered retention, watermark export, dump persistence + the tsdump
+subcommand, and the capture thread lifecycle.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from stellar_core_tpu.util import metrics
+from stellar_core_tpu.util.timeseries import (DOWNSAMPLE, TimeSeriesStore,
+                                              load_dump)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset_registry()
+    yield
+    metrics.reset_registry()
+
+
+def _store(**kw):
+    kw.setdefault("cadence_s", 1.0)
+    return TimeSeriesStore(**kw)
+
+
+class TestCaptureAndReplay:
+    def test_points_reconstruct_full_fields(self):
+        """Delta-encoded ticks replay back to the exact per-tick field
+        values the registry reported."""
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store()
+        expect = []
+        for i in range(10):
+            c.inc()
+            s.capture(now=float(i))
+            expect.append(i + 1)
+        pts = s.doc(metric="ledger.ledger.close")["series"][
+            "ledger.ledger.close"]
+        assert [p["v"]["count"] for p in pts] == expect
+        assert [p["seq"] for p in pts] == list(range(1, 11))
+        assert [p["t"] for p in pts] == [float(i) for i in range(10)]
+
+    def test_idle_metric_deltas_are_empty(self):
+        """An unchanged metric costs an empty delta per tick, not a full
+        row — the bound that makes a 1 s cadence affordable."""
+        metrics.registry().counter("ledger.ledger.close").inc()
+        s = _store()
+        for i in range(6):
+            s.capture(now=float(i))
+        dq = s._dense["ledger.ledger.close"]
+        # tick 1 carries the full fields; later ticks change nothing
+        deltas = [delta for _, _, delta, _ in list(dq)[1:]]
+        assert all(d == {} for d in deltas)
+        # replay still yields full points for every tick
+        pts = s.doc(metric="ledger.ledger.close")["series"][
+            "ledger.ledger.close"]
+        assert len(pts) == 6
+        assert all(p["v"]["count"] == 1 for p in pts)
+
+    def test_keyframes_interleave_deltas(self):
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store(key_interval=4)
+        for i in range(9):
+            c.inc()
+            s.capture(now=float(i))
+        dq = s._dense["ledger.ledger.close"]
+        keys = [seq for seq, _, _, is_key in dq if is_key]
+        assert keys == [4, 8]
+
+    def test_registry_swap_is_picked_up(self):
+        """reset_registry() swaps the registry object; the next capture
+        must snapshot the NEW registry (and re-home the self gauges)."""
+        metrics.registry().counter("ledger.ledger.close").inc()
+        s = _store()
+        s.capture(now=0.0)
+        metrics.reset_registry()
+        metrics.registry().counter("scp.value.sign").inc()
+        s.capture(now=1.0)
+        assert "scp.value.sign" in s.metric_names()
+        assert "timeseries.points.retained" in metrics.registry().names()
+
+    def test_capture_accounting_metrics(self):
+        s = _store()
+        s.capture(now=0.0)
+        s.capture(now=1.0)
+        names = metrics.registry().names()
+        assert "timeseries.capture.ticks" in names
+        assert "timeseries.capture.tick-time" in names
+
+
+class TestRetention:
+    def test_dense_ring_is_bounded(self):
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store(dense_points=8, tail_points=4)
+        for i in range(40):
+            c.inc()
+            s.capture(now=float(i))
+        assert len(s._dense["ledger.ledger.close"]) == 8
+
+    def test_evicted_points_survive_downsampled(self):
+        """Points rolled out of the dense window stay readable at
+        1-in-DOWNSAMPLE resolution, with correct replayed values."""
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store(dense_points=8, tail_points=64)
+        n = 64
+        for i in range(n):
+            c.inc()
+            s.capture(now=float(i))
+        pts = s.doc(metric="ledger.ledger.close")["series"][
+            "ledger.ledger.close"]
+        seqs = [p["seq"] for p in pts]
+        # the dense window is the trailing 8 ticks...
+        assert seqs[-8:] == list(range(n - 7, n + 1))
+        # ...and the tail holds downsampled evicted ticks before it
+        tail = seqs[:-8]
+        assert tail, "no tail survived eviction"
+        assert all(seq % DOWNSAMPLE == 0 for seq in tail)
+        # replayed values stay exact through eviction
+        assert all(p["v"]["count"] == p["seq"] for p in pts)
+
+    def test_tail_ring_is_bounded(self):
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store(dense_points=4, tail_points=3)
+        for i in range(200):
+            c.inc()
+            s.capture(now=float(i))
+        assert len(s._tail["ledger.ledger.close"]) == 3
+
+
+class TestWatermark:
+    def test_since_filters_and_next_since_advances(self):
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store()
+        for i in range(5):
+            c.inc()
+            s.capture(now=float(i))
+        first = s.doc()
+        assert first["next_since"] == 5
+        for i in range(3):
+            c.inc()
+            s.capture(now=5.0 + i)
+        incr = s.doc(since=first["next_since"])
+        pts = incr["series"]["ledger.ledger.close"]
+        assert [p["seq"] for p in pts] == [6, 7, 8]
+        assert incr["next_since"] == 8
+        # fully caught up: empty series, watermark stays put
+        done = s.doc(since=8)
+        assert done["series"] == {}
+        assert done["next_since"] == 8
+
+    def test_window_returns_trailing_ticks(self):
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store()
+        for i in range(20):
+            c.inc()
+            s.capture(now=float(i))
+        w = s.window("ledger.ledger.close", 5)
+        assert [p["seq"] for p in w] == [16, 17, 18, 19, 20]
+
+    def test_metric_filter(self):
+        metrics.registry().counter("ledger.ledger.close").inc()
+        metrics.registry().counter("scp.value.sign").inc()
+        s = _store()
+        s.capture(now=0.0)
+        doc = s.doc(metric="ledger.ledger.close")
+        assert list(doc["series"]) == ["ledger.ledger.close"]
+
+
+class TestCaptureThread:
+    def test_start_stop_idempotent(self):
+        s = _store(cadence_s=0.01)
+        s.start()
+        t = s._thread
+        s.start()  # second start is a no-op
+        assert s._thread is t
+        assert s.running
+        # the daemon captures on its own cadence
+        deadline = 50
+        while s.seq == 0 and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        assert s.seq > 0
+        s.stop()
+        assert not s.running
+        s.stop()  # idempotent
+
+    def test_timer_driven_store_needs_no_thread(self):
+        s = _store()
+        s.capture(now=0.0)
+        assert not s.running
+        s.stop()  # no-op
+
+
+class TestDumpAndTsdump:
+    def _dumped(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("STPU_CRASH_DIR", str(tmp_path))
+        c = metrics.registry().counter("ledger.ledger.close")
+        s = _store()
+        for i in range(6):
+            c.inc()
+            s.capture(now=float(i))
+        return s, s.dump(reason="test")
+
+    def test_dump_roundtrips_through_load(self, tmp_path, monkeypatch):
+        s, path = self._dumped(tmp_path, monkeypatch)
+        assert os.path.dirname(path) == str(tmp_path)
+        doc = load_dump(path)
+        assert doc["kind"] == "timeseries-dump"
+        assert doc["reason"] == "test"
+        assert doc["next_since"] == s.seq
+        live = s.doc(metric="ledger.ledger.close")["series"]
+        assert doc["series"]["ledger.ledger.close"] \
+            == live["ledger.ledger.close"]
+
+    def test_load_rejects_non_dumps(self, tmp_path):
+        p = tmp_path / "not-a-dump.json"
+        p.write_text(json.dumps({"kind": "crash-bundle", "series": {}}))
+        with pytest.raises(ValueError):
+            load_dump(str(p))
+        p2 = tmp_path / "not-json.json"
+        p2.write_text("{")
+        with pytest.raises(ValueError):
+            load_dump(str(p2))
+
+    def test_tsdump_summary_matches_dump(self, tmp_path, monkeypatch,
+                                         capsys):
+        """The tsdump subcommand's summary agrees with the persisted
+        document (satellite: offline dump reader)."""
+        from stellar_core_tpu.main.commandline import main
+        s, path = self._dumped(tmp_path, monkeypatch)
+        assert main(["tsdump", path]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["kind"] == "timeseries-dump"
+        assert out["next_since"] == s.seq
+        row = next(r for r in out["series"]
+                   if r["metric"] == "ledger.ledger.close")
+        assert row["points"] == 6
+        assert row["last_seq"] == s.seq
+        assert row["last"]["count"] == 6
+
+    def test_tsdump_single_metric_since(self, tmp_path, monkeypatch,
+                                        capsys):
+        from stellar_core_tpu.main.commandline import main
+        _, path = self._dumped(tmp_path, monkeypatch)
+        assert main(["tsdump", path, "--metric", "ledger.ledger.close",
+                     "--since", "4"]) == 0
+        lines = [json.loads(line) for line in
+                 capsys.readouterr().out.splitlines()]
+        assert [p["seq"] for p in lines] == [5, 6]
+
+    def test_tsdump_errors_exit_nonzero(self, tmp_path, monkeypatch,
+                                        capsys):
+        from stellar_core_tpu.main.commandline import main
+        _, path = self._dumped(tmp_path, monkeypatch)
+        assert main(["tsdump", str(tmp_path / "absent.json")]) == 1
+        assert main(["tsdump", path, "--metric", "no.such.metric"]) == 1
